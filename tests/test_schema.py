@@ -1,0 +1,75 @@
+from tpu_cypher.api.schema import PropertyGraphSchema, SchemaPattern
+from tpu_cypher.api.types import CTFloat, CTInteger, CTNumber, CTString
+
+
+def make_schema():
+    return (
+        PropertyGraphSchema.empty()
+        .with_node_combination(["Person"], {"name": CTString, "age": CTInteger})
+        .with_node_combination(["Person", "Employee"], {"name": CTString, "salary": CTFloat})
+        .with_node_combination(["Book"], {"title": CTString})
+        .with_relationship_type("KNOWS", {"since": CTInteger})
+    )
+
+
+def test_labels_and_combos():
+    s = make_schema()
+    assert s.labels == {"Person", "Employee", "Book"}
+    assert frozenset(["Person"]) in s.label_combinations
+    assert s.combinations_for(["Person"]) == {
+        frozenset(["Person"]),
+        frozenset(["Person", "Employee"]),
+    }
+    assert s.relationship_types == {"KNOWS"}
+
+
+def test_property_key_merging():
+    s = make_schema()
+    keys = s.node_property_keys_for_labels(["Person"])
+    assert keys["name"] == CTString
+    # age exists only on :Person combo -> nullable when merged
+    assert keys["age"] == CTInteger.nullable
+    assert keys["salary"] == CTFloat.nullable
+
+
+def test_exact_combo_keys():
+    s = make_schema()
+    assert s.node_property_keys(["Person"]) == {"name": CTString, "age": CTInteger}
+    assert s.node_property_keys(["Missing"]) == {}
+
+
+def test_union():
+    a = PropertyGraphSchema.empty().with_node_combination(["A"], {"p": CTInteger})
+    b = PropertyGraphSchema.empty().with_node_combination(["A"], {"p": CTFloat})
+    u = a + b
+    assert u.node_property_keys(["A"])["p"] == CTNumber
+
+
+def test_implied_labels():
+    s = (
+        PropertyGraphSchema.empty()
+        .with_node_combination(["A", "B"])
+        .with_node_combination(["A", "B", "C"])
+        .with_node_combination(["B"])
+    )
+    implied = s.implied_labels
+    assert implied["A"] == {"B"}
+    assert implied["B"] == frozenset()
+    assert implied["C"] == {"A", "B"}
+
+
+def test_for_node_restriction():
+    s = make_schema()
+    restricted = s.for_node(["Person"])
+    assert restricted.label_combinations == {
+        frozenset(["Person"]),
+        frozenset(["Person", "Employee"]),
+    }
+    assert restricted.relationship_types == frozenset()
+
+
+def test_json_roundtrip():
+    s = make_schema().with_schema_patterns(
+        SchemaPattern(["Person"], "KNOWS", ["Person"])
+    )
+    assert PropertyGraphSchema.from_json(s.to_json()) == s

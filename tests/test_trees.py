@@ -1,0 +1,104 @@
+"""Analog of the reference's TreeNodeTest (okapi-trees)."""
+
+import sys
+from dataclasses import dataclass
+from typing import Tuple
+
+from tpu_cypher.trees import TreeNode
+
+
+@dataclass(frozen=True)
+class Num(TreeNode):
+    value: int
+
+
+@dataclass(frozen=True)
+class Add(TreeNode):
+    lhs: TreeNode
+    rhs: TreeNode
+
+
+@dataclass(frozen=True)
+class Sum(TreeNode):
+    terms: Tuple[TreeNode, ...]
+
+
+def test_children_and_rebuild():
+    t = Add(Num(1), Num(2))
+    assert t.children == (Num(1), Num(2))
+    t2 = t.with_new_children((Num(3), Num(4)))
+    assert t2 == Add(Num(3), Num(4))
+    # identity preserved when unchanged
+    assert t.with_new_children(t.children) is t
+
+
+def test_children_in_sequences():
+    t = Sum((Num(1), Num(2), Num(3)))
+    assert t.children == (Num(1), Num(2), Num(3))
+    t2 = t.with_new_children((Num(9), Num(8), Num(7)))
+    assert t2 == Sum((Num(9), Num(8), Num(7)))
+
+
+def test_bottom_up_rewrite():
+    t = Add(Num(1), Add(Num(2), Num(3)))
+
+    def rule(n):
+        if isinstance(n, Add) and isinstance(n.lhs, Num) and isinstance(n.rhs, Num):
+            return Num(n.lhs.value + n.rhs.value)
+        return n
+
+    assert t.rewrite(rule) == Num(6)
+
+
+def test_top_down_rewrite():
+    t = Add(Num(1), Num(2))
+
+    def rule(n):
+        if isinstance(n, Num):
+            return Num(n.value * 10)
+        return n
+
+    assert t.rewrite_top_down(rule) == Add(Num(10), Num(20))
+
+
+def test_transform_fold():
+    t = Add(Num(1), Add(Num(2), Num(3)))
+
+    def fold(n, kids):
+        if isinstance(n, Num):
+            return n.value
+        return sum(kids)
+
+    assert t.transform(fold) == 6
+
+
+def test_stack_safety():
+    # deep chain far beyond the recursion limit
+    depth = sys.getrecursionlimit() * 3
+    t = Num(0)
+    for i in range(depth):
+        t = Add(t, Num(1))
+
+    def fold(n, kids):
+        if isinstance(n, Num):
+            return n.value
+        return sum(kids)
+
+    assert t.transform(fold) == depth
+    out = t.rewrite(lambda n: n)
+    assert out.height == depth + 1
+    assert out.size == 2 * depth + 1
+
+
+def test_pretty():
+    t = Add(Num(1), Add(Num(2), Num(3)))
+    p = t.pretty()
+    assert "Add" in p and "Num(value=1)" in p
+    assert len(p.splitlines()) == 5
+
+
+def test_collect_exists():
+    t = Add(Num(1), Add(Num(2), Num(3)))
+    assert t.exists(lambda n: isinstance(n, Num) and n.value == 3)
+    assert not t.exists(lambda n: isinstance(n, Num) and n.value == 9)
+    assert sorted(n.value for n in t.collect_nodes(Num)) == [1, 2, 3]

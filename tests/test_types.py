@@ -1,0 +1,154 @@
+"""Type lattice laws — analog of the reference's scalacheck TypeLawsTest
+(``okapi-api/src/test/.../types/TypeLawsTest.scala``), here enumerated over a
+finite universe of representative types."""
+
+import itertools
+
+import pytest
+
+from tpu_cypher.api.types import (
+    CTAny,
+    CTBoolean,
+    CTFloat,
+    CTInteger,
+    CTList,
+    CTMap,
+    CTNode,
+    CTNull,
+    CTNumber,
+    CTRelationship,
+    CTString,
+    CTUnion,
+    CTVoid,
+    parse_type,
+    type_of_value,
+)
+
+UNIVERSE = [
+    CTAny,
+    CTVoid,
+    CTNull,
+    CTBoolean,
+    CTString,
+    CTInteger,
+    CTFloat,
+    CTNumber,
+    CTInteger.nullable,
+    CTString.nullable,
+    CTNode(),
+    CTNode("A"),
+    CTNode("A", "B"),
+    CTNode("B"),
+    CTRelationship(),
+    CTRelationship("R"),
+    CTRelationship("R", "S"),
+    CTList(CTInteger),
+    CTList(CTString.nullable),
+    CTList(CTAny),
+    CTMap({"a": CTInteger}),
+    CTMap(),
+    CTUnion.of(CTString, CTBoolean),
+]
+
+
+def test_subtype_reflexive():
+    for t in UNIVERSE:
+        assert t.subtype_of(t), t
+
+
+def test_subtype_transitive():
+    for a, b, c in itertools.product(UNIVERSE, repeat=3):
+        if a.subtype_of(b) and b.subtype_of(c):
+            assert a.subtype_of(c), (a, b, c)
+
+
+def test_join_is_upper_bound():
+    for a, b in itertools.product(UNIVERSE, repeat=2):
+        j = a.join(b)
+        assert a.subtype_of(j), (a, b, j)
+        assert b.subtype_of(j), (a, b, j)
+
+
+def test_join_commutative():
+    for a, b in itertools.product(UNIVERSE, repeat=2):
+        assert a.join(b) == b.join(a), (a, b)
+
+
+def test_meet_is_lower_bound():
+    for a, b in itertools.product(UNIVERSE, repeat=2):
+        m = a.meet(b)
+        assert m.subtype_of(a), (a, b, m)
+        assert m.subtype_of(b), (a, b, m)
+
+
+def test_void_bottom_any_top():
+    for t in UNIVERSE:
+        assert CTVoid.subtype_of(t)
+        assert t.material.subtype_of(CTAny)
+
+
+def test_null_and_nullability():
+    assert CTNull.subtype_of(CTInteger.nullable)
+    assert not CTNull.subtype_of(CTInteger)
+    assert CTInteger.subtype_of(CTInteger.nullable)
+    assert CTInteger.nullable.material == CTInteger
+    assert CTInteger.nullable.is_nullable
+    assert (CTInteger.nullable).nullable == CTInteger.nullable
+
+
+def test_node_label_subtyping():
+    # more labels = more specific
+    assert CTNode("A", "B").subtype_of(CTNode("A"))
+    assert CTNode("A").subtype_of(CTNode())
+    assert not CTNode("A").subtype_of(CTNode("B"))
+    assert CTNode("A").join(CTNode("B")) == CTNode()
+    assert CTNode("A").meet(CTNode("B")) == CTNode("A", "B")
+
+
+def test_relationship_type_subtyping():
+    # fewer alternatives = more specific
+    assert CTRelationship("R").subtype_of(CTRelationship("R", "S"))
+    assert CTRelationship("R").subtype_of(CTRelationship())
+    assert not CTRelationship("R", "S").subtype_of(CTRelationship("R"))
+    assert CTRelationship("R").join(CTRelationship("S")) == CTRelationship("R", "S")
+    assert CTRelationship("R", "S").meet(CTRelationship("S", "T")) == CTRelationship("S")
+    assert CTRelationship("R").meet(CTRelationship("S")) == CTVoid
+
+
+def test_number_union():
+    assert CTInteger.join(CTFloat) == CTNumber
+    assert CTUnion.of(CTInteger, CTFloat) == CTNumber
+
+
+def test_list_covariance():
+    assert CTList(CTInteger).subtype_of(CTList(CTNumber))
+    assert CTList(CTInteger).join(CTList(CTFloat)) == CTList(CTNumber)
+
+
+def test_union_simplification():
+    assert CTUnion.of(CTInteger) == CTInteger
+    assert CTUnion.of(CTInteger, CTInteger) == CTInteger
+    assert CTUnion.of(CTNode("A"), CTNode()) == CTNode()
+    u = CTUnion.of(CTString, CTBoolean)
+    assert CTString.subtype_of(u)
+    assert CTBoolean.subtype_of(u)
+
+
+def test_type_parsing_roundtrip():
+    for t in UNIVERSE:
+        assert parse_type(repr(t)) == t, repr(t)
+
+
+def test_type_of_value():
+    from tpu_cypher.api.values import Node, Relationship
+
+    assert type_of_value(None) == CTNull
+    assert type_of_value(True) == CTBoolean
+    assert type_of_value(42) == CTInteger
+    assert type_of_value(4.2) == CTFloat
+    assert type_of_value("x") == CTString
+    assert type_of_value([1, 2]) == CTList(CTInteger)
+    assert type_of_value([1, None]) == CTList(CTInteger.nullable)
+    assert type_of_value(Node(1, ["A"])) == CTNode("A")
+    assert type_of_value(Relationship(1, 2, 3, "R")) == CTRelationship("R")
+    assert type_of_value({"a": 1}) == CTMap({"a": CTInteger})

@@ -1,0 +1,86 @@
+import datetime as dt
+import math
+
+from tpu_cypher.api.values import (
+    CypherMap,
+    Duration,
+    Node,
+    Relationship,
+    cypher_equals,
+    cypher_equivalent,
+    order_key,
+    to_cypher_string,
+)
+
+
+def test_equals_ternary_null():
+    assert cypher_equals(None, 1) is None
+    assert cypher_equals(1, None) is None
+    assert cypher_equals(None, None) is None
+    assert cypher_equals(1, 1) is True
+    assert cypher_equals(1, 2) is False
+    assert cypher_equals([1, None], [2, None]) is False
+    assert cypher_equals([1, None], [1, None]) is None
+    assert cypher_equals([1, 2], [1, 2]) is True
+
+
+def test_equals_numeric_cross_type():
+    assert cypher_equals(1, 1.0) is True
+    assert cypher_equals(float("nan"), float("nan")) is False
+    assert cypher_equals(True, 1) is False  # boolean is not a number
+
+
+def test_equivalence():
+    assert cypher_equivalent(None, None)
+    assert not cypher_equivalent(None, 1)
+    assert cypher_equivalent(float("nan"), float("nan"))
+    assert cypher_equivalent(1, 1.0)
+    assert cypher_equivalent([1, None], [1, None])
+    assert not cypher_equivalent(True, 1)
+
+
+def test_cypher_map_bag_semantics():
+    a = CypherMap(x=1, y=None)
+    b = CypherMap(x=1.0, y=None)
+    c = CypherMap(x=2, y=None)
+    assert a == b
+    assert hash(a) == hash(b)
+    assert a != c
+    assert len({a, b, c}) == 2
+
+
+def test_element_identity():
+    n1 = Node(1, ["A"], {"p": 1})
+    n2 = Node(1, ["B"], {"p": 2})
+    assert n1 == n2  # id-based
+    r = Relationship(1, 10, 20, "KNOWS")
+    assert r.start == 10 and r.end == 20 and r.rel_type == "KNOWS"
+
+
+def test_duration():
+    d = Duration.of(years=1, days=2, hours=3)
+    assert d.months == 12
+    assert d.days == 2
+    assert d.seconds == 3 * 3600
+    assert d + Duration(days=1) == Duration(months=12, days=3, seconds=10800)
+    assert (d - d) == Duration()
+    assert Duration(seconds=61).cypher_str() == "PT1M1S"
+    assert Duration().cypher_str() == "PT0S"
+
+
+def test_ordering():
+    vals = [3, 1, None, 2.5]
+    s = sorted(vals, key=order_key)
+    assert s == [1, 2.5, 3, None]  # nulls last ascending
+    assert sorted(["b", "a"], key=order_key) == ["a", "b"]
+    # strings sort before numbers in Cypher global order
+    assert sorted([1, "z"], key=order_key) == ["z", 1]
+
+
+def test_to_cypher_string():
+    assert to_cypher_string(None) == "null"
+    assert to_cypher_string(True) == "true"
+    assert to_cypher_string(1.0) == "1.0"
+    assert to_cypher_string("a'b") == "'a\\'b'"
+    assert to_cypher_string([1, "x"]) == "[1, 'x']"
+    assert to_cypher_string(dt.date(2020, 1, 2)) == "'2020-01-02'"
