@@ -1,0 +1,276 @@
+"""Parser tests — analog of the reference's parse-layer tests
+(okapi-ir Neo4jAstTestSupport-driven suites)."""
+
+import pytest
+
+from tpu_cypher.frontend import ast as A
+from tpu_cypher.frontend.lexer import CypherSyntaxError, tokenize
+from tpu_cypher.frontend.parser import parse, parse_expr
+from tpu_cypher.ir import expr as E
+
+
+# -- lexer ------------------------------------------------------------------
+
+
+def test_tokenize_basics():
+    kinds = [t.kind for t in tokenize("MATCH (a)-[:R]->(b) RETURN a.x + 1.5 // c")]
+    assert kinds[-1] == "EOF"
+    toks = tokenize("'it\\'s' \"d\" `weird id` 0x10 1e3 .5")
+    assert toks[0].text == "it's"
+    assert toks[1].text == "d"
+    assert toks[2] == toks[2].__class__("ESC_IDENT", "weird id", toks[2].pos)
+    assert toks[3].text == "16"
+    assert (toks[4].kind, toks[5].kind) == ("FLOAT", "FLOAT")
+
+
+def test_tokenize_range_not_float():
+    toks = tokenize("[1..3]")
+    assert [t.text for t in toks[:-1]] == ["[", "1", "..", "3", "]"]
+
+
+def test_lexer_errors():
+    with pytest.raises(CypherSyntaxError):
+        tokenize("'unterminated")
+    with pytest.raises(CypherSyntaxError):
+        tokenize("RETURN ~")
+
+
+# -- expressions ------------------------------------------------------------
+
+
+def test_precedence():
+    e = parse_expr("1 + 2 * 3")
+    assert isinstance(e, E.Add)
+    assert isinstance(e.rhs, E.Multiply)
+    e = parse_expr("2 ^ 3 ^ 4")  # right assoc
+    assert isinstance(e, E.Pow)
+    assert isinstance(e.rhs, E.Pow)
+    e = parse_expr("a OR b AND c")
+    assert isinstance(e, E.Ors)
+    assert isinstance(e.exprs[1], E.Ands)
+    e = parse_expr("NOT a = b")
+    assert isinstance(e, E.Not)
+    assert isinstance(e.expr, E.Equals)
+
+
+def test_chained_comparison():
+    e = parse_expr("1 < x <= 10")
+    assert isinstance(e, E.Ands)
+    assert isinstance(e.exprs[0], E.LessThan)
+    assert isinstance(e.exprs[1], E.LessThanOrEqual)
+    # both comparisons share the middle operand
+    assert e.exprs[0].rhs == e.exprs[1].lhs == E.Var("x")
+
+
+def test_unary_minus_literal_folding():
+    assert parse_expr("-5") == E.Lit(-5)
+    assert parse_expr("- 5.5") == E.Lit(-5.5)
+    assert isinstance(parse_expr("-a"), E.Neg)
+
+
+def test_string_predicates():
+    assert isinstance(parse_expr("a STARTS WITH 'x'"), E.StartsWith)
+    assert isinstance(parse_expr("a ENDS WITH 'x'"), E.EndsWith)
+    assert isinstance(parse_expr("a CONTAINS 'x'"), E.Contains)
+    assert isinstance(parse_expr("a =~ 'x.*'"), E.RegexMatch)
+    assert isinstance(parse_expr("a IN [1,2]"), E.In)
+    assert isinstance(parse_expr("a.p IS NULL"), E.IsNull)
+    assert isinstance(parse_expr("a.p IS NOT NULL"), E.IsNotNull)
+
+
+def test_property_and_index():
+    e = parse_expr("a.b.c")
+    assert e == E.Property(E.Property(E.Var("a"), "b"), "c")
+    e = parse_expr("xs[0]")
+    assert e == E.Index(E.Var("xs"), E.Lit(0))
+    e = parse_expr("xs[1..3]")
+    assert e == E.ListSlice(E.Var("xs"), E.Lit(1), E.Lit(3))
+    e = parse_expr("xs[..2]")
+    assert e == E.ListSlice(E.Var("xs"), None, E.Lit(2))
+
+
+def test_label_predicate():
+    e = parse_expr("n:Person")
+    assert e == E.HasLabel(E.Var("n"), "Person")
+    e = parse_expr("n:Person:Employee")
+    assert e == E.Ands((E.HasLabel(E.Var("n"), "Person"), E.HasLabel(E.Var("n"), "Employee")))
+
+
+def test_literals():
+    assert parse_expr("[1, 'a', true, null]") == E.ListLit(
+        (E.Lit(1), E.Lit("a"), E.TRUE, E.NULL)
+    )
+    m = parse_expr("{a: 1, b: 'x'}")
+    assert m == E.MapLit(("a", "b"), (E.Lit(1), E.Lit("x")))
+    assert parse_expr("$param") == E.Param("param")
+
+
+def test_functions_and_aggregates():
+    e = parse_expr("toUpper(a.name)")
+    assert e == E.FunctionCall("toupper", (E.Property(E.Var("a"), "name"),))
+    e = parse_expr("count(*)")
+    assert isinstance(e, E.CountStar)
+    e = parse_expr("count(DISTINCT a)")
+    assert e == E.Agg("count", E.Var("a"), True, ())
+    e = parse_expr("percentileCont(n.x, 0.5)")
+    assert e == E.Agg("percentilecont", E.Property(E.Var("n"), "x"), False, (E.Lit(0.5),))
+
+
+def test_case():
+    e = parse_expr("CASE WHEN a > 1 THEN 'big' ELSE 'small' END")
+    assert isinstance(e, E.CaseExpr) and e.operand is None and e.default == E.Lit("small")
+    e = parse_expr("CASE a WHEN 1 THEN 'one' WHEN 2 THEN 'two' END")
+    assert e.operand == E.Var("a") and len(e.whens) == 2 and e.default is None
+
+
+def test_comprehensions_and_quantifiers():
+    e = parse_expr("[x IN [1,2,3] WHERE x > 1 | x * 2]")
+    assert isinstance(e, E.ListComprehension)
+    assert e.var == E.Var("x") and e.where is not None and e.projection is not None
+    e = parse_expr("any(x IN xs WHERE x = 1)")
+    assert isinstance(e, E.Quantified) and e.kind == "any"
+    e = parse_expr("reduce(acc = 0, x IN xs | acc + x)")
+    assert isinstance(e, E.Reduce)
+
+
+def test_pattern_predicate():
+    e = parse_expr("(a)-[:KNOWS]->(b)")
+    assert isinstance(e, E.ExistsPattern)
+    e = parse_expr("exists(a.prop)")
+    assert isinstance(e, E.IsNotNull)
+    e = parse_expr("exists((a)-->(b))")
+    assert isinstance(e, E.ExistsPattern)
+    # plain parenthesized expr still works
+    assert parse_expr("(1 + 2)") == E.Add(E.Lit(1), E.Lit(2))
+
+
+# -- patterns ---------------------------------------------------------------
+
+
+def q(text):
+    stmt = parse(text)
+    assert isinstance(stmt, A.SingleQuery)
+    return stmt.clauses
+
+
+def test_match_pattern():
+    (m, r) = q("MATCH (a:Person)-[k:KNOWS]->(b) RETURN a")
+    assert isinstance(m, A.Match) and not m.optional
+    part = m.pattern.parts[0]
+    n1, rel, n2 = part.elements
+    assert n1 == A.NodePattern("a", ("Person",))
+    assert rel.var == "k" and rel.types == ("KNOWS",) and rel.direction == A.OUTGOING
+    assert n2.var == "b"
+
+
+def test_pattern_directions():
+    (m, _) = q("MATCH (a)<-[:R]-(b), (b)-[:S]-(c) RETURN a")
+    p1, p2 = m.pattern.parts
+    assert p1.rels[0].direction == A.INCOMING
+    assert p2.rels[0].direction == A.BOTH
+
+
+def test_shorthand_rels():
+    (m, _) = q("MATCH (a)-->(b)<--(c)--(d) RETURN a")
+    rels = m.pattern.parts[0].rels
+    assert [r.direction for r in rels] == [A.OUTGOING, A.INCOMING, A.BOTH]
+
+
+def test_var_length():
+    (m, _) = q("MATCH (a)-[r:KNOWS*1..3]->(b) RETURN a")
+    rel = m.pattern.parts[0].rels[0]
+    assert rel.length == (1, 3)
+    (m, _) = q("MATCH (a)-[*2]->(b) RETURN a")
+    assert m.pattern.parts[0].rels[0].length == (2, 2)
+    (m, _) = q("MATCH (a)-[*]->(b) RETURN a")
+    assert m.pattern.parts[0].rels[0].length == (1, None)
+    (m, _) = q("MATCH (a)-[*..4]->(b) RETURN a")
+    assert m.pattern.parts[0].rels[0].length == (1, 4)
+
+
+def test_node_properties():
+    (m, _) = q("MATCH (a:Person {name: 'Alice', age: 23}) RETURN a")
+    node = m.pattern.parts[0].nodes[0]
+    assert node.properties == E.MapLit(("name", "age"), (E.Lit("Alice"), E.Lit(23)))
+
+
+def test_named_path():
+    (m, _) = q("MATCH p = (a)-[:R]->(b) RETURN p")
+    assert m.pattern.parts[0].path_var == "p"
+
+
+# -- clauses ----------------------------------------------------------------
+
+
+def test_full_query_shape():
+    clauses = q(
+        "MATCH (a:Person) WHERE a.age > 26 "
+        "WITH a.name AS name ORDER BY name DESC SKIP 1 LIMIT 2 WHERE name <> 'X' "
+        "RETURN DISTINCT name"
+    )
+    m, w, r = clauses
+    assert m.where is not None
+    assert isinstance(w, A.With)
+    assert w.items[0].alias == "name"
+    assert not w.order_by[0].ascending
+    assert w.skip == E.Lit(1) and w.limit == E.Lit(2) and w.where is not None
+    assert isinstance(r, A.Return) and r.distinct
+
+
+def test_optional_match_unwind():
+    clauses = q("MATCH (a) OPTIONAL MATCH (a)-[:R]->(b) UNWIND [1,2] AS x RETURN x")
+    assert not clauses[0].optional
+    assert clauses[1].optional
+    assert isinstance(clauses[2], A.Unwind) and clauses[2].var == "x"
+
+
+def test_return_star():
+    clauses = q("MATCH (a) RETURN *")
+    assert clauses[1].star
+
+
+def test_union():
+    stmt = parse("RETURN 1 AS x UNION RETURN 2 AS x")
+    assert isinstance(stmt, A.UnionQuery) and not stmt.all
+    stmt = parse("RETURN 1 AS x UNION ALL RETURN 2 AS x")
+    assert stmt.all
+
+
+def test_create_for_test_graphs():
+    clauses = q("CREATE (a:Person {name: 'A'})-[:KNOWS {since: 2020}]->(b:Person)")
+    assert isinstance(clauses[0], A.CreateClause)
+
+
+def test_multiple_graph_statements():
+    stmt = parse("CATALOG CREATE GRAPH ns.g { FROM GRAPH ns.a RETURN GRAPH }")
+    assert isinstance(stmt, A.CreateGraphStatement) and stmt.qgn == "ns.g"
+    inner = stmt.inner
+    assert isinstance(inner.clauses[0], A.FromGraph)
+    assert isinstance(inner.clauses[1], A.ReturnGraph)
+
+    stmt = parse("CATALOG DROP GRAPH ns.g")
+    assert isinstance(stmt, A.DropGraphStatement)
+
+
+def test_construct():
+    stmt = parse(
+        "FROM GRAPH a MATCH (x) CONSTRUCT ON b CLONE x AS y NEW (y)-[:R]->(:New) RETURN GRAPH"
+    )
+    clauses = stmt.clauses
+    con = clauses[2]
+    assert isinstance(con, A.ConstructClause)
+    assert con.on_graphs == ("b",)
+    assert con.clones[0].alias == "y"
+    assert len(con.news) == 1
+
+
+def test_syntax_errors():
+    for bad in [
+        "MATCH (a RETURN a",
+        "RETURN",
+        "MATCH (a) RETURN a a",
+        "MATCH (a)-[:]->(b) RETURN a",
+        "RETURN toUpper(DISTINCT x)",
+    ]:
+        with pytest.raises(CypherSyntaxError):
+            parse(bad)
