@@ -1,0 +1,229 @@
+"""IR builder + logical planner shape tests (analog of reference
+IrBuilderTest / LogicalPlannerTest / LogicalOptimizerTest)."""
+
+import pytest
+
+from tpu_cypher.api import types as T
+from tpu_cypher.api.schema import PropertyGraphSchema
+from tpu_cypher.frontend.parser import parse
+from tpu_cypher.ir import blocks as B
+from tpu_cypher.ir import expr as E
+from tpu_cypher.ir.builder import IRBuildError, IRBuilderContext, build_ir
+from tpu_cypher.logical import ops as L
+from tpu_cypher.logical.optimizer import optimize
+from tpu_cypher.logical.planner import plan_logical
+
+
+SCHEMA = (
+    PropertyGraphSchema.empty()
+    .with_node_combination(["Person"], {"name": T.CTString, "age": T.CTInteger})
+    .with_node_combination(["Book"], {"title": T.CTString})
+    .with_relationship_type("KNOWS", {"since": T.CTInteger})
+    .with_relationship_type("READS")
+)
+
+
+def ir_for(query, **params):
+    ctx = IRBuilderContext(SCHEMA, parameters=params)
+    return build_ir(parse(query), ctx)
+
+
+def plan_for(query, do_optimize=False, **params):
+    ir = ir_for(query, **params)
+    plan = plan_logical(ir)
+    if do_optimize:
+        plan = optimize(plan, SCHEMA)
+    return plan
+
+
+def ops_of(plan):
+    return [type(n).__name__ for n in plan.iter_nodes()]
+
+
+# -- IR construction --------------------------------------------------------
+
+
+def test_simple_match_ir():
+    ir = ir_for("MATCH (a:Person) WHERE a.age > 26 RETURN a.name")
+    match, proj, select, result = ir.blocks
+    assert isinstance(match, B.MatchBlock)
+    assert match.pattern.node_types == {"a": T.CTNode("Person")}
+    (pred,) = match.predicates
+    assert isinstance(pred, E.GreaterThan)
+    assert pred.lhs.typ == T.CTInteger  # schema-typed property
+    assert isinstance(proj, B.ProjectBlock)
+    assert proj.items[0][0] == "a.name"
+    assert ir.returns == ("a.name",)
+
+
+def test_property_map_becomes_predicate():
+    ir = ir_for("MATCH (a:Person {name: 'Alice'}) RETURN a")
+    match = ir.blocks[0]
+    (pred,) = match.predicates
+    assert isinstance(pred, E.Equals)
+    assert pred.lhs == E.Property(E.Var("a"), "name")
+    assert pred.rhs == E.Lit("Alice")
+
+
+def test_expand_ir_topology():
+    ir = ir_for("MATCH (a:Person)-[k:KNOWS]->(b:Person) RETURN a, b")
+    p = ir.blocks[0].pattern
+    assert set(p.rel_types) == {"k"}
+    conn = p.topology["k"]
+    assert (conn.source, conn.target, conn.direction) == ("a", "b", ">")
+
+
+def test_incoming_normalized_to_outgoing():
+    ir = ir_for("MATCH (a)<-[r:KNOWS]-(b) RETURN a")
+    conn = ir.blocks[0].pattern.topology["r"]
+    assert (conn.source, conn.target) == ("b", "a")
+    assert conn.direction == ">"
+
+
+def test_anonymous_entities_get_fresh_names():
+    ir = ir_for("MATCH (:Person)-[:KNOWS]->(b) RETURN b")
+    p = ir.blocks[0].pattern
+    assert len(p.node_types) == 2
+    assert len(p.rel_types) == 1
+    anon = [n for n in p.node_types if n.startswith("__")]
+    assert len(anon) == 1
+
+
+def test_aggregation_isolation():
+    ir = ir_for("MATCH (a:Person) RETURN a.age AS age, count(*) AS cnt")
+    agg = next(b for b in ir.blocks if isinstance(b, B.AggregationBlock))
+    assert [n for n, _ in agg.group] == ["age"]
+    assert [n for n, _ in agg.aggregations] == ["cnt"]
+
+
+def test_aggregation_expression_isolation():
+    ir = ir_for("MATCH (a:Person) RETURN count(*) + 1 AS x")
+    kinds = [type(b).__name__ for b in ir.blocks]
+    assert "AggregationBlock" in kinds
+    assert "ProjectBlock" in kinds  # post-projection computing agg+1
+
+
+def test_unknown_variable_rejected():
+    with pytest.raises(IRBuildError):
+        ir_for("MATCH (a) RETURN b")
+
+
+def test_unbounded_var_length_rejected():
+    with pytest.raises(IRBuildError):
+        ir_for("MATCH (a)-[:KNOWS*]->(b) RETURN a")
+
+
+def test_missing_return_rejected():
+    with pytest.raises(IRBuildError):
+        ir_for("MATCH (a)")
+
+
+def test_typing_through_with():
+    ir = ir_for("MATCH (a:Person) WITH a.age AS age RETURN age + 1 AS x")
+    proj = [b for b in ir.blocks if isinstance(b, B.ProjectBlock)]
+    x_expr = proj[-1].items[0][1]
+    assert x_expr.typ.material == T.CTInteger
+
+
+# -- logical planning -------------------------------------------------------
+
+
+def test_plan_node_scan():
+    plan = plan_for("MATCH (a:Person) RETURN a")
+    names = ops_of(plan)
+    assert names == ["NodeScan", "Start"]  # no-op Select elided
+
+
+def test_plan_expand():
+    plan = plan_for("MATCH (a:Person)-[k:KNOWS]->(b:Person) RETURN a, b")
+    names = ops_of(plan)
+    assert "Expand" in names
+    expand = plan.collect_nodes(L.Expand)[0]
+    assert (expand.source, expand.rel, expand.target) == ("a", "k", "b")
+
+
+def test_plan_two_hop_is_two_expands():
+    plan = plan_for("MATCH (a)-[:KNOWS]->(b)-[:KNOWS]->(c) RETURN a, c")
+    assert len(plan.collect_nodes(L.Expand)) == 2
+
+
+def test_plan_triangle_uses_expand_into():
+    plan = plan_for("MATCH (a)-->(b)-->(c)-->(a) RETURN a")
+    assert len(plan.collect_nodes(L.Expand)) == 2
+    assert len(plan.collect_nodes(L.ExpandInto)) == 1
+
+
+def test_plan_cartesian_for_disconnected():
+    plan = plan_for("MATCH (a:Person), (b:Book) RETURN a, b")
+    assert len(plan.collect_nodes(L.CartesianProduct)) == 1
+
+
+def test_plan_optional_match():
+    plan = plan_for("MATCH (a:Person) OPTIONAL MATCH (a)-[:KNOWS]->(b) RETURN a, b")
+    opt = plan.collect_nodes(L.Optional)
+    assert len(opt) == 1
+
+
+def test_plan_var_length():
+    plan = plan_for("MATCH (a)-[r:KNOWS*1..3]->(b) RETURN a, b")
+    (vle,) = plan.collect_nodes(L.BoundedVarLengthExpand)
+    assert (vle.lower, vle.upper) == (1, 3)
+    d = dict(vle.fields)
+    assert isinstance(d["r"], T.CTListType)
+
+
+def test_plan_exists_subquery():
+    plan = plan_for("MATCH (a:Person) WHERE (a)-[:KNOWS]->(:Person) RETURN a")
+    (ex,) = plan.collect_nodes(L.ExistsSubQuery)
+    # the filter now references the target flag var
+    filt = plan.collect_nodes(L.Filter)
+    assert any(
+        isinstance(f.predicate, E.Var) and f.predicate.name == ex.target_field
+        for f in filt
+    )
+
+
+def test_plan_order_skip_limit():
+    plan = plan_for("MATCH (a:Person) RETURN a.name ORDER BY a.name DESC SKIP 1 LIMIT 2")
+    names = ops_of(plan)
+    for op in ("Limit", "Skip", "OrderBy"):
+        assert op in names
+    # limit above skip above orderby
+    assert names.index("Limit") < names.index("Skip") < names.index("OrderBy")
+
+
+def test_plan_distinct():
+    plan = plan_for("MATCH (a:Person) RETURN DISTINCT a.name")
+    assert "Distinct" in ops_of(plan)
+
+
+def test_plan_union():
+    plan = plan_for("RETURN 1 AS x UNION ALL RETURN 2 AS x")
+    assert "TabularUnionAll" in ops_of(plan)
+    plan = plan_for("RETURN 1 AS x UNION RETURN 2 AS x")
+    names = ops_of(plan)
+    assert "TabularUnionAll" in names and "Distinct" in names
+
+
+def test_plan_unwind():
+    plan = plan_for("UNWIND [1,2,3] AS x RETURN x")
+    (uw,) = plan.collect_nodes(L.Unwind)
+    assert uw.fld == "x" and uw.fld_type == T.CTInteger
+
+
+# -- optimizer --------------------------------------------------------------
+
+
+def test_discard_scan_for_unknown_label():
+    plan = plan_for("MATCH (a:Nonexistent) RETURN a", do_optimize=True)
+    assert "EmptyRecords" in ops_of(plan)
+
+
+def test_cartesian_to_value_join():
+    plan = plan_for(
+        "MATCH (a:Person), (b:Person) WHERE a.name = b.name RETURN a, b",
+        do_optimize=True,
+    )
+    names = ops_of(plan)
+    assert "ValueJoin" in names
+    assert "CartesianProduct" not in names

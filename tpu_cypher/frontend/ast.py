@@ -16,16 +16,8 @@ from dataclasses import dataclass
 from typing import Any, Optional, Tuple
 
 from ..ir.expr import Expr, MapLit, Var
+from ..ir.pattern import BOTH, INCOMING, OUTGOING  # single source of truth
 from ..trees import TreeNode
-
-
-# ---------------------------------------------------------------------------
-# Patterns
-# ---------------------------------------------------------------------------
-
-OUTGOING = ">"
-INCOMING = "<"
-BOTH = "-"
 
 
 @dataclass(frozen=True)

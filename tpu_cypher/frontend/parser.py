@@ -736,8 +736,14 @@ class Parser:
                     proj = self.parse_expression()
                 self.eat_sym(")")
                 return E.ListComprehension(var, lst, None, proj)
-            # function call?
+            # function call? (incl. qualified names like duration.between)
             if self.at_sym("(", ahead=1):
+                return self.parse_function_call()
+            if (
+                self.at_sym(".", ahead=1)
+                and self.peek(2).kind == "IDENT"
+                and self.at_sym("(", ahead=3)
+            ):
                 return self.parse_function_call()
             # map projection: var{...}
             if self.at_sym("{", ahead=1):
@@ -750,6 +756,9 @@ class Parser:
 
     def parse_function_call(self) -> E.Expr:
         fname = self.name()
+        while self.at_sym(".") and self.peek(1).kind == "IDENT":
+            self.next()
+            fname += "." + self.name()
         lowered = fname.lower()
         self.eat_sym("(")
         distinct = self.try_kw("DISTINCT")

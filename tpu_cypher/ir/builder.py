@@ -1,0 +1,711 @@
+"""IR builder: frontend AST -> typed block pipeline.
+
+Re-design of the reference's eff-monad IR builder
+(``okapi-ir/.../impl/IRBuilder.scala:51``, clause match at ``:71-690``) plus its
+``ExpressionConverter``/``PatternConverter`` and incremental typer
+(``impl/typer/TypeTracker.scala``): a single pass that
+
+* converts patterns to :class:`~tpu_cypher.ir.pattern.IRPattern` (fresh names
+  for anonymous entities, property maps lowered to equality predicates —
+  matching the reference's pattern conversion),
+* converts + types expressions against the scope environment and graph schema
+  (label info refines ``CTNode`` types; property lookups consult the schema),
+* performs aggregation isolation (reference ``isolateAggregation`` rewriter):
+  projection items containing aggregators are split into an AggregationBlock
+  over extracted aggregates plus a post-projection,
+* tracks the WITH/RETURN horizon discipline via Select blocks,
+* handles multiple-graph clauses (FROM GRAPH switching the schema context,
+  CONSTRUCT, RETURN GRAPH) and CATALOG statements.
+"""
+
+from __future__ import annotations
+
+import itertools
+from dataclasses import dataclass, field as dc_field
+from typing import Any, Dict, List, Optional, Tuple
+
+from ..api import types as T
+from ..api.schema import PropertyGraphSchema
+from ..api.types import CypherType
+from ..frontend import ast as A
+from ..frontend.lexer import CypherSyntaxError
+from . import blocks as B
+from . import expr as E
+from .functions import CypherTypeError, lookup as lookup_function
+from .pattern import BOTH, INCOMING, OUTGOING, Connection, IRPattern
+
+
+class IRBuildError(Exception):
+    pass
+
+
+@dataclass
+class IRBuilderContext:
+    schema: PropertyGraphSchema
+    parameters: Dict[str, Any] = dc_field(default_factory=dict)
+    catalog_schemas: Dict[str, PropertyGraphSchema] = dc_field(default_factory=dict)
+    working_graph: str = "session.ambient"
+    # driving-table input fields (session.cypher(query, drivingTable))
+    input_fields: Dict[str, CypherType] = dc_field(default_factory=dict)
+
+
+class IRBuilder:
+    def __init__(self, ctx: IRBuilderContext):
+        self.ctx = ctx
+        self.schema = ctx.schema
+        self._fresh = itertools.count()
+
+    # ------------------------------------------------------------------
+    def fresh_name(self, prefix: str = "a") -> str:
+        return f"__{prefix}{next(self._fresh)}"
+
+    def build(self, stmt: A.Statement):
+        if isinstance(stmt, A.SingleQuery):
+            return self._build_single(stmt)
+        if isinstance(stmt, A.UnionQuery):
+            irs = [self._build_single(q) for q in stmt.queries]
+            cols = irs[0].returns
+            for ir in irs[1:]:
+                if ir.returns != cols:
+                    raise IRBuildError(
+                        f"UNION requires same return columns: {cols} vs {ir.returns}"
+                    )
+            return B.UnionIR(tuple(irs), all=stmt.all, returns=cols)
+        if isinstance(stmt, A.CreateGraphStatement):
+            inner = IRBuilder(self.ctx).build(stmt.inner)
+            return B.CreateGraphIR(stmt.qgn, inner)
+        if isinstance(stmt, A.CreateViewStatement):
+            return B.CreateViewIR(stmt.name, stmt.params, stmt.inner_text)
+        if isinstance(stmt, A.DropGraphStatement):
+            return B.DropGraphIR(stmt.qgn, stmt.view)
+        raise IRBuildError(f"Unsupported statement {type(stmt).__name__}")
+
+    # ------------------------------------------------------------------
+
+    def _build_single(self, q: A.SingleQuery) -> B.QueryIR:
+        env: Dict[str, CypherType] = dict(self.ctx.input_fields)
+        blocks: List[B.Block] = []
+        returns: Optional[Tuple[str, ...]] = None
+        clauses = list(q.clauses)
+        i = 0
+        saw_return = False
+        while i < len(clauses):
+            c = clauses[i]
+            if isinstance(c, A.Match):
+                blocks.extend(self._convert_match(c, env))
+            elif isinstance(c, A.Unwind):
+                lst = self.convert_expr(c.expr, env)
+                inner = self._list_inner_type(lst.cypher_type)
+                blocks.append(B.UnwindBlock(lst, c.var))
+                env[c.var] = inner
+            elif isinstance(c, (A.With, A.Return)) and not isinstance(c, A.ReturnGraph):
+                is_return = isinstance(c, A.Return)
+                new_env, seg = self._convert_projection(c, env)
+                blocks.extend(seg)
+                env = new_env
+                if is_return:
+                    returns = tuple(env.keys())
+                    blocks.append(B.ResultBlock(returns))
+                    saw_return = True
+            elif isinstance(c, A.FromGraph):
+                qgn = self._resolve_qgn(c.graph_name)
+                if qgn not in self.ctx.catalog_schemas:
+                    raise IRBuildError(f"Unknown graph {qgn!r}")
+                self.schema = self.ctx.catalog_schemas[qgn]
+                blocks.append(B.FromGraphBlock(qgn))
+            elif isinstance(c, A.ConstructClause):
+                blocks.append(self._convert_construct(c, env))
+            elif isinstance(c, A.ReturnGraph):
+                blocks.append(B.GraphResultBlock())
+                saw_return = True
+            elif isinstance(c, A.CreateClause):
+                raise IRBuildError(
+                    "CREATE is only supported in test-graph construction "
+                    "(use testing.create_graph) or CONSTRUCT NEW"
+                )
+            else:
+                raise IRBuildError(f"Unsupported clause {type(c).__name__}")
+            i += 1
+        if not saw_return:
+            raise IRBuildError("Query must end in RETURN")
+        return B.QueryIR(tuple(blocks), returns, self.ctx.working_graph)
+
+    def _resolve_qgn(self, name: str) -> str:
+        if "." in name:
+            return name
+        return f"session.{name}"
+
+    @staticmethod
+    def _list_inner_type(t: CypherType) -> CypherType:
+        m = t.material
+        if isinstance(m, T.CTListType):
+            return m.inner
+        return T.CTAny.nullable
+
+    # ------------------------------------------------------------------
+    # MATCH
+    # ------------------------------------------------------------------
+
+    def _convert_match(self, c: A.Match, env: Dict[str, CypherType]) -> List[B.Block]:
+        pattern, predicates = self.convert_pattern(c.pattern, env)
+        # register new entities into env
+        for n, t in pattern.node_types.items():
+            env[n] = t
+        for r, t in pattern.rel_types.items():
+            conn = pattern.topology.get(r)
+            if conn is not None and conn.is_var_length:
+                env[r] = T.CTListType(t)
+            else:
+                env[r] = t
+        preds = list(predicates)
+        if c.where is not None:
+            w = self.convert_expr(c.where, env)
+            preds.extend(w.exprs if isinstance(w, E.Ands) else [w])
+        # assign target fields to exists-pattern predicates
+        preds = [self._assign_exists_targets(p, env) for p in preds]
+        return [B.MatchBlock(pattern, tuple(preds), c.optional)]
+
+    def _assign_exists_targets(self, p: E.Expr, env) -> E.Expr:
+        def rule(n):
+            if isinstance(n, E.ExistsPattern) and n.target_field is None:
+                sub_pattern, sub_preds = self.convert_pattern(n.pattern, dict(env))
+                target = self.fresh_name("exists")
+                clone = E.ExistsPattern(n.pattern, target)
+                object.__setattr__(clone, "_ir_pattern", sub_pattern)
+                object.__setattr__(clone, "_ir_predicates", tuple(sub_preds))
+                object.__setattr__(clone, "_typ", T.CTBoolean)
+                return clone
+            return n
+
+        return p.rewrite_top_down(rule)
+
+    # ------------------------------------------------------------------
+    # Pattern conversion
+    # ------------------------------------------------------------------
+
+    def convert_pattern(
+        self, pattern: A.Pattern, env: Dict[str, CypherType]
+    ) -> Tuple[IRPattern, List[E.Expr]]:
+        """Frontend pattern -> IRPattern + lowered property predicates."""
+        ir = IRPattern()
+        predicates: List[E.Expr] = []
+
+        def node_field(np: A.NodePattern) -> str:
+            name = np.var or self.fresh_name("n")
+            prev = env.get(name) or ir.node_types.get(name)
+            if prev is not None:
+                base = prev.material
+                if not isinstance(base, T.CTNodeType):
+                    raise IRBuildError(
+                        f"Variable {name!r} already bound to {base!r}, cannot re-bind as node"
+                    )
+                labels = base.labels | frozenset(np.labels)
+            else:
+                labels = frozenset(np.labels)
+                # label implication from schema
+            t = T.CTNodeType(labels)
+            ir.node_types[name] = t
+            if np.labels and prev is not None:
+                # extra label constraints on a bound var become predicates
+                for l in np.labels:
+                    predicates.append(
+                        E.HasLabel(E.Var(name).with_type(t), l).with_type(T.CTBoolean)
+                    )
+            if np.properties is not None:
+                var = E.Var(name).with_type(t)
+                for k, v in zip(np.properties.keys, np.properties.values):
+                    lhs = self._type_property(E.Property(var, k), t)
+                    rhs = self.convert_expr(v, env)
+                    predicates.append(
+                        E.Equals(lhs, rhs).with_type(T.CTBoolean.nullable)
+                    )
+            if np.base_var:
+                ir.base_entities[name] = np.base_var
+            return name
+
+        for part in pattern.parts:
+            elems = part.elements
+            prev_node = node_field(elems[0])
+            path_fields: List[str] = [prev_node]
+            for j in range(1, len(elems), 2):
+                rp: A.RelPattern = elems[j]
+                nxt = node_field(elems[j + 1])
+                rname = rp.var or self.fresh_name("r")
+                if rname in env or rname in ir.rel_types or rname in ir.node_types:
+                    # openCypher: a relationship variable cannot be re-bound
+                    raise IRBuildError(
+                        f"Relationship variable {rname!r} bound more than once"
+                    )
+                rt = T.CTRelationshipType(rp.types)
+                ir.rel_types[rname] = rt
+                if rp.direction == INCOMING:
+                    src, dst, direction = nxt, prev_node, OUTGOING
+                elif rp.direction == OUTGOING:
+                    src, dst, direction = prev_node, nxt, OUTGOING
+                else:
+                    src, dst, direction = prev_node, nxt, BOTH
+                if rp.length is None:
+                    lo, hi = 1, 1
+                else:
+                    lo, hi = rp.length
+                    if hi is None:
+                        raise IRBuildError(
+                            "Unbounded variable-length patterns are not supported; "
+                            "specify an upper bound (e.g. *1..10)"
+                        )
+                ir.topology[rname] = Connection(src, dst, direction, lo, hi)
+                if rp.properties is not None:
+                    var = E.Var(rname).with_type(rt)
+                    for k, v in zip(rp.properties.keys, rp.properties.values):
+                        lhs = self._type_property(E.Property(var, k), rt)
+                        rhs = self.convert_expr(v, env)
+                        predicates.append(
+                            E.Equals(lhs, rhs).with_type(T.CTBoolean.nullable)
+                        )
+                if rp.base_var:
+                    ir.base_entities[rname] = rp.base_var
+                path_fields.append(rname)
+                path_fields.append(nxt)
+                prev_node = nxt
+            if part.path_var:
+                ir.paths[part.path_var] = tuple(path_fields)
+        return ir, predicates
+
+    # ------------------------------------------------------------------
+    # WITH / RETURN
+    # ------------------------------------------------------------------
+
+    def _convert_projection(
+        self, c: A.ProjectionClause, env: Dict[str, CypherType]
+    ) -> Tuple[Dict[str, CypherType], List[B.Block]]:
+        blocks: List[B.Block] = []
+        items: List[Tuple[str, E.Expr]] = []
+        seen: set = set()
+        if c.star:
+            for name, t in env.items():
+                if name.startswith("__"):
+                    continue
+                items.append((name, E.Var(name).with_type(t)))
+                seen.add(name)
+        for it in c.items:
+            converted = self.convert_expr(it.expr, env)
+            name = it.alias or it.name
+            if name in seen:
+                raise IRBuildError(f"Duplicate return column {name!r}")
+            seen.add(name)
+            items.append((name, converted))
+
+        has_agg = any(E.has_aggregation(e) for _, e in items)
+        if has_agg:
+            blocks.extend(self._aggregation_blocks(items, env))
+        else:
+            blocks.append(B.ProjectBlock(tuple(items), distinct=False))
+        # environment after projection (pre-narrowing): old fields + new
+        wide_env = dict(env)
+        new_env: Dict[str, CypherType] = {}
+        for name, e in items:
+            t = e.cypher_type
+            if E.has_aggregation(e):
+                t = self._agg_result_type(e)
+            wide_env[name] = t
+            new_env[name] = t
+        if has_agg:
+            # aggregation narrows the horizon immediately
+            wide_env = dict(new_env)
+
+        # with DISTINCT the horizon narrows first: WHERE/ORDER BY may only
+        # reference the projected items (Neo4j's scoping rule); otherwise the
+        # wide pre-narrowing scope is visible
+        rest_env = new_env if c.distinct else wide_env
+        where_pred = None
+        if c.where is not None:
+            where_pred = self.convert_expr(c.where, rest_env)
+
+        sort_items = []
+        for s in c.order_by:
+            sort_items.append(A.SortItem(self.convert_expr(s.expr, rest_env), s.ascending))
+        skip = self.convert_expr(c.skip, rest_env) if c.skip is not None else None
+        limit = self.convert_expr(c.limit, rest_env) if c.limit is not None else None
+
+        if c.distinct:
+            blocks.append(B.SelectBlock(tuple(new_env.keys())))
+            blocks.append(B.DistinctBlock(tuple(new_env.keys())))
+            if where_pred is not None:
+                blocks.append(B.FilterBlock(where_pred))
+            if sort_items or skip is not None or limit is not None:
+                blocks.append(B.OrderAndSliceBlock(tuple(sort_items), skip, limit))
+        else:
+            if where_pred is not None:
+                blocks.append(B.FilterBlock(where_pred))
+            if sort_items or skip is not None or limit is not None:
+                blocks.append(B.OrderAndSliceBlock(tuple(sort_items), skip, limit))
+            blocks.append(B.SelectBlock(tuple(new_env.keys())))
+        return new_env, blocks
+
+    def _aggregation_blocks(
+        self, items: List[Tuple[str, E.Expr]], env: Dict[str, CypherType]
+    ) -> List[B.Block]:
+        """Aggregation isolation (reference ``isolateAggregation`` rewriter)."""
+        group: List[Tuple[str, E.Expr]] = []
+        aggs: List[Tuple[str, E.Agg]] = []
+        post: List[Tuple[str, E.Expr]] = []
+        needs_post = False
+
+        for name, e in items:
+            if not E.has_aggregation(e):
+                group.append((name, e))
+                post.append((name, E.Var(name).with_type(e.cypher_type)))
+                continue
+            if isinstance(e, (E.Agg, E.CountStar)):
+                agg = self._normalize_agg(e)
+                aggs.append((name, agg))
+                post.append((name, E.Var(name).with_type(self._agg_result_type(e))))
+            else:
+                # expression over aggregates: extract each Agg into a fresh field
+                mapping: Dict[E.Expr, E.Expr] = {}
+                for node in e.iter_nodes():
+                    if isinstance(node, (E.Agg, E.CountStar)) and node not in mapping:
+                        f = self.fresh_name("agg")
+                        aggs.append((f, self._normalize_agg(node)))
+                        mapping[node] = E.Var(f).with_type(self._agg_result_type(node))
+                rewritten = E.substitute(e, mapping)
+                rewritten = self._retype(rewritten, {**env, **{m.name: m.cypher_type for m in mapping.values()}})
+                post.append((name, rewritten))
+                needs_post = True
+
+        blocks: List[B.Block] = [B.AggregationBlock(tuple(group), tuple(aggs))]
+        if needs_post:
+            blocks.append(B.ProjectBlock(tuple(post), distinct=False))
+            blocks.append(B.SelectBlock(tuple(n for n, _ in post)))
+        return blocks
+
+    @staticmethod
+    def _normalize_agg(e: E.Expr) -> E.Agg:
+        if isinstance(e, E.CountStar):
+            return E.Agg("count", None, False)
+        assert isinstance(e, E.Agg)
+        return e
+
+    @staticmethod
+    def _agg_result_type(e: E.Expr) -> CypherType:
+        if isinstance(e, E.CountStar):
+            return T.CTInteger
+        if isinstance(e, E.Agg):
+            name = e.name
+            at = e.expr.cypher_type.material if e.expr is not None else T.CTAny
+            if name == "count":
+                return T.CTInteger
+            if name == "collect":
+                return T.CTListType(at)
+            if name in ("min", "max"):
+                return at.nullable
+            if name == "sum":
+                return at if at in (T.CTInteger, T.CTFloat) else T.CTNumber
+            if name == "avg":
+                return T.CTDuration if at == T.CTDuration else T.CTFloat
+            if name in ("stdev", "stdevp"):
+                return T.CTFloat
+            if name in ("percentilecont",):
+                return T.CTFloat.nullable
+            if name == "percentiledisc":
+                return at.nullable
+        # expression over aggregations
+        return e.cypher_type
+
+    # ------------------------------------------------------------------
+    # CONSTRUCT
+    # ------------------------------------------------------------------
+
+    def _convert_construct(self, c: A.ConstructClause, env) -> B.ConstructBlock:
+        clones: List[Tuple[str, str]] = []
+        for item in c.clones:
+            if not isinstance(item.expr, E.Var):
+                raise IRBuildError("CLONE items must be variables")
+            src = item.expr.name
+            if src not in env:
+                raise IRBuildError(f"CLONE of unbound variable {src!r}")
+            clones.append((item.alias or src, src))
+        clone_env = dict(env)
+        for new, src in clones:
+            clone_env[new] = env[src]
+        new_pattern = IRPattern()
+        new_props: List[Tuple[str, str, E.Expr]] = []
+        for pat in c.news:
+            ir, preds = self.convert_pattern(pat, clone_env)
+            for n, t in ir.node_types.items():
+                if n in clone_env:
+                    continue  # references an existing/cloned entity
+                new_pattern.node_types[n] = t
+            for r, t in ir.rel_types.items():
+                new_pattern.rel_types[r] = t
+            new_pattern.topology.update(ir.topology)
+            new_pattern.base_entities.update(ir.base_entities)
+            # property map predicates become property settings
+            for p in preds:
+                if isinstance(p, E.Equals) and isinstance(p.lhs, E.Property):
+                    owner = p.lhs.expr
+                    assert isinstance(owner, E.Var)
+                    new_props.append((owner.name, p.lhs.key, p.rhs))
+        sets: List[Tuple[str, str, E.Expr]] = []
+        set_labels: List[Tuple[str, Tuple[str, ...]]] = []
+        for s in c.sets:
+            if s.labels:
+                assert isinstance(s.target, E.Var)
+                set_labels.append((s.target.name, s.labels))
+            elif isinstance(s.target, E.Property):
+                owner = s.target.expr
+                assert isinstance(owner, E.Var)
+                sets.append(
+                    (owner.name, s.target.key, self.convert_expr(s.value, clone_env))
+                )
+            else:
+                raise IRBuildError("Unsupported SET item in CONSTRUCT")
+        on_graphs = tuple(self._resolve_qgn(g) for g in c.on_graphs)
+        return B.ConstructBlock(
+            on_graphs, tuple(clones), new_pattern, tuple(new_props), tuple(sets), tuple(set_labels)
+        )
+
+    # ------------------------------------------------------------------
+    # Expressions + typing
+    # ------------------------------------------------------------------
+
+    def convert_expr(self, e: E.Expr, env: Dict[str, CypherType]) -> E.Expr:
+        return self._retype(e, env)
+
+    def _retype(self, e: E.Expr, env: Dict[str, CypherType]) -> E.Expr:
+        conv = self._retype  # shorthand
+
+        if isinstance(e, E.Var):
+            if e.name not in env:
+                raise IRBuildError(f"Variable {e.name!r} not defined")
+            return e.with_type(env[e.name])
+        if isinstance(e, E.Param):
+            val = self.ctx.parameters.get(e.name)
+            t = T.type_of_value(val) if val is not None else T.CTAny.nullable
+            return e.with_type(t)
+        if isinstance(e, E.Lit):
+            return e.with_type(T.type_of_value(e.value))
+        if isinstance(e, E.ListLit):
+            items = tuple(conv(i, env) for i in e.items)
+            inner = T.join_types(i.cypher_type for i in items)
+            return E.ListLit(items).with_type(T.CTListType(inner))
+        if isinstance(e, E.MapLit):
+            vals = tuple(conv(v, env) for v in e.values)
+            return E.MapLit(e.keys, vals).with_type(
+                T.CTMapType({k: v.cypher_type for k, v in zip(e.keys, vals)})
+            )
+        if isinstance(e, E.Property):
+            owner = conv(e.expr, env)
+            return self._type_property(E.Property(owner, e.key), owner.cypher_type)
+        if isinstance(e, E.HasLabel):
+            return E.HasLabel(conv(e.expr, env), e.label).with_type(T.CTBoolean)
+        if isinstance(e, E.HasType):
+            return E.HasType(conv(e.expr, env), e.rel_type).with_type(T.CTBoolean)
+        if isinstance(e, (E.Id, E.StartNode, E.EndNode)):
+            inner = conv(e.expr, env)
+            t = T.CTInteger if isinstance(e, E.Id) else T.CTNodeType(())
+            return type(e)(inner).with_type(t)
+        if isinstance(e, E.Ands):
+            return E.Ands(tuple(conv(x, env) for x in e.exprs)).with_type(
+                T.CTBoolean.nullable
+            )
+        if isinstance(e, E.Ors):
+            return E.Ors(tuple(conv(x, env) for x in e.exprs)).with_type(
+                T.CTBoolean.nullable
+            )
+        if isinstance(e, (E.Xor,)):
+            return E.Xor(conv(e.lhs, env), conv(e.rhs, env)).with_type(
+                T.CTBoolean.nullable
+            )
+        if isinstance(e, E.Not):
+            return E.Not(conv(e.expr, env)).with_type(T.CTBoolean.nullable)
+        if isinstance(e, (E.IsNull, E.IsNotNull)):
+            return type(e)(conv(e.expr, env)).with_type(T.CTBoolean)
+        if isinstance(e, E.BinaryPredicate):
+            lhs, rhs = conv(e.lhs, env), conv(e.rhs, env)
+            return type(e)(lhs, rhs).with_type(T.CTBoolean.nullable)
+        if isinstance(e, E.Neg):
+            inner = conv(e.expr, env)
+            return E.Neg(inner).with_type(inner.cypher_type)
+        if isinstance(e, E.ArithmeticExpr):
+            lhs, rhs = conv(e.lhs, env), conv(e.rhs, env)
+            return type(e)(lhs, rhs).with_type(self._arith_type(type(e), lhs, rhs))
+        if isinstance(e, E.FunctionCall):
+            return self._type_function(e, env)
+        if isinstance(e, E.Agg):
+            inner = conv(e.expr, env) if e.expr is not None else None
+            extra = tuple(conv(x, env) for x in e.extra)
+            out = E.Agg(e.name, inner, e.distinct, extra)
+            return out.with_type(self._agg_result_type(out))
+        if isinstance(e, E.CountStar):
+            return e.with_type(T.CTInteger)
+        if isinstance(e, E.CaseExpr):
+            operand = conv(e.operand, env) if e.operand is not None else None
+            whens = tuple(conv(w, env) for w in e.whens)
+            thens = tuple(conv(t, env) for t in e.thens)
+            default = conv(e.default, env) if e.default is not None else None
+            result = T.join_types(t.cypher_type for t in thens)
+            if default is not None:
+                result = result.join(default.cypher_type)
+            else:
+                result = result.nullable
+            return E.CaseExpr(operand, whens, thens, default).with_type(result)
+        if isinstance(e, E.Index):
+            owner = conv(e.expr, env)
+            idx = conv(e.index, env)
+            m = owner.cypher_type.material
+            if isinstance(m, T.CTListType):
+                t = m.inner.nullable
+            elif isinstance(m, T.CTMapType) and m.fields is not None:
+                t = T.join_types(dict(m.fields).values()).nullable
+            else:
+                t = T.CTAny.nullable
+            return E.Index(owner, idx).with_type(t)
+        if isinstance(e, E.ListSlice):
+            owner = conv(e.expr, env)
+            return E.ListSlice(
+                owner,
+                conv(e.from_, env) if e.from_ is not None else None,
+                conv(e.to, env) if e.to is not None else None,
+            ).with_type(owner.cypher_type.material.nullable if isinstance(owner.cypher_type.material, T.CTListType) else T.CTListType(T.CTAny).nullable)
+        if isinstance(e, E.ListComprehension):
+            lst = conv(e.list_expr, env)
+            inner_t = self._list_inner_type(lst.cypher_type)
+            env2 = {**env, e.var.name: inner_t}
+            where = conv(e.where, env2) if e.where is not None else None
+            proj = conv(e.projection, env2) if e.projection is not None else None
+            out_t = proj.cypher_type if proj is not None else inner_t
+            return E.ListComprehension(
+                e.var.with_type(inner_t), lst, where, proj
+            ).with_type(T.CTListType(out_t))
+        if isinstance(e, E.Quantified):
+            lst = conv(e.list_expr, env)
+            inner_t = self._list_inner_type(lst.cypher_type)
+            env2 = {**env, e.var.name: inner_t}
+            return E.Quantified(
+                e.kind, e.var.with_type(inner_t), lst, conv(e.predicate, env2)
+            ).with_type(T.CTBoolean.nullable)
+        if isinstance(e, E.Reduce):
+            lst = conv(e.list_expr, env)
+            inner_t = self._list_inner_type(lst.cypher_type)
+            init = conv(e.init, env)
+            env2 = {**env, e.var.name: inner_t, e.acc.name: init.cypher_type}
+            body = conv(e.expr, env2)
+            # widen accumulator
+            env2[e.acc.name] = init.cypher_type.join(body.cypher_type)
+            body = conv(e.expr, env2)
+            return E.Reduce(
+                e.acc.with_type(env2[e.acc.name]),
+                init,
+                e.var.with_type(inner_t),
+                lst,
+                body,
+            ).with_type(body.cypher_type)
+        if isinstance(e, E.MapProjection):
+            var = conv(e.var, env)
+            items = tuple(
+                (k, conv(v, env) if v is not None else None) for k, v in e.items
+            )
+            return E.MapProjection(var, items, e.all_props).with_type(T.CTMapType(None))
+        if isinstance(e, E.ExistsPattern):
+            return self._assign_exists_targets(e, env)
+        raise IRBuildError(f"Cannot convert expression {type(e).__name__}")
+
+    def _type_property(self, p: E.Property, owner_t: CypherType) -> E.Expr:
+        m = owner_t.material
+        key = p.key
+        if isinstance(m, T.CTNodeType):
+            keys = self.schema.node_property_keys_for_labels(m.labels)
+            t = keys.get(key, T.CTNull)
+        elif isinstance(m, T.CTRelationshipType):
+            keys = self.schema.relationship_property_keys_for_types(m.types)
+            t = keys.get(key, T.CTNull)
+        elif isinstance(m, T.CTMapType):
+            if m.fields is None:
+                t = T.CTAny.nullable
+            else:
+                t = dict(m.fields).get(key, T.CTNull)
+        elif isinstance(m, (T.CTDateType, T.CTLocalDateTimeType)):
+            t = T.CTInteger
+        elif isinstance(m, T.CTDurationType):
+            t = T.CTInteger
+        elif isinstance(m, T.CTListType):
+            # var-length rel list: properties distribute over elements
+            t = T.CTListType(T.CTAny.nullable)
+        else:
+            t = T.CTAny.nullable
+        if owner_t.is_nullable and not t.is_nullable and t != T.CTNull:
+            t = t.nullable
+        return p.with_type(t)
+
+    @staticmethod
+    def _arith_type(op, lhs: E.Expr, rhs: E.Expr) -> CypherType:
+        lt, rt = lhs.cypher_type.material, rhs.cypher_type.material
+        nullable = lhs.cypher_type.is_nullable or rhs.cypher_type.is_nullable
+        out: CypherType
+        if op is E.Add:
+            if lt == T.CTString or rt == T.CTString:
+                out = T.CTString
+            elif isinstance(lt, T.CTListType) or isinstance(rt, T.CTListType):
+                li = lt.inner if isinstance(lt, T.CTListType) else lt
+                ri = rt.inner if isinstance(rt, T.CTListType) else rt
+                out = T.CTListType(li.join(ri))
+            elif lt == T.CTDuration and rt in (T.CTDate, T.CTLocalDateTime):
+                out = rt
+            elif rt == T.CTDuration and lt in (T.CTDate, T.CTLocalDateTime, T.CTDuration):
+                out = lt
+            else:
+                out = IRBuilder._numeric_join(lt, rt)
+        elif op is E.Subtract:
+            if rt == T.CTDuration and lt in (T.CTDate, T.CTLocalDateTime, T.CTDuration):
+                out = lt
+            else:
+                out = IRBuilder._numeric_join(lt, rt)
+        elif op is E.Divide:
+            if lt == T.CTInteger and rt == T.CTInteger:
+                out = T.CTInteger
+            else:
+                out = IRBuilder._numeric_join(lt, rt)
+        elif op is E.Pow:
+            out = T.CTFloat
+        else:
+            out = IRBuilder._numeric_join(lt, rt)
+        return out.nullable if nullable else out
+
+    @staticmethod
+    def _numeric_join(lt: CypherType, rt: CypherType) -> CypherType:
+        if lt == T.CTFloat or rt == T.CTFloat:
+            return T.CTFloat
+        if lt == T.CTInteger and rt == T.CTInteger:
+            return T.CTInteger
+        if isinstance(lt, T.CTBigDecimalType) or isinstance(rt, T.CTBigDecimalType):
+            if isinstance(lt, T.CTBigDecimalType) and isinstance(rt, T.CTBigDecimalType):
+                return T.CTBigDecimalType()
+            return T.CTBigDecimalType()
+        return T.CTNumber
+
+    def _type_function(self, e: E.FunctionCall, env) -> E.Expr:
+        args = tuple(self._retype(a, env) for a in e.args)
+        name = e.name
+        # element-column rewrites (these ARE physical columns)
+        if name == "id" and len(args) == 1:
+            return E.Id(args[0]).with_type(T.CTInteger)
+        if name == "startnode" and len(args) == 1:
+            m = args[0].cypher_type.material
+            return E.StartNode(args[0]).with_type(T.CTNodeType(()))
+        if name == "endnode" and len(args) == 1:
+            return E.EndNode(args[0]).with_type(T.CTNodeType(()))
+        f = lookup_function(name)
+        if len(args) < f.min_args or (f.max_args >= 0 and len(args) > f.max_args):
+            raise IRBuildError(
+                f"Wrong number of arguments for {name}(): got {len(args)}"
+            )
+        t = f.result_type([a.cypher_type for a in args])
+        if f.null_prop and any(a.cypher_type.is_nullable for a in args):
+            t = t.nullable
+        return E.FunctionCall(name, args).with_type(t)
+
+
+def build_ir(stmt: A.Statement, ctx: IRBuilderContext):
+    """Entry point (reference ``IRBuilder.process``)."""
+    return IRBuilder(ctx).build(stmt)

@@ -1,0 +1,501 @@
+"""Scalar function registry: type signatures + reference semantics.
+
+The reference models ~70 functions as individual ``Expr`` case classes
+(``okapi-ir/.../api/expr/Expr.scala``) with per-backend SQL translations
+(``FlinkSQLExprMapper.scala:48`` / ``SparkSQLExprMapper.scala``). Here each
+function is one table entry: a result-type rule plus a pure-Python reference
+implementation (the local backend's evaluator and the oracle for the TPU
+kernels; the TPU backend overrides the hot ones with jnp equivalents).
+
+``null_prop`` functions return null when any argument is null (the default
+Cypher convention); exceptions (coalesce, toString variants…) opt out.
+"""
+
+from __future__ import annotations
+
+import datetime as _dt
+import math
+import re
+from dataclasses import dataclass
+from typing import Callable, Dict, List, Optional, Sequence
+
+from ..api import types as T
+from ..api.types import CypherType
+from ..api.values import Duration, Node, Path, Relationship, to_cypher_string
+
+
+class CypherTypeError(Exception):
+    pass
+
+
+@dataclass
+class FunctionDef:
+    name: str
+    min_args: int
+    max_args: int  # -1 = varargs
+    result_type: Callable[[List[CypherType]], CypherType]
+    fn: Callable
+    null_prop: bool = True
+
+
+def _const(t: CypherType):
+    return lambda args: t
+
+
+def _nullable(t: CypherType):
+    return lambda args: t.nullable
+
+
+FUNCTIONS: Dict[str, FunctionDef] = {}
+
+
+def _register(
+    name: str,
+    fn: Callable,
+    result_type,
+    min_args: int = 1,
+    max_args: Optional[int] = None,
+    null_prop: bool = True,
+):
+    if isinstance(result_type, CypherType):
+        result_type = _const(result_type)
+    FUNCTIONS[name] = FunctionDef(
+        name,
+        min_args,
+        min_args if max_args is None else max_args,
+        result_type,
+        fn,
+        null_prop,
+    )
+
+
+# ---------------------------------------------------------------------------
+# element functions
+# ---------------------------------------------------------------------------
+
+
+def _f_id(v):
+    if isinstance(v, (Node, Relationship)):
+        return v.id
+    raise CypherTypeError(f"id() expects an element, got {type(v).__name__}")
+
+
+def _f_labels(v):
+    if isinstance(v, Node):
+        return sorted(v.labels)
+    raise CypherTypeError("labels() expects a node")
+
+
+def _f_type(v):
+    if isinstance(v, Relationship):
+        return v.rel_type
+    raise CypherTypeError("type() expects a relationship")
+
+
+def _f_keys(v):
+    if isinstance(v, (Node, Relationship)):
+        return sorted(k for k, p in v.properties.items() if p is not None)
+    if isinstance(v, dict):
+        return sorted(v.keys())
+    raise CypherTypeError("keys() expects an element or map")
+
+
+def _f_properties(v):
+    if isinstance(v, (Node, Relationship)):
+        return dict(v.properties)
+    if isinstance(v, dict):
+        return dict(v)
+    raise CypherTypeError("properties() expects an element or map")
+
+
+_register("id", _f_id, T.CTInteger)
+_register("labels", _f_labels, T.CTList(T.CTString))
+_register("type", _f_type, T.CTString)
+_register("keys", _f_keys, T.CTList(T.CTString))
+_register("properties", _f_properties, T.CTMap(None))
+_register(
+    "startnode",
+    lambda r: r.start if isinstance(r, Relationship) else None,
+    T.CTNode(),
+)
+_register(
+    "endnode", lambda r: r.end if isinstance(r, Relationship) else None, T.CTNode()
+)
+
+
+# ---------------------------------------------------------------------------
+# scalar / list functions
+# ---------------------------------------------------------------------------
+
+
+def _f_size(v):
+    if isinstance(v, (list, tuple, str)):
+        return len(v)
+    raise CypherTypeError("size() expects a list or string")
+
+
+def _f_length(v):
+    if isinstance(v, Path):
+        return max(0, (len(v.elements) - 1) // 2)
+    if isinstance(v, (list, tuple, str)):
+        return len(v)
+    raise CypherTypeError("length() expects a path, list or string")
+
+
+def _f_range(*args):
+    start, end = args[0], args[1]
+    step = args[2] if len(args) > 2 else 1
+    if step == 0:
+        raise CypherTypeError("range() step must not be zero")
+    out = list(range(start, end + (1 if step > 0 else -1), step))
+    return out
+
+
+def _f_coalesce(*args):
+    for a in args:
+        if a is not None:
+            return a
+    return None
+
+
+def _f_head(v):
+    return v[0] if v else None
+
+
+def _f_last(v):
+    return v[-1] if v else None
+
+
+def _f_tail(v):
+    return list(v[1:])
+
+
+def _list_inner(args: List[CypherType]) -> CypherType:
+    if args and isinstance(args[0].material, T.CTListType):
+        return args[0].material.inner.nullable
+    return T.CTAny.nullable
+
+
+_register("size", _f_size, T.CTInteger)
+_register("length", _f_length, T.CTInteger)
+_register("range", _f_range, T.CTList(T.CTInteger), min_args=2, max_args=3)
+_register(
+    "coalesce",
+    _f_coalesce,
+    lambda args: T.join_types(a for a in args),
+    min_args=1,
+    max_args=-1,
+    null_prop=False,
+)
+_register("head", _f_head, _list_inner)
+_register("last", _f_last, _list_inner)
+_register(
+    "tail",
+    _f_tail,
+    lambda args: args[0].material if isinstance(args[0].material, T.CTListType) else T.CTList(T.CTAny),
+)
+_register("reverse", lambda v: v[::-1], lambda args: args[0])
+_register("exists", lambda v: v is not None, T.CTBoolean, null_prop=False)
+
+
+# ---------------------------------------------------------------------------
+# conversions
+# ---------------------------------------------------------------------------
+
+
+def _f_tointeger(v):
+    if isinstance(v, bool):
+        raise CypherTypeError("toInteger() on boolean")
+    if isinstance(v, (int, float)):
+        return int(v)
+    if isinstance(v, str):
+        try:
+            return int(v)
+        except ValueError:
+            try:
+                return int(float(v))
+            except ValueError:
+                return None
+    raise CypherTypeError("toInteger() expects number or string")
+
+
+def _f_tofloat(v):
+    if isinstance(v, bool):
+        raise CypherTypeError("toFloat() on boolean")
+    if isinstance(v, (int, float)):
+        return float(v)
+    if isinstance(v, str):
+        try:
+            return float(v)
+        except ValueError:
+            return None
+    raise CypherTypeError("toFloat() expects number or string")
+
+
+def _f_toboolean(v):
+    if isinstance(v, bool):
+        return v
+    if isinstance(v, str):
+        low = v.lower()
+        if low == "true":
+            return True
+        if low == "false":
+            return False
+        return None
+    raise CypherTypeError("toBoolean() expects boolean or string")
+
+
+def _f_tostring(v):
+    if isinstance(v, bool):
+        return "true" if v else "false"
+    if isinstance(v, float):
+        return to_cypher_string(v)
+    if isinstance(v, (int, str)):
+        return str(v)
+    if isinstance(v, (_dt.date, _dt.datetime)):
+        return v.isoformat()
+    if isinstance(v, Duration):
+        return v.cypher_str()
+    return str(v)
+
+
+_register("tointeger", _f_tointeger, _nullable(T.CTInteger))
+_register("tofloat", _f_tofloat, _nullable(T.CTFloat))
+_register("toboolean", _f_toboolean, _nullable(T.CTBoolean))
+_register("tostring", _f_tostring, T.CTString)
+
+
+# ---------------------------------------------------------------------------
+# strings
+# ---------------------------------------------------------------------------
+
+
+def _f_substring(s, start, length=None):
+    if length is None:
+        return s[start:]
+    return s[start : start + length]
+
+
+def _f_split(s, sep):
+    return s.split(sep)
+
+
+_register("touppercase", str.upper, T.CTString)
+_register("toupper", str.upper, T.CTString)
+_register("tolowercase", str.lower, T.CTString)
+_register("tolower", str.lower, T.CTString)
+_register("trim", str.strip, T.CTString)
+_register("ltrim", str.lstrip, T.CTString)
+_register("rtrim", str.rstrip, T.CTString)
+_register("substring", _f_substring, T.CTString, min_args=2, max_args=3)
+_register("left", lambda s, n: s[:n], T.CTString, min_args=2)
+_register("right", lambda s, n: s[-n:] if n > 0 else "", T.CTString, min_args=2)
+_register("replace", lambda s, a, b: s.replace(a, b), T.CTString, min_args=3)
+_register("split", _f_split, T.CTList(T.CTString), min_args=2)
+
+
+# ---------------------------------------------------------------------------
+# math
+# ---------------------------------------------------------------------------
+
+
+def _numeric_result(args: List[CypherType]) -> CypherType:
+    t = args[0].material if args else T.CTNumber
+    if t == T.CTInteger:
+        return T.CTInteger
+    if t == T.CTFloat:
+        return T.CTFloat
+    return T.CTNumber
+
+
+def _f_abs(v):
+    return abs(v)
+
+
+def _f_round(v):
+    # Cypher rounds half away from zero
+    return float(math.floor(v + 0.5)) if v >= 0 else float(math.ceil(v - 0.5))
+
+
+def _f_sign(v):
+    return (v > 0) - (v < 0)
+
+
+_register("abs", _f_abs, _numeric_result)
+_register("ceil", lambda v: float(math.ceil(v)), T.CTFloat)
+_register("floor", lambda v: float(math.floor(v)), T.CTFloat)
+_register("round", _f_round, T.CTFloat)
+_register("sqrt", lambda v: math.sqrt(v), T.CTFloat)
+_register("sign", _f_sign, T.CTInteger)
+_register("exp", math.exp, T.CTFloat)
+_register("log", lambda v: math.log(v) if v > 0 else None, _nullable(T.CTFloat))
+_register("log10", lambda v: math.log10(v) if v > 0 else None, _nullable(T.CTFloat))
+_register("sin", math.sin, T.CTFloat)
+_register("cos", math.cos, T.CTFloat)
+_register("tan", math.tan, T.CTFloat)
+_register("cot", lambda v: 1.0 / math.tan(v), T.CTFloat)
+_register("asin", math.asin, T.CTFloat)
+_register("acos", math.acos, T.CTFloat)
+_register("atan", math.atan, T.CTFloat)
+_register("atan2", math.atan2, T.CTFloat, min_args=2)
+_register("degrees", math.degrees, T.CTFloat)
+_register("radians", math.radians, T.CTFloat)
+_register("haversin", lambda v: (1 - math.cos(v)) / 2, T.CTFloat)
+_register("pi", lambda: math.pi, T.CTFloat, min_args=0, max_args=0)
+_register("e", lambda: math.e, T.CTFloat, min_args=0, max_args=0)
+
+import random as _random
+
+_register("rand", lambda: _random.random(), T.CTFloat, min_args=0, max_args=0)
+
+
+# ---------------------------------------------------------------------------
+# temporal
+# ---------------------------------------------------------------------------
+
+_DATE_RE = re.compile(r"(\d{4})-?(\d{2})?-?(\d{2})?")
+
+
+def _f_date(v=None):
+    if v is None:
+        return _dt.date.today()
+    if isinstance(v, str):
+        m = _DATE_RE.match(v)
+        if not m:
+            raise CypherTypeError(f"Cannot parse date {v!r}")
+        y, mo, d = int(m.group(1)), int(m.group(2) or 1), int(m.group(3) or 1)
+        return _dt.date(y, mo, d)
+    if isinstance(v, dict):
+        return _dt.date(int(v.get("year", 1)), int(v.get("month", 1)), int(v.get("day", 1)))
+    raise CypherTypeError("date() expects a string or map")
+
+
+def _f_localdatetime(v=None):
+    if v is None:
+        return _dt.datetime.now()
+    if isinstance(v, str):
+        return _dt.datetime.fromisoformat(v)
+    if isinstance(v, dict):
+        return _dt.datetime(
+            int(v.get("year", 1)),
+            int(v.get("month", 1)),
+            int(v.get("day", 1)),
+            int(v.get("hour", 0)),
+            int(v.get("minute", 0)),
+            int(v.get("second", 0)),
+            int(v.get("millisecond", 0)) * 1000 + int(v.get("microsecond", 0)),
+        )
+    raise CypherTypeError("localdatetime() expects a string or map")
+
+
+def _f_duration(v):
+    if isinstance(v, str):
+        return _parse_iso_duration(v)
+    if isinstance(v, dict):
+        return Duration.of(**{k: v for k, v in v.items()})
+    raise CypherTypeError("duration() expects a string or map")
+
+
+_ISO_DUR = re.compile(
+    r"^(?P<sign>-)?P(?:(?P<y>-?[\d.]+)Y)?(?:(?P<mo>-?[\d.]+)M)?(?:(?P<w>-?[\d.]+)W)?"
+    r"(?:(?P<d>-?[\d.]+)D)?(?:T(?:(?P<h>-?[\d.]+)H)?(?:(?P<mi>-?[\d.]+)M)?"
+    r"(?:(?P<s>-?[\d.]+)S)?)?$"
+)
+
+
+def _parse_iso_duration(s: str) -> Duration:
+    m = _ISO_DUR.match(s.strip())
+    if not m or s.strip() in ("P", "-P"):
+        raise CypherTypeError(f"Cannot parse duration {s!r}")
+    g = {k: float(v) if v else 0.0 for k, v in m.groupdict().items() if k != "sign"}
+    d = Duration.of(
+        years=g["y"], months=g["mo"], weeks=g["w"], days=g["d"],
+        hours=g["h"], minutes=g["mi"], seconds=g["s"],
+    )
+    if m.group("sign"):
+        d = -d
+    return d
+
+
+def _f_duration_between(a, b):
+    if isinstance(a, _dt.date) and not isinstance(a, _dt.datetime):
+        a = _dt.datetime(a.year, a.month, a.day)
+    if isinstance(b, _dt.date) and not isinstance(b, _dt.datetime):
+        b = _dt.datetime(b.year, b.month, b.day)
+    delta = b - a
+    return Duration(days=delta.days, seconds=delta.seconds, microseconds=delta.microseconds)
+
+
+_register("date", _f_date, T.CTDate, min_args=0, max_args=1)
+_register("localdatetime", _f_localdatetime, T.CTLocalDateTime, min_args=0, max_args=1)
+_register("duration", _f_duration, T.CTDuration)
+_register("duration.between", _f_duration_between, T.CTDuration, min_args=2)
+
+
+# temporal accessors used via property syntax (d.year, d.month, ...)
+TEMPORAL_ACCESSORS: Dict[str, Callable] = {
+    "year": lambda d: d.year,
+    "month": lambda d: d.month,
+    "day": lambda d: d.day,
+    "week": lambda d: d.isocalendar()[1],
+    "weekyear": lambda d: d.isocalendar()[0],
+    "dayofweek": lambda d: d.isoweekday(),
+    "ordinalday": lambda d: d.timetuple().tm_yday,
+    "quarter": lambda d: (d.month - 1) // 3 + 1,
+    "dayofquarter": lambda d: (d - _quarter_start(d)).days + 1,
+    "hour": lambda d: d.hour,
+    "minute": lambda d: d.minute,
+    "second": lambda d: d.second,
+    "millisecond": lambda d: d.microsecond // 1000,
+    "microsecond": lambda d: d.microsecond,
+}
+
+DURATION_ACCESSORS: Dict[str, Callable] = {
+    "years": lambda d: d.months // 12,
+    "months": lambda d: d.months,
+    "monthsofyear": lambda d: d.months % 12,
+    "weeks": lambda d: d.days // 7,
+    "days": lambda d: d.days,
+    "hours": lambda d: d.seconds // 3600,
+    "minutes": lambda d: d.seconds // 60,
+    "seconds": lambda d: d.seconds,
+    "milliseconds": lambda d: d.seconds * 1000 + d.microseconds // 1000,
+    "microseconds": lambda d: d.seconds * 1_000_000 + d.microseconds,
+}
+
+
+def _quarter_start(d):
+    q_month = 3 * ((d.month - 1) // 3) + 1
+    if isinstance(d, _dt.datetime):
+        return _dt.datetime(d.year, q_month, 1)
+    return _dt.date(d.year, q_month, 1)
+
+
+# ---------------------------------------------------------------------------
+# big decimal
+# ---------------------------------------------------------------------------
+
+from decimal import Decimal
+
+
+def _f_bigdecimal(v, precision=38, scale=18):
+    if isinstance(v, bool):
+        raise CypherTypeError("bigdecimal() on boolean")
+    q = Decimal(str(v)).quantize(Decimal(1).scaleb(-int(scale)))
+    return q
+
+
+_register(
+    "bigdecimal",
+    _f_bigdecimal,
+    lambda args: T.CTBigDecimalType(),
+    min_args=1,
+    max_args=3,
+)
+
+
+def lookup(name: str) -> FunctionDef:
+    f = FUNCTIONS.get(name)
+    if f is None:
+        raise CypherTypeError(f"Unknown function: {name}")
+    return f

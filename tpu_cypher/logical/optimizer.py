@@ -1,0 +1,93 @@
+"""Logical optimizer.
+
+Mirrors the reference's rule pipeline (``LogicalOptimizer.scala:41``):
+
+* ``discard_scans_for_nonexistent_labels`` — scans on labels absent from the
+  schema become EmptyRecords (``LogicalOptimizer.scala`` rule 1),
+* ``replace_cartesian_with_value_join`` — a Filter(Equals) above a
+  CartesianProduct whose sides each solve one operand becomes a ValueJoin
+  (``LogicalOptimizer.scala:53``),
+* filter pushdown below cartesian products (our addition — the reference
+  relies on engine optimizers (Catalyst/Calcite) for this; we have no engine
+  below us, so simple pushdown lives here).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Set
+
+from ..api.schema import PropertyGraphSchema
+from ..api import types as T
+from ..ir import expr as E
+from ..trees import TreeNode
+from . import ops as L
+
+
+def optimize(
+    plan: L.LogicalOperator, schema: Optional[PropertyGraphSchema] = None
+) -> L.LogicalOperator:
+    if schema is not None:
+        plan = discard_scans_for_nonexistent_labels(plan, schema)
+    plan = replace_cartesian_with_value_join(plan)
+    return plan
+
+
+def discard_scans_for_nonexistent_labels(
+    plan: L.LogicalOperator, schema: PropertyGraphSchema
+) -> L.LogicalOperator:
+    known = schema.labels
+
+    def rule(n: TreeNode) -> TreeNode:
+        if isinstance(n, L.NodeScan):
+            t = n.node_type
+            if isinstance(t, T.CTNodeType) and not (t.labels <= known):
+                return L.EmptyRecords(n.graph_name, n.fields)
+        return n
+
+    return plan.rewrite(rule)
+
+
+def _vars_of(e: E.Expr) -> Set[str]:
+    return {v.name for v in e.iter_nodes() if isinstance(v, E.Var)}
+
+
+def replace_cartesian_with_value_join(plan: L.LogicalOperator) -> L.LogicalOperator:
+    """Filter(Equals(l, r), CartesianProduct(a, b)) -> ValueJoin(a, b, l=r)."""
+
+    def rule(n: TreeNode) -> TreeNode:
+        if not isinstance(n, L.Filter):
+            return n
+        pred = n.predicate
+        eqs = [pred] if isinstance(pred, E.Equals) else (
+            [p for p in pred.exprs if isinstance(p, E.Equals)]
+            if isinstance(pred, E.Ands)
+            else []
+        )
+        if not eqs or not isinstance(n.in_op, L.CartesianProduct):
+            return n
+        cart = n.in_op
+        lhs_fields = {f for f, _ in cart.lhs.fields}
+        rhs_fields = {f for f, _ in cart.rhs.fields}
+        join_preds = []
+        rest = (
+            list(pred.exprs) if isinstance(pred, E.Ands) else [pred]
+        )
+        for eq in eqs:
+            lv, rv = _vars_of(eq.lhs), _vars_of(eq.rhs)
+            if lv <= lhs_fields and rv <= rhs_fields:
+                join_preds.append(eq)
+                rest.remove(eq)
+            elif lv <= rhs_fields and rv <= lhs_fields:
+                join_preds.append(E.Equals(eq.rhs, eq.lhs).with_type(eq.cypher_type))
+                rest.remove(eq)
+        if not join_preds:
+            return n
+        out: L.LogicalOperator = L.ValueJoin(cart.lhs, cart.rhs, tuple(join_preds))
+        if rest:
+            remaining = rest[0] if len(rest) == 1 else E.Ands(tuple(rest)).with_type(
+                T.CTBoolean.nullable
+            )
+            out = L.Filter(out, remaining)
+        return out
+
+    return plan.rewrite(rule)

@@ -1,0 +1,295 @@
+"""Logical planner: IR blocks -> logical operator tree.
+
+Re-design of the reference ``LogicalPlanner``
+(``okapi-logical/.../impl/LogicalPlanner.scala:47``, planBlock/planLeaf/
+planNonLeaf ``:93-190``) and ``LogicalOperatorProducer``: connected-component
+analysis of match patterns produces Expand chains joined by CartesianProduct;
+optional matches become ``Optional``; pattern predicates become
+``ExistsSubQuery``; projections/aggregations/slices map 1:1 onto operators.
+"""
+
+from __future__ import annotations
+
+import itertools
+from dataclasses import dataclass, field as dc_field
+from typing import Dict, List, Optional as Opt, Set, Tuple
+
+from ..api import types as T
+from ..frontend.ast import SortItem
+from ..ir import blocks as B
+from ..ir import expr as E
+from ..ir.pattern import BOTH, Connection, IRPattern
+from . import ops as L
+
+
+class LogicalPlanningError(Exception):
+    pass
+
+
+@dataclass
+class LogicalPlannerContext:
+    working_graph: str = "session.ambient"
+    input_fields: L.FieldsT = ()
+
+
+class LogicalPlanner:
+    def __init__(self, ctx: LogicalPlannerContext):
+        self.ctx = ctx
+        self._fresh = itertools.count()
+
+    def fresh(self, prefix: str) -> str:
+        return f"__{prefix}_{next(self._fresh)}"
+
+    # ------------------------------------------------------------------
+
+    def plan(self, ir) -> L.LogicalOperator:
+        if isinstance(ir, B.UnionIR):
+            plans = [self.plan(q) for q in ir.queries]
+            out = plans[0]
+            for p in plans[1:]:
+                out = L.TabularUnionAll(out, p)
+            if not ir.all:
+                out = L.Distinct(out, tuple(ir.returns or ()))
+            return out
+        assert isinstance(ir, B.QueryIR)
+        graph = ir.source_graph
+        if self.ctx.input_fields:
+            plan: L.LogicalOperator = L.DrivingTable(graph, self.ctx.input_fields)
+        else:
+            plan = L.Start(graph, ())
+        for blk in ir.blocks:
+            plan = self.plan_block(blk, plan)
+        return plan
+
+    # ------------------------------------------------------------------
+
+    def plan_block(self, blk: B.Block, plan: L.LogicalOperator) -> L.LogicalOperator:
+        if isinstance(blk, B.MatchBlock):
+            return self.plan_match(blk, plan)
+        if isinstance(blk, B.ProjectBlock):
+            # All items are evaluated against the PRE-projection scope
+            # (simultaneous assignment: WITH a AS b, b AS a must swap).
+            assigned = {
+                name
+                for name, ex in blk.items
+                if not (isinstance(ex, E.Var) and ex.name == name)
+            }
+            needs_temps = any(
+                name in (v.name for v in E.walk_vars(ex)) and name != other
+                for other, ex in blk.items
+                for name in assigned
+                if not (isinstance(ex, E.Var) and ex.name == other)
+            )
+            if needs_temps:
+                renames: List[Tuple[str, E.Expr]] = []
+                for name, ex in blk.items:
+                    if isinstance(ex, E.Var) and ex.name == name:
+                        continue
+                    tmp = self.fresh("proj")
+                    plan = L.Project(plan, ex, tmp)
+                    renames.append((name, E.Var(tmp).with_type(ex.cypher_type)))
+                for name, var in renames:
+                    plan = L.Project(plan, var, name)
+            else:
+                for name, ex in blk.items:
+                    if isinstance(ex, E.Var) and ex.name == name:
+                        continue
+                    plan = L.Project(plan, ex, name)
+            return plan
+        if isinstance(blk, B.AggregationBlock):
+            for name, ex in blk.group:
+                if not (isinstance(ex, E.Var) and ex.name == name):
+                    plan = L.Project(plan, ex, name)
+            d = dict(plan.fields)
+            group = tuple((n, d[n]) for n, _ in blk.group)
+            return L.Aggregate(plan, group, blk.aggregations)
+        if isinstance(blk, B.FilterBlock):
+            return self._plan_predicate(blk.predicate, plan)
+        if isinstance(blk, B.DistinctBlock):
+            return L.Distinct(plan, blk.fields)
+        if isinstance(blk, B.OrderAndSliceBlock):
+            if blk.sort_items:
+                items: List[SortItem] = []
+                for s in blk.sort_items:
+                    if isinstance(s.expr, E.Var):
+                        items.append(s)
+                    else:
+                        f = self.fresh("sort")
+                        plan = L.Project(plan, s.expr, f)
+                        items.append(
+                            SortItem(E.Var(f).with_type(s.expr.cypher_type), s.ascending)
+                        )
+                plan = L.OrderBy(plan, tuple(items))
+            if blk.skip is not None:
+                plan = L.Skip(plan, blk.skip)
+            if blk.limit is not None:
+                plan = L.Limit(plan, blk.limit)
+            return plan
+        if isinstance(blk, B.UnwindBlock):
+            inner = blk.list_expr.cypher_type.material
+            t = inner.inner if isinstance(inner, T.CTListType) else T.CTAny.nullable
+            return L.Unwind(plan, blk.list_expr, blk.fld, t)
+        if isinstance(blk, (B.SelectBlock, B.ResultBlock)):
+            current = tuple(n for n, _ in plan.fields)
+            if current == tuple(blk.fields):
+                return plan
+            return L.Select(plan, tuple(blk.fields))
+        if isinstance(blk, B.FromGraphBlock):
+            return L.FromGraph(plan, blk.qgn)
+        if isinstance(blk, B.GraphResultBlock):
+            return L.ReturnGraph(plan)
+        if isinstance(blk, B.ConstructBlock):
+            return L.ConstructGraph(plan, blk, self.fresh("constructed"))
+        raise LogicalPlanningError(f"Cannot plan block {type(blk).__name__}")
+
+    # ------------------------------------------------------------------
+    # MATCH planning
+    # ------------------------------------------------------------------
+
+    def plan_match(self, blk: B.MatchBlock, plan: L.LogicalOperator) -> L.LogicalOperator:
+        if blk.optional:
+            rhs = self._plan_pattern(blk.pattern, plan)
+            for p in blk.predicates:
+                rhs = self._plan_predicate(p, rhs)
+            return L.Optional(plan, rhs)
+        plan = self._plan_pattern(blk.pattern, plan)
+        for p in blk.predicates:
+            plan = self._plan_predicate(p, plan)
+        return plan
+
+    def _plan_pattern(
+        self, pattern: IRPattern, base: L.LogicalOperator
+    ) -> L.LogicalOperator:
+        graph = base.graph_name
+        bound: Set[str] = {n for n, _ in base.fields}
+        solved_nodes: Set[str] = {n for n in pattern.node_types if n in bound}
+        unsolved_conns: Dict[str, Connection] = {
+            r: c for r, c in pattern.topology.items() if r not in bound
+        }
+        plan = base
+
+        def node_scan(fld: str, on: Opt[L.LogicalOperator] = None) -> L.LogicalOperator:
+            src = on if on is not None else L.Start(graph, ())
+            return L.NodeScan(src, fld, pattern.node_types[fld])
+
+        # deterministic component order: components containing bound nodes
+        # first, then by smallest member name
+        comps = sorted(
+            pattern.components(),
+            key=lambda comp: (not any(n in bound for n in comp), sorted(comp)[0]),
+        )
+        for comp in comps:
+            comp_conns = {
+                r: c
+                for r, c in unsolved_conns.items()
+                if c.source in comp or c.target in comp
+            }
+            if not any(n in solved_nodes for n in comp):
+                # need a fresh scan to anchor this component
+                start = self._pick_start(comp, pattern)
+                scan = node_scan(start)
+                if not plan.fields and isinstance(plan, L.Start):
+                    plan = scan
+                else:
+                    plan = L.CartesianProduct(plan, scan)
+                solved_nodes.add(start)
+            # expand until the whole component is solved
+            while comp_conns:
+                progress = False
+                for r in sorted(comp_conns):
+                    c = comp_conns[r]
+                    src_solved = c.source in solved_nodes
+                    dst_solved = c.target in solved_nodes
+                    if not (src_solved or dst_solved):
+                        continue
+                    plan = self._plan_connection(
+                        plan, pattern, r, c, src_solved, dst_solved, graph
+                    )
+                    solved_nodes.add(c.source)
+                    solved_nodes.add(c.target)
+                    del comp_conns[r]
+                    del unsolved_conns[r]
+                    progress = True
+                    break
+                if not progress:  # pragma: no cover - components guarantee progress
+                    raise LogicalPlanningError("Disconnected pattern component")
+            # isolated unsolved nodes (no connections)
+            for n in sorted(comp):
+                if n not in solved_nodes:
+                    plan = L.CartesianProduct(plan, node_scan(n))
+                    solved_nodes.add(n)
+        return plan
+
+    @staticmethod
+    def _pick_start(comp, pattern: IRPattern) -> str:
+        # prefer labelled nodes (cheaper scans), then name determinism
+        def key(n):
+            t = pattern.node_types[n]
+            return (-len(t.labels), n)
+
+        return min(comp, key=key)
+
+    def _plan_connection(
+        self,
+        plan: L.LogicalOperator,
+        pattern: IRPattern,
+        rel: str,
+        c: Connection,
+        src_solved: bool,
+        dst_solved: bool,
+        graph: str,
+    ) -> L.LogicalOperator:
+        rel_type = pattern.rel_types[rel]
+        if not c.is_var_length:
+            if src_solved and dst_solved:
+                return L.ExpandInto(plan, c.source, rel, rel_type, c.target, c.direction)
+            new_node = c.target if src_solved else c.source
+            scan = L.NodeScan(L.Start(graph, ()), new_node, pattern.node_types[new_node])
+            return L.Expand(plan, scan, c.source, rel, rel_type, c.target, c.direction)
+        # var-length
+        upper = c.upper
+        if upper is None:
+            raise LogicalPlanningError("Unbounded var-length expand not supported")
+        if src_solved and dst_solved:
+            # expand to a fresh target, then align on id equality
+            fresh_t = self.fresh(f"vt_{c.target}")
+            t_type = pattern.node_types[c.target]
+            scan = L.NodeScan(L.Start(graph, ()), fresh_t, t_type)
+            expand = L.BoundedVarLengthExpand(
+                plan, scan, c.source, rel, rel_type, fresh_t, c.direction, c.lower, upper
+            )
+            eq = E.Equals(
+                E.Id(E.Var(fresh_t).with_type(t_type)).with_type(T.CTInteger),
+                E.Id(E.Var(c.target).with_type(t_type)).with_type(T.CTInteger),
+            ).with_type(T.CTBoolean)
+            return L.Filter(expand, eq)
+        new_node = c.target if src_solved else c.source
+        scan = L.NodeScan(L.Start(graph, ()), new_node, pattern.node_types[new_node])
+        return L.BoundedVarLengthExpand(
+            plan, scan, c.source, rel, rel_type, c.target, c.direction, c.lower, upper
+        )
+
+    # ------------------------------------------------------------------
+    # predicates (incl. exists subqueries)
+    # ------------------------------------------------------------------
+
+    def _plan_predicate(self, pred: E.Expr, plan: L.LogicalOperator) -> L.LogicalOperator:
+        exists = [n for n in pred.iter_nodes() if isinstance(n, E.ExistsPattern)]
+        mapping: Dict[E.Expr, E.Expr] = {}
+        for ep in exists:
+            target = ep.target_field or self.fresh("exists")
+            sub_pattern = getattr(ep, "_ir_pattern", None)
+            if sub_pattern is None:
+                raise LogicalPlanningError("ExistsPattern missing IR pattern")
+            rhs = self._plan_pattern(sub_pattern, plan)
+            for p in getattr(ep, "_ir_predicates", ()):  # inner property predicates
+                rhs = self._plan_predicate(p, rhs)
+            plan = L.ExistsSubQuery(plan, rhs, target)
+            mapping[ep] = E.Var(target).with_type(T.CTBoolean)
+        if mapping:
+            pred = E.substitute(pred, mapping)
+        return L.Filter(plan, pred)
+
+
+def plan_logical(ir, ctx: Opt[LogicalPlannerContext] = None) -> L.LogicalOperator:
+    return LogicalPlanner(ctx or LogicalPlannerContext()).plan(ir)
