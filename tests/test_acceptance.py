@@ -1,0 +1,717 @@
+"""Acceptance tests: behavior spec executed on the local backend.
+
+Mirrors the reference's acceptance suites
+(``morpheus-testing/src/test/.../impl/acceptance/``: MatchTests,
+ExpandIntoTests, BoundedVarExpandTests, OptionalMatchTests, PredicateTests,
+ExpressionTests, FunctionTests, AggregationTests, WithTests, ReturnTests,
+UnwindTests, UnionTests, NullTests...) with the same pattern: build a graph
+from a CREATE query, run Cypher, assert a Bag (multiset) of rows."""
+
+import math
+
+import pytest
+
+from tpu_cypher import CypherSession
+from tpu_cypher.testing.bag import Bag
+
+
+@pytest.fixture(scope="module")
+def session():
+    return CypherSession.local()
+
+
+def init_graph(session, create_query):
+    return session.create_graph_from_create_query(create_query)
+
+
+def results(graph, query, **params):
+    return graph.cypher(query, params or None).records.to_bag()
+
+
+def assert_results(graph, query, expected, **params):
+    got = results(graph, query, **params)
+    assert got == Bag(expected), f"\nquery: {query}\ngot: {got!r}\nexpected: {Bag(expected)!r}"
+
+
+# ---------------------------------------------------------------------------
+# MatchTests
+# ---------------------------------------------------------------------------
+
+
+class TestMatch:
+    @pytest.fixture(scope="class")
+    def g(self, session):
+        return init_graph(
+            session,
+            "CREATE (a:Person {name:'Alice', age:23})-[:KNOWS {since:2019}]->"
+            "(b:Person {name:'Bob', age:42}),"
+            "(b)-[:KNOWS {since:2020}]->(c:Person {name:'Carol', age:55}),"
+            "(a)-[:KNOWS {since:2021}]->(c),"
+            "(a)-[:READS]->(k:Book {title:'Graphs'}),"
+            "(c)-[:READS]->(k)",
+        )
+
+    def test_node_scan(self, g):
+        assert_results(
+            g,
+            "MATCH (b:Book) RETURN b.title",
+            [{"b.title": "Graphs"}],
+        )
+
+    def test_scan_all_nodes(self, g):
+        assert results(g, "MATCH (n) RETURN n").counter and len(results(g, "MATCH (n) RETURN n")) == 4
+
+    def test_single_hop(self, g):
+        assert_results(
+            g,
+            "MATCH (a:Person)-[:KNOWS]->(b) RETURN a.name, b.name",
+            [
+                {"a.name": "Alice", "b.name": "Bob"},
+                {"a.name": "Bob", "b.name": "Carol"},
+                {"a.name": "Alice", "b.name": "Carol"},
+            ],
+        )
+
+    def test_incoming(self, g):
+        assert_results(
+            g,
+            "MATCH (a)<-[:KNOWS]-(b:Person {name:'Alice'}) RETURN a.name",
+            [{"a.name": "Bob"}, {"a.name": "Carol"}],
+        )
+
+    def test_undirected(self, g):
+        assert_results(
+            g,
+            "MATCH (b:Person {name:'Bob'})-[:KNOWS]-(x) RETURN x.name",
+            [{"x.name": "Alice"}, {"x.name": "Carol"}],
+        )
+
+    def test_two_hop(self, g):
+        assert_results(
+            g,
+            "MATCH (a)-[:KNOWS]->()-[:KNOWS]->(c) RETURN a.name, c.name",
+            [{"a.name": "Alice", "c.name": "Carol"}],
+        )
+
+    def test_expand_into_triangle(self, g):
+        assert_results(
+            g,
+            "MATCH (a)-[:KNOWS]->(b)-[:KNOWS]->(c), (a)-[:KNOWS]->(c) RETURN a.name, b.name, c.name",
+            [{"a.name": "Alice", "b.name": "Bob", "c.name": "Carol"}],
+        )
+
+    def test_shared_node_two_patterns(self, g):
+        assert_results(
+            g,
+            "MATCH (a)-[:READS]->(book)<-[:READS]-(other) WHERE a.name < other.name "
+            "RETURN a.name, other.name",
+            [{"a.name": "Alice", "other.name": "Carol"}],
+        )
+
+    def test_cartesian(self, g):
+        assert_results(
+            g,
+            "MATCH (a:Book), (b:Book) RETURN a.title, b.title",
+            [{"a.title": "Graphs", "b.title": "Graphs"}],
+        )
+
+    def test_rel_var_and_properties(self, g):
+        assert_results(
+            g,
+            "MATCH ()-[k:KNOWS]->() WHERE k.since >= 2020 RETURN k.since",
+            [{"k.since": 2020}, {"k.since": 2021}],
+        )
+
+    def test_multiple_rel_types(self, g):
+        assert_results(
+            g,
+            "MATCH (a:Person {name:'Alice'})-[r:KNOWS|READS]->(x) RETURN count(r) AS c",
+            [{"c": 3}],
+        )
+
+    def test_property_map_filter(self, g):
+        assert_results(
+            g,
+            "MATCH (a:Person {name:'Bob'})-[:KNOWS {since:2020}]->(b) RETURN b.name",
+            [{"b.name": "Carol"}],
+        )
+
+    def test_label_disjunction_via_union(self, g):
+        assert_results(
+            g,
+            "MATCH (a:Book) RETURN a.title AS t UNION MATCH (p:Person {name:'Alice'}) RETURN p.name AS t",
+            [{"t": "Graphs"}, {"t": "Alice"}],
+        )
+
+    def test_match_on_bound_var(self, g):
+        assert_results(
+            g,
+            "MATCH (a:Person {name:'Alice'}) MATCH (a)-[:READS]->(b) RETURN b.title",
+            [{"b.title": "Graphs"}],
+        )
+
+
+# ---------------------------------------------------------------------------
+# OptionalMatchTests / NullTests
+# ---------------------------------------------------------------------------
+
+
+class TestOptionalMatchAndNulls:
+    @pytest.fixture(scope="class")
+    def g(self, session):
+        return init_graph(
+            session,
+            "CREATE (a:Person {name:'Alice'})-[:READS]->(:Book {title:'X'}),"
+            "(:Person {name:'Bob'})",
+        )
+
+    def test_optional_match_null_fill(self, g):
+        assert_results(
+            g,
+            "MATCH (p:Person) OPTIONAL MATCH (p)-[:READS]->(b) RETURN p.name, b.title",
+            [
+                {"p.name": "Alice", "b.title": "X"},
+                {"p.name": "Bob", "b.title": None},
+            ],
+        )
+
+    def test_optional_then_filter_is_null(self, g):
+        assert_results(
+            g,
+            "MATCH (p:Person) OPTIONAL MATCH (p)-[:READS]->(b) WITH p, b WHERE b IS NULL RETURN p.name",
+            [{"p.name": "Bob"}],
+        )
+
+    def test_null_propagation_in_arithmetic(self, g):
+        assert_results(
+            g,
+            "MATCH (p:Person {name:'Bob'}) RETURN p.missing + 1 AS x",
+            [{"x": None}],
+        )
+
+    def test_ternary_logic(self, g):
+        assert_results(
+            g,
+            "MATCH (p:Person {name:'Bob'}) RETURN p.missing > 1 AS gt, "
+            "p.missing > 1 OR true AS or_t, p.missing > 1 AND false AS and_f",
+            [{"gt": None, "or_t": True, "and_f": False}],
+        )
+
+    def test_missing_property_is_null(self, g):
+        assert_results(
+            g,
+            "MATCH (p:Person) RETURN p.name, p.nope IS NULL AS missing",
+            [
+                {"p.name": "Alice", "missing": True},
+                {"p.name": "Bob", "missing": True},
+            ],
+        )
+
+
+# ---------------------------------------------------------------------------
+# PredicateTests
+# ---------------------------------------------------------------------------
+
+
+class TestPredicates:
+    @pytest.fixture(scope="class")
+    def g(self, session):
+        return init_graph(
+            session,
+            "CREATE (:N {i:1, s:'abc', f: 1.5}), (:N {i:2, s:'abd', f: 2.5}), (:N {i:3, s:'x'})",
+        )
+
+    def test_comparisons(self, g):
+        assert_results(g, "MATCH (n:N) WHERE n.i >= 2 RETURN n.i", [{"n.i": 2}, {"n.i": 3}])
+        assert_results(g, "MATCH (n:N) WHERE n.i < 1.6 RETURN n.i", [{"n.i": 1}])
+        assert_results(g, "MATCH (n:N) WHERE 1 < n.i <= 3 RETURN n.i", [{"n.i": 2}, {"n.i": 3}])
+
+    def test_string_predicates(self, g):
+        assert_results(g, "MATCH (n:N) WHERE n.s STARTS WITH 'ab' RETURN n.i", [{"n.i": 1}, {"n.i": 2}])
+        assert_results(g, "MATCH (n:N) WHERE n.s ENDS WITH 'd' RETURN n.i", [{"n.i": 2}])
+        assert_results(g, "MATCH (n:N) WHERE n.s CONTAINS 'b' RETURN n.i", [{"n.i": 1}, {"n.i": 2}])
+        assert_results(g, "MATCH (n:N) WHERE n.s =~ 'ab.' RETURN n.i", [{"n.i": 1}, {"n.i": 2}])
+
+    def test_in_predicate(self, g):
+        assert_results(g, "MATCH (n:N) WHERE n.i IN [1, 3, 5] RETURN n.i", [{"n.i": 1}, {"n.i": 3}])
+
+    def test_boolean_connectives(self, g):
+        assert_results(
+            g, "MATCH (n:N) WHERE n.i = 1 OR n.i = 3 RETURN n.i", [{"n.i": 1}, {"n.i": 3}]
+        )
+        assert_results(g, "MATCH (n:N) WHERE NOT n.i = 1 RETURN n.i", [{"n.i": 2}, {"n.i": 3}])
+        assert_results(g, "MATCH (n:N) WHERE n.i = 1 XOR n.i = 3 RETURN n.i", [{"n.i": 1}, {"n.i": 3}])
+
+    def test_label_predicate_in_where(self, g):
+        assert_results(g, "MATCH (n) WHERE n:N AND n.i = 1 RETURN n.i", [{"n.i": 1}])
+
+
+# ---------------------------------------------------------------------------
+# ExpressionTests / FunctionTests
+# ---------------------------------------------------------------------------
+
+
+class TestExpressionsAndFunctions:
+    @pytest.fixture(scope="class")
+    def g(self, session):
+        return init_graph(session, "CREATE (:One {i: 1})")
+
+    def test_arithmetic(self, g):
+        assert_results(
+            g,
+            "MATCH (n:One) RETURN 2 + 3 * 4 AS a, 7 / 2 AS b, 7.0 / 2 AS c, 7 % 2 AS d, 2 ^ 10 AS e, -(-5) AS f",
+            [{"a": 14, "b": 3, "c": 3.5, "d": 1, "e": 1024.0, "f": 5}],
+        )
+
+    def test_string_functions(self, g):
+        assert_results(
+            g,
+            "RETURN toUpper('ab') AS u, toLower('AB') AS l, trim('  x  ') AS t, "
+            "substring('hello', 1, 3) AS s, replace('aaa', 'a', 'b') AS r, "
+            "split('a,b', ',') AS sp, reverse('abc') AS rev, size('abcd') AS sz",
+            [
+                {
+                    "u": "AB",
+                    "l": "ab",
+                    "t": "x",
+                    "s": "ell",
+                    "r": "bbb",
+                    "sp": ["a", "b"],
+                    "rev": "cba",
+                    "sz": 4,
+                }
+            ],
+        )
+
+    def test_math_functions(self, g):
+        r = results(g, "RETURN abs(-3) AS a, ceil(1.2) AS c, floor(1.8) AS f, round(1.5) AS r, sqrt(16) AS s, sign(-7) AS g, exp(0) AS e")
+        row = next(iter(r.counter))
+        assert row["a"] == 3 and row["c"] == 2.0 and row["f"] == 1.0
+        assert row["r"] == 2.0 and row["s"] == 4.0 and row["g"] == -1 and row["e"] == 1.0
+
+    def test_conversions(self, g):
+        assert_results(
+            g,
+            "RETURN toInteger('42') AS i, toFloat('1.5') AS f, toString(7) AS s, "
+            "toBoolean('true') AS b, toInteger('nope') AS bad",
+            [{"i": 42, "f": 1.5, "s": "7", "b": True, "bad": None}],
+        )
+
+    def test_list_operations(self, g):
+        assert_results(
+            g,
+            "RETURN [1,2,3][0] AS head_idx, [1,2,3][-1] AS last_idx, [1,2,3][1..3] AS slice, "
+            "head([1,2]) AS h, last([1,2]) AS l, tail([1,2,3]) AS t, size([1,2,3]) AS sz, "
+            "range(1, 4) AS rng, [1,2] + [3] AS cat",
+            [
+                {
+                    "head_idx": 1,
+                    "last_idx": 3,
+                    "slice": [2, 3],
+                    "h": 1,
+                    "l": 2,
+                    "t": [2, 3],
+                    "sz": 3,
+                    "rng": [1, 2, 3, 4],
+                    "cat": [1, 2, 3],
+                }
+            ],
+        )
+
+    def test_list_comprehension(self, g):
+        assert_results(
+            g,
+            "RETURN [x IN range(1,5) WHERE x % 2 = 0 | x * 10] AS xs",
+            [{"xs": [20, 40]}],
+        )
+
+    def test_quantifiers(self, g):
+        assert_results(
+            g,
+            "RETURN any(x IN [1,2] WHERE x > 1) AS a, all(x IN [1,2] WHERE x > 0) AS b, "
+            "none(x IN [1,2] WHERE x > 5) AS c, single(x IN [1,2] WHERE x = 2) AS d",
+            [{"a": True, "b": True, "c": True, "d": True}],
+        )
+
+    def test_reduce(self, g):
+        assert_results(
+            g,
+            "RETURN reduce(acc = 0, x IN [1,2,3] | acc + x) AS sum",
+            [{"sum": 6}],
+        )
+
+    def test_case_expressions(self, g):
+        assert_results(
+            g,
+            "MATCH (n:One) RETURN CASE n.i WHEN 1 THEN 'one' ELSE 'other' END AS simple, "
+            "CASE WHEN n.i > 0 THEN 'pos' WHEN n.i < 0 THEN 'neg' END AS generic",
+            [{"simple": "one", "generic": "pos"}],
+        )
+
+    def test_string_concat(self, g):
+        assert_results(
+            g,
+            "RETURN 'a' + 'b' AS ss, 'a' + 1 AS si, 1 + 'a' AS is_",
+            [{"ss": "ab", "si": "a1", "is_": "1a"}],
+        )
+
+    def test_coalesce(self, g):
+        assert_results(
+            g,
+            "MATCH (n:One) RETURN coalesce(n.missing, n.i, 99) AS c",
+            [{"c": 1}],
+        )
+
+    def test_id_labels_type_keys(self, g2=None, session=None):
+        pass  # covered in TestGraphFunctions below
+
+    def test_parameters(self, g):
+        assert_results(
+            g,
+            "RETURN $a + 1 AS x, $s AS s",
+            [{"x": 42, "s": "hi"}],
+            a=41,
+            s="hi",
+        )
+
+
+class TestGraphFunctions:
+    @pytest.fixture(scope="class")
+    def g(self, session):
+        return init_graph(
+            session,
+            "CREATE (a:Person:Employee {name:'Alice'})-[:KNOWS {since:2019}]->(b:Person {name:'Bob'})",
+        )
+
+    def test_labels(self, g):
+        assert_results(
+            g,
+            "MATCH (a) WHERE a.name = 'Alice' RETURN labels(a) AS l",
+            [{"l": ["Employee", "Person"]}],
+        )
+
+    def test_type(self, g):
+        assert_results(g, "MATCH ()-[r]->() RETURN type(r) AS t", [{"t": "KNOWS"}])
+
+    def test_keys_properties(self, g):
+        assert_results(
+            g,
+            "MATCH (b:Person {name:'Bob'}) RETURN keys(b) AS k, properties(b) AS p",
+            [{"k": ["name"], "p": {"name": "Bob"}}],
+        )
+
+    def test_id_and_equality(self, g):
+        assert_results(
+            g,
+            "MATCH (a:Person {name:'Alice'}), (b) WHERE id(a) = id(b) RETURN b.name",
+            [{"b.name": "Alice"}],
+        )
+
+    def test_startnode_endnode_via_match(self, g):
+        assert_results(
+            g,
+            "MATCH (a)-[r:KNOWS]->(b) RETURN a.name = startNode(r) OR true AS ok",
+            [{"ok": True}],
+        )
+
+    def test_exists_function(self, g):
+        assert_results(
+            g,
+            "MATCH (n:Person) RETURN n.name, exists(n.missing) AS m",
+            [
+                {"n.name": "Alice", "m": False},
+                {"n.name": "Bob", "m": False},
+            ],
+        )
+
+
+# ---------------------------------------------------------------------------
+# AggregationTests
+# ---------------------------------------------------------------------------
+
+
+class TestAggregation:
+    @pytest.fixture(scope="class")
+    def g(self, session):
+        return init_graph(
+            session,
+            "CREATE (:P {g:'x', v:1}), (:P {g:'x', v:3}), (:P {g:'y', v:5}), (:P {g:'y'})",
+        )
+
+    def test_count_star_and_count_expr(self, g):
+        assert_results(
+            g,
+            "MATCH (p:P) RETURN count(*) AS all, count(p.v) AS vals",
+            [{"all": 4, "vals": 3}],
+        )
+
+    def test_grouped(self, g):
+        assert_results(
+            g,
+            "MATCH (p:P) RETURN p.g AS grp, count(*) AS n, sum(p.v) AS s, min(p.v) AS mn, max(p.v) AS mx",
+            [
+                {"grp": "x", "n": 2, "s": 4, "mn": 1, "mx": 3},
+                {"grp": "y", "n": 2, "s": 5, "mn": 5, "mx": 5},
+            ],
+        )
+
+    def test_avg_collect(self, g):
+        assert_results(
+            g,
+            "MATCH (p:P {g:'x'}) RETURN avg(p.v) AS a, collect(p.v) AS c",
+            [{"a": 2.0, "c": [1, 3]}],
+        )
+
+    def test_distinct_agg(self, g):
+        assert_results(
+            g,
+            "MATCH (p:P) MATCH (q:P) RETURN count(DISTINCT p.g) AS dg",
+            [{"dg": 2}],
+        )
+
+    def test_agg_expression(self, g):
+        assert_results(
+            g,
+            "MATCH (p:P) RETURN count(*) + 1 AS n1, 2 * count(*) AS n2",
+            [{"n1": 5, "n2": 8}],
+        )
+
+    def test_stdev_percentiles(self, g):
+        r = results(
+            g,
+            "MATCH (p:P) WHERE p.v IS NOT NULL RETURN stDev(p.v) AS sd, "
+            "percentileCont(p.v, 0.5) AS pc, percentileDisc(p.v, 0.5) AS pd",
+        )
+        row = next(iter(r.counter))
+        assert abs(row["sd"] - 2.0) < 1e-9
+        assert row["pc"] == 3.0 and row["pd"] == 3
+
+    def test_empty_group_aggregates(self, g):
+        assert_results(
+            g,
+            "MATCH (p:P {g:'zzz'}) RETURN count(*) AS n, sum(p.v) AS s, collect(p.v) AS c, min(p.v) AS m",
+            [{"n": 0, "s": 0, "c": [], "m": None}],
+        )
+
+    def test_grouping_by_node(self, g):
+        assert_results(
+            g,
+            "MATCH (p:P {g: 'x'}) WITH p, count(*) AS c RETURN sum(c) AS total",
+            [{"total": 2}],
+        )
+
+
+# ---------------------------------------------------------------------------
+# WithTests / ReturnTests / UnwindTests / UnionTests
+# ---------------------------------------------------------------------------
+
+
+class TestHorizons:
+    @pytest.fixture(scope="class")
+    def g(self, session):
+        return init_graph(
+            session, "CREATE (:V {i:3}), (:V {i:1}), (:V {i:2}), (:V {i:2})"
+        )
+
+    def test_with_projection_narrows(self, g):
+        # after WITH only the projected field survives
+        from tpu_cypher.frontend.lexer import CypherSyntaxError
+        from tpu_cypher.ir.builder import IRBuildError
+
+        with pytest.raises(IRBuildError):
+            g.cypher("MATCH (v:V) WITH v.i AS i RETURN v")
+
+    def test_order_by_skip_limit(self, g):
+        got = [
+            dict(m)
+            for m in g.cypher(
+                "MATCH (v:V) RETURN v.i AS i ORDER BY i ASC SKIP 1 LIMIT 2"
+            ).records.collect()
+        ]
+        assert got == [{"i": 2}, {"i": 2}]
+
+    def test_order_desc(self, g):
+        got = [
+            dict(m)
+            for m in g.cypher("MATCH (v:V) RETURN v.i AS i ORDER BY i DESC LIMIT 2").records.collect()
+        ]
+        assert got == [{"i": 3}, {"i": 2}]
+
+    def test_order_by_expression(self, g):
+        got = [
+            dict(m)
+            for m in g.cypher("MATCH (v:V) RETURN v.i AS i ORDER BY -i LIMIT 1").records.collect()
+        ]
+        assert got == [{"i": 3}]
+
+    def test_distinct(self, g):
+        assert_results(
+            g,
+            "MATCH (v:V) RETURN DISTINCT v.i AS i",
+            [{"i": 1}, {"i": 2}, {"i": 3}],
+        )
+
+    def test_with_distinct(self, g):
+        assert_results(
+            g,
+            "MATCH (v:V) WITH DISTINCT v.i AS i RETURN count(*) AS n",
+            [{"n": 3}],
+        )
+
+    def test_return_star(self, g):
+        r = results(g, "MATCH (v:V {i:1}) RETURN *")
+        assert len(r) == 1
+
+    def test_with_star_extension(self, g):
+        assert_results(
+            g,
+            "MATCH (v:V {i: 1}) WITH *, v.i + 1 AS j RETURN j",
+            [{"j": 2}],
+        )
+
+    def test_alias_swap(self, g):
+        assert_results(
+            g,
+            "WITH 1 AS a, 2 AS b WITH a AS b, b AS a RETURN a, b",
+            [{"a": 2, "b": 1}],
+        )
+
+    def test_unwind(self, g):
+        assert_results(
+            g,
+            "UNWIND [1, 2, 3] AS x RETURN x",
+            [{"x": 1}, {"x": 2}, {"x": 3}],
+        )
+
+    def test_unwind_null_and_empty(self, g):
+        assert_results(g, "UNWIND [] AS x RETURN x", [])
+        assert_results(g, "UNWIND null AS x RETURN x", [])
+
+    def test_unwind_param(self, g):
+        assert_results(
+            g, "UNWIND $xs AS x RETURN x * 2 AS y", [{"y": 2}, {"y": 4}], xs=[1, 2]
+        )
+
+    def test_double_unwind(self, g):
+        assert_results(
+            g,
+            "UNWIND [1,2] AS x UNWIND ['a','b'] AS y RETURN x, y",
+            [
+                {"x": 1, "y": "a"},
+                {"x": 1, "y": "b"},
+                {"x": 2, "y": "a"},
+                {"x": 2, "y": "b"},
+            ],
+        )
+
+    def test_union_distinct_vs_all(self, g):
+        assert_results(
+            g,
+            "RETURN 1 AS x UNION RETURN 1 AS x",
+            [{"x": 1}],
+        )
+        assert_results(
+            g,
+            "RETURN 1 AS x UNION ALL RETURN 1 AS x",
+            [{"x": 1}, {"x": 1}],
+        )
+
+    def test_limit_zero(self, g):
+        assert_results(g, "MATCH (v:V) RETURN v.i LIMIT 0", [])
+
+
+# ---------------------------------------------------------------------------
+# BoundedVarExpandTests
+# ---------------------------------------------------------------------------
+
+
+class TestBoundedVarExpand:
+    @pytest.fixture(scope="class")
+    def g(self, session):
+        # chain: n1 -> n2 -> n3 -> n4
+        return init_graph(
+            session,
+            "CREATE (n1:N {i:1})-[:R]->(n2:N {i:2})-[:R]->(n3:N {i:3})-[:R]->(n4:N {i:4})",
+        )
+
+    def test_fixed_length_2(self, g):
+        assert_results(
+            g,
+            "MATCH (a:N)-[:R*2]->(b) RETURN a.i, b.i",
+            [{"a.i": 1, "b.i": 3}, {"a.i": 2, "b.i": 4}],
+        )
+
+    def test_range_1_to_3(self, g):
+        assert_results(
+            g,
+            "MATCH (a:N {i:1})-[rs:R*1..3]->(b) RETURN b.i, size(rs) AS n",
+            [
+                {"b.i": 2, "n": 1},
+                {"b.i": 3, "n": 2},
+                {"b.i": 4, "n": 3},
+            ],
+        )
+
+    def test_rel_list_binding(self, g):
+        r = results(g, "MATCH (a:N {i:1})-[rs:R*2]->(b) RETURN rs")
+        row = next(iter(r.counter))
+        assert len(row["rs"]) == 2
+        assert row["rs"][0].rel_type == "R"
+
+    def test_undirected_var_expand(self, g):
+        assert_results(
+            g,
+            "MATCH (a:N {i:2})-[:R*1]-(b) RETURN b.i",
+            [{"b.i": 1}, {"b.i": 3}],
+        )
+
+    def test_isomorphism_no_edge_reuse(self, g, session):
+        # a single undirected edge cannot be traversed back and forth
+        g2 = init_graph(session, "CREATE (x:A)-[:R]->(y:A)")
+        assert_results(g2, "MATCH (a:A)-[:R*2]-(b) RETURN a, b", [])
+
+
+# ---------------------------------------------------------------------------
+# Exists subqueries
+# ---------------------------------------------------------------------------
+
+
+class TestExists:
+    @pytest.fixture(scope="class")
+    def g(self, session):
+        return init_graph(
+            session,
+            "CREATE (a:P {n:'a'})-[:R]->(:Q), (:P {n:'b'})",
+        )
+
+    def test_pattern_predicate(self, g):
+        assert_results(g, "MATCH (p:P) WHERE (p)-[:R]->(:Q) RETURN p.n", [{"p.n": "a"}])
+
+    def test_negated_pattern_predicate(self, g):
+        assert_results(
+            g, "MATCH (p:P) WHERE NOT (p)-[:R]->(:Q) RETURN p.n", [{"p.n": "b"}]
+        )
+
+    def test_exists_keyword(self, g):
+        assert_results(
+            g, "MATCH (p:P) WHERE exists((p)-[:R]->()) RETURN p.n", [{"p.n": "a"}]
+        )
+
+
+# ---------------------------------------------------------------------------
+# Driving tables (reference DrivingTableTests)
+# ---------------------------------------------------------------------------
+
+
+class TestDrivingTable:
+    def test_driving_table_input(self, session):
+        g = init_graph(session, "CREATE (:Person {name:'Alice'}), (:Person {name:'Bob'})")
+        from tpu_cypher.backend.local.table import LocalTable
+
+        driving = LocalTable.from_columns({"who": ["Alice"]})
+        r = session.cypher(
+            "MATCH (p:Person) WHERE p.name = who RETURN p.name",
+            graph=g,
+            driving_table=driving,
+        )
+        assert r.records.to_bag() == Bag([{"p.name": "Alice"}])

@@ -1,0 +1,40 @@
+"""tpu-cypher: a TPU-native openCypher property-graph query engine.
+
+Brand-new framework with the capabilities of the reference CAPF/Morpheus
+stack (soerenreichardt/cypher-for-apache-flink): the backend-agnostic Cypher
+compiler pipeline (parse -> IR -> logical plan -> relational plan) bottoms out
+in an abstract Table algebra with two backends — a pure-Python local table
+(correctness oracle) and sharded JAX arrays on TPU.
+
+Quick start::
+
+    from tpu_cypher import CypherSession
+    session = CypherSession.local()
+    g = session.create_graph_from_create_query(
+        "CREATE (a:Person {name:'Alice'})-[:KNOWS]->(:Person {name:'Bob'})")
+    print(g.cypher("MATCH (a:Person)-[:KNOWS]->(b) RETURN a.name, b.name").show())
+"""
+
+from .api.mapping import NodeMappingBuilder, RelationshipMappingBuilder
+from .api.schema import PropertyGraphSchema, SchemaPattern
+from .api.values import CypherMap, Duration, Node, Relationship
+from .relational.graphs import ElementTable, ScanGraph
+from .relational.session import CypherResult, CypherSession, PropertyGraph
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "CypherSession",
+    "PropertyGraph",
+    "CypherResult",
+    "ElementTable",
+    "ScanGraph",
+    "PropertyGraphSchema",
+    "SchemaPattern",
+    "NodeMappingBuilder",
+    "RelationshipMappingBuilder",
+    "Node",
+    "Relationship",
+    "CypherMap",
+    "Duration",
+]

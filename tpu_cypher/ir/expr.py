@@ -505,6 +505,23 @@ class MapProjection(Expr):
     all_props: bool = False
 
 
+@dataclass(frozen=True)
+class PrefixId(Expr):
+    """Tag an element id with a graph prefix in the high bits.
+
+    TPU-native replacement for the reference's varint-prefix codegen
+    (``AddPrefix.scala:27-60`` / ``EncodeLong.scala:40-100``): ids stay fixed
+    width int64 — ``id | (tag << 54)`` is a cheap XLA bitwise op, where the
+    reference's byte-array prefixing is hostile to device columns.
+    """
+
+    expr: Expr
+    tag: int
+
+    def pretty_expr(self) -> str:
+        return f"prefix({self.expr.pretty_expr()}, {self.tag})"
+
+
 # ---------------------------------------------------------------------------
 # helpers
 # ---------------------------------------------------------------------------

@@ -1,0 +1,331 @@
+"""Relational property-graph implementations.
+
+Re-design of the reference's graph implementations
+(``okapi-relational/.../impl/graph/*.scala``): ``ScanGraph`` (a sequence of
+element tables; ``scanOperator`` selects matching scans, aligns their headers
+to the target and unions them — ``ScanGraph.scala:59-110``), ``UnionGraph``
+(members get a distinct id prefix then scans union — ``UnionGraph``/
+``PrefixedGraph``), and ``EmptyGraph``. Element tables pair a backend Table
+with an ``ElementMapping`` (``api/io/ElementTable.scala:43``)."""
+
+from __future__ import annotations
+
+import itertools
+from typing import Dict, List, Optional, Sequence, Tuple, Union
+
+from ..api import types as T
+from ..api.mapping import NodeMapping, RelationshipMapping
+from ..api.schema import PropertyGraphSchema
+from ..api.table import Table
+from ..ir import expr as E
+from .header import (
+    RecordHeader,
+    header_for_node,
+    header_for_relationship,
+)
+from .ops import (
+    EmptyRecordsOp,
+    RelationalOperator,
+    RelationalRuntimeContext,
+    TableOp,
+    UnionAllOp,
+)
+
+ElementMappingT = Union[NodeMapping, RelationshipMapping]
+
+
+class ElementTable:
+    """A backend table + mapping describing how its columns form elements."""
+
+    def __init__(self, mapping: ElementMappingT, table: Table):
+        self.mapping = mapping
+        self.table = table
+        missing = [c for c in mapping.all_columns if c not in table.physical_columns]
+        if missing:
+            raise ValueError(
+                f"Mapping references missing columns {missing}; table has "
+                f"{table.physical_columns}"
+            )
+
+    @property
+    def is_node(self) -> bool:
+        return isinstance(self.mapping, NodeMapping)
+
+    def schema(self) -> PropertyGraphSchema:
+        """Schema contributed by this table (reference ``ElementTable.schema``)."""
+        m = self.mapping
+        prop_types = {
+            key: self.table.column_type(col).nullable
+            for key, col in m.property_mapping
+        }
+        if isinstance(m, NodeMapping):
+            s = PropertyGraphSchema.empty()
+            opt = [l for l, _ in m.optional_labels]
+            for k in range(len(opt) + 1):
+                for subset in itertools.combinations(opt, k):
+                    s = s.with_node_combination(
+                        m.implied_labels | set(subset), prop_types
+                    )
+            return s
+        return PropertyGraphSchema.empty().with_relationship_type(
+            m.rel_type, prop_types
+        )
+
+
+class RelationalCypherGraph:
+    """Abstract graph (reference ``RelationalCypherGraph.scala:82``)."""
+
+    schema: PropertyGraphSchema
+
+    def scan_operator(
+        self, var_name: str, ct: T.CypherType, ctx: RelationalRuntimeContext
+    ) -> RelationalOperator:
+        raise NotImplementedError
+
+    # -- convenience -------------------------------------------------------
+
+    def node_scan(self, ctx, var_name: str = "n", labels=()) -> RelationalOperator:
+        return self.scan_operator(var_name, T.CTNodeType(labels), ctx)
+
+    def rel_scan(self, ctx, var_name: str = "r", types=()) -> RelationalOperator:
+        return self.scan_operator(var_name, T.CTRelationshipType(types), ctx)
+
+
+class EmptyGraph(RelationalCypherGraph):
+    def __init__(self):
+        self.schema = PropertyGraphSchema.empty()
+
+    def scan_operator(self, var_name, ct, ctx) -> RelationalOperator:
+        if isinstance(ct, T.CTNodeType):
+            h = header_for_node(var_name, ct, self.schema)
+        else:
+            h = header_for_relationship(var_name, ct, self.schema)
+        return EmptyRecordsOp(self, ctx, h)
+
+
+class ScanGraph(RelationalCypherGraph):
+    def __init__(
+        self,
+        scans: Sequence[ElementTable],
+        schema: Optional[PropertyGraphSchema] = None,
+    ):
+        self.scans = list(scans)
+        if schema is None:
+            schema = PropertyGraphSchema.empty()
+            for s in self.scans:
+                schema = schema + s.schema()
+        self.schema = schema
+
+    # ------------------------------------------------------------------
+
+    def scan_operator(self, var_name, ct, ctx) -> RelationalOperator:
+        if isinstance(ct, T.CTNodeType):
+            return self._node_scan_op(var_name, ct, ctx)
+        if isinstance(ct, T.CTRelationshipType):
+            return self._rel_scan_op(var_name, ct, ctx)
+        raise TypeError(f"Cannot scan for {ct!r}")
+
+    def _node_scan_op(self, var_name, ct: T.CTNodeType, ctx) -> RelationalOperator:
+        target = header_for_node(var_name, ct, self.schema)
+        var = E.Var(var_name).with_type(ct)
+        required = set(ct.labels)
+        aligned: List[RelationalOperator] = []
+        for et in self.scans:
+            if not et.is_node:
+                continue
+            m: NodeMapping = et.mapping
+            available = m.implied_labels | {l for l, _ in m.optional_labels}
+            if not required <= available:
+                continue
+            aligned.append(self._align_node(et, var, target, required, ctx))
+        return self._union(aligned, target, ctx)
+
+    def _align_node(
+        self, et: ElementTable, var: E.Var, target: RecordHeader, required, ctx
+    ) -> RelationalOperator:
+        m: NodeMapping = et.mapping
+        opt = dict(m.optional_labels)
+        props = dict(m.property_mapping)
+        t = et.table
+        # filter rows lacking a required-but-optional label
+        need_filter = [opt[l] for l in required if l in opt and l not in m.implied_labels]
+        rename: Dict[str, str] = {}
+        consts: List[Tuple[E.Expr, str]] = []
+        for e in target.expressions:
+            col = target.column(e)
+            if isinstance(e, E.Id):
+                rename[m.id_key] = col
+            elif isinstance(e, E.HasLabel):
+                if e.label in m.implied_labels:
+                    consts.append((E.Lit(True), col))
+                elif e.label in opt:
+                    rename[opt[e.label]] = col
+                else:
+                    consts.append((E.Lit(False), col))
+            elif isinstance(e, E.Property):
+                if e.key in props:
+                    rename[props[e.key]] = col
+                else:
+                    consts.append((E.Lit(None), col))
+        for c in need_filter:
+            t = t.filter(E.Var(c).with_type(T.CTBoolean), _col_header(c), {})
+        t = t.select([c for c in rename]).rename(rename)
+        if consts:
+            t = t.with_columns(consts, None, {})
+        t = t.select(target.columns)
+        return TableOp(self, ctx, target, t)
+
+    def _rel_scan_op(self, var_name, ct: T.CTRelationshipType, ctx) -> RelationalOperator:
+        target = header_for_relationship(var_name, ct, self.schema)
+        var = E.Var(var_name).with_type(ct)
+        wanted = ct.types or self.schema.relationship_types
+        aligned: List[RelationalOperator] = []
+        for et in self.scans:
+            if et.is_node:
+                continue
+            m: RelationshipMapping = et.mapping
+            if m.rel_type not in wanted:
+                continue
+            props = dict(m.property_mapping)
+            t = et.table
+            rename: Dict[str, str] = {}
+            consts: List[Tuple[E.Expr, str]] = []
+            for e in target.expressions:
+                col = target.column(e)
+                if isinstance(e, E.Id):
+                    rename[m.id_key] = col
+                elif isinstance(e, E.StartNode):
+                    rename[m.source_key] = col
+                elif isinstance(e, E.EndNode):
+                    rename[m.target_key] = col
+                elif isinstance(e, E.HasType):
+                    consts.append((E.Lit(e.rel_type == m.rel_type), col))
+                elif isinstance(e, E.Property):
+                    if e.key in props:
+                        rename[props[e.key]] = col
+                    else:
+                        consts.append((E.Lit(None), col))
+            t = t.select([c for c in rename]).rename(rename)
+            if consts:
+                t = t.with_columns(consts, None, {})
+            t = t.select(target.columns)
+            aligned.append(TableOp(self, ctx, target, t))
+        return self._union(aligned, target, ctx)
+
+    def _union(
+        self, ops: List[RelationalOperator], header: RecordHeader, ctx
+    ) -> RelationalOperator:
+        if not ops:
+            return EmptyRecordsOp(self, ctx, header)
+        out = ops[0]
+        for o in ops[1:]:
+            out = UnionAllOp(out, o)
+        return out
+
+
+class PrefixedGraph(RelationalCypherGraph):
+    """Wraps a graph, tagging all element ids with a prefix
+    (reference ``PrefixedGraph`` / ``RelationalOperator.PrefixGraph:185``)."""
+
+    def __init__(self, graph: RelationalCypherGraph, prefix: int):
+        self.graph = graph
+        self.prefix = prefix
+        self.schema = graph.schema
+
+    def scan_operator(self, var_name, ct, ctx) -> RelationalOperator:
+        op = self.graph.scan_operator(var_name, ct, ctx)
+        h = op.header
+        items: List[Tuple[E.Expr, str]] = []
+        for e in h.expressions:
+            if isinstance(e, (E.Id, E.StartNode, E.EndNode)):
+                items.append(
+                    (E.PrefixId(e, self.prefix).with_type(T.CTInteger), h.column(e))
+                )
+        t = op.table.with_columns(items, h, ctx.parameters)
+        return TableOp(self, ctx, h, t)
+
+
+class UnionGraph(RelationalCypherGraph):
+    """Union of member graphs with per-member id prefixes
+    (reference ``UnionGraph.scala``).
+
+    Nested unions are FLATTENED before tags are assigned: a single OR into the
+    tag bits does not compose (tag 2 then 1 == tag 1 then 2), so the member
+    list is the transitive closure of leaf graphs, each tagged once."""
+
+    def __init__(self, graphs: Sequence[RelationalCypherGraph]):
+        if not graphs:
+            raise ValueError("UnionGraph requires at least one member")
+        leaves: List[RelationalCypherGraph] = []
+
+        def flatten(g: RelationalCypherGraph):
+            if isinstance(g, UnionGraph):
+                for m in g.members:
+                    assert isinstance(m, PrefixedGraph)
+                    flatten(m.graph)
+            elif isinstance(g, PrefixedGraph):
+                flatten(g.graph)
+            else:
+                leaves.append(g)
+
+        for g in graphs:
+            flatten(g)
+        if len(leaves) >= (1 << 9):
+            raise ValueError("UnionGraph supports at most 511 member graphs")
+        self.members = [PrefixedGraph(g, i + 1) for i, g in enumerate(leaves)]
+        schema = PropertyGraphSchema.empty()
+        for g in graphs:
+            schema = schema + g.schema
+        self.schema = schema
+
+    def scan_operator(self, var_name, ct, ctx) -> RelationalOperator:
+        if isinstance(ct, T.CTNodeType):
+            target = header_for_node(var_name, ct, self.schema)
+        else:
+            target = header_for_relationship(var_name, ct, self.schema)
+        ops = []
+        for g in self.members:
+            member_schema = g.schema
+            if isinstance(ct, T.CTNodeType):
+                if ct.labels and not member_schema.combinations_for(ct.labels):
+                    continue
+            op = g.scan_operator(var_name, ct, ctx)
+            ops.append(_align_to(op, target, self, ctx))
+        if not ops:
+            return EmptyRecordsOp(self, ctx, target)
+        out = ops[0]
+        for o in ops[1:]:
+            out = UnionAllOp(out, o)
+        return out
+
+
+def _align_to(
+    op: RelationalOperator, target: RecordHeader, graph, ctx
+) -> RelationalOperator:
+    """Align a member scan to a wider union header: add missing label/property
+    columns as constants (reference ``RelationalPlanner.alignWith``)."""
+    h = op.header
+    t = op.table
+    rename: Dict[str, str] = {}
+    consts: List[Tuple[E.Expr, str]] = []
+    for e in target.expressions:
+        col = target.column(e)
+        if e in h:
+            if h.column(e) != col:
+                rename[h.column(e)] = col
+        elif isinstance(e, (E.HasLabel, E.HasType)):
+            consts.append((E.Lit(False), col))
+        else:
+            consts.append((E.Lit(None), col))
+    keep = [h.column(e) for e in target.expressions if e in h]
+    t = t.select(list(dict.fromkeys(keep)))
+    if rename:
+        t = t.rename(rename)
+    if consts:
+        t = t.with_columns(consts, None, {})
+    t = t.select(target.columns)
+    return TableOp(graph, ctx, target, t)
+
+
+def _col_header(col: str) -> RecordHeader:
+    return RecordHeader({E.Var(col).with_type(T.CTBoolean): col})

@@ -1,0 +1,59 @@
+"""Shared element materialization: header columns -> Node/Relationship values.
+
+Single source of truth used by both the result layer (``records.py``) and the
+local evaluator (``eval.py``) — the analog of the reference backends'
+``rowToCypherMap``."""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict
+
+from ..api.values import Node, Relationship
+from ..ir import expr as E
+from .header import RecordHeader
+
+RowFn = Callable[[Dict[str, Any]], Any]
+
+
+def node_materializer(header: RecordHeader, var: E.Var) -> RowFn:
+    id_col = header.column(header.id_expr(var))
+    label_cols = [(e.label, header.column(e)) for e in header.labels_for(var)]
+    prop_cols = [(e.key, header.column(e)) for e in header.properties_for(var)]
+
+    def make(r: Dict[str, Any]):
+        i = r.get(id_col)
+        if i is None:
+            return None
+        return Node(
+            i,
+            [l for l, c in label_cols if r.get(c)],
+            {k: r.get(c) for k, c in prop_cols if r.get(c) is not None},
+        )
+
+    return make
+
+
+def relationship_materializer(header: RecordHeader, var: E.Var) -> RowFn:
+    id_col = header.column(header.id_expr(var))
+    start_col = header.column(
+        next(e for e in header.expressions_for(var) if isinstance(e, E.StartNode))
+    )
+    end_col = header.column(
+        next(e for e in header.expressions_for(var) if isinstance(e, E.EndNode))
+    )
+    type_cols = [(e.rel_type, header.column(e)) for e in header.types_for(var)]
+    prop_cols = [(e.key, header.column(e)) for e in header.properties_for(var)]
+
+    def make(r: Dict[str, Any]):
+        i = r.get(id_col)
+        if i is None:
+            return None
+        return Relationship(
+            i,
+            r.get(start_col),
+            r.get(end_col),
+            next((t for t, c in type_cols if r.get(c)), ""),
+            {k: r.get(c) for k, c in prop_cols if r.get(c) is not None},
+        )
+
+    return make

@@ -1,0 +1,382 @@
+"""Relational planner: logical operators -> physical operator tree.
+
+Re-design of the reference ``RelationalPlanner``
+(``okapi-relational/.../impl/planning/RelationalPlanner.scala:55-610``):
+
+* Expand      = relationship scan + 2 hash joins (``:130-165``)
+* ExpandInto  = 1 join on both endpoints (``:167-189``)
+* undirected  = union of both rel orientations
+* Optional    = left outer join on the common fields (``:298``)
+* Exists      = distinct + true-flag + left outer join + IsNotNull (``:224-246``)
+* var-length  = bounded unrolled join loop with per-step edge-distinctness
+                filters (``VarLengthExpandPlanner.scala:45-330``)
+"""
+
+from __future__ import annotations
+
+import itertools
+from typing import Dict, List, Optional as Opt, Sequence, Tuple
+
+from ..api import types as T
+from ..ir import expr as E
+from ..logical import ops as L
+from .header import RecordHeader, header_for_node, header_for_relationship
+from .ops import (
+    AddOp,
+    AliasOp,
+    AggregateOp,
+    CacheOp,
+    DistinctOp,
+    DropOp,
+    EmptyRecordsOp,
+    FilterOp,
+    JoinOp,
+    LimitOp,
+    OrderByOp,
+    RelationalError,
+    RelationalOperator,
+    RelationalRuntimeContext,
+    SelectOp,
+    SkipOp,
+    StartOp,
+    SwapStartEndOp,
+    TableOp,
+    UnionAllOp,
+    UnwindOp,
+)
+
+
+class RelationalPlanner:
+    def __init__(self, ctx: RelationalRuntimeContext, driving_table=None, driving_header=None):
+        self.ctx = ctx
+        self.driving_table = driving_table
+        self.driving_header = driving_header
+        self._fresh = itertools.count()
+
+    def fresh(self, prefix: str) -> str:
+        return f"__{prefix}_{next(self._fresh)}"
+
+    # ------------------------------------------------------------------
+
+    def process(self, op: L.LogicalOperator) -> RelationalOperator:
+        # Memoize by logical-node identity: shared logical subtrees (Optional /
+        # Exists rhs contain the lhs subtree) map to the SAME relational
+        # operator, whose lazily computed table is cached — the analog of the
+        # reference's InsertCachingOperators duplicate-subtree pass
+        # (RelationalOptimizer.scala:41-90).
+        if not hasattr(self, "_memo"):
+            self._memo: Dict[int, RelationalOperator] = {}
+        key = id(op)
+        got = self._memo.get(key)
+        if got is not None:
+            return got
+        method = getattr(self, f"_plan_{type(op).__name__}", None)
+        if method is None:
+            raise RelationalError(f"No physical planning for {type(op).__name__}")
+        out = method(op)
+        self._memo[key] = out
+        return out
+
+    # -- leaves ---------------------------------------------------------
+
+    def _plan_Start(self, op: L.Start) -> RelationalOperator:
+        graph = self.ctx.resolve_graph(op.qgn)
+        return StartOp(graph, self.ctx)
+
+    def _plan_DrivingTable(self, op: L.DrivingTable) -> RelationalOperator:
+        graph = self.ctx.resolve_graph(op.qgn)
+        return StartOp(graph, self.ctx, self.driving_table, self.driving_header)
+
+    def _plan_EmptyRecords(self, op: L.EmptyRecords) -> RelationalOperator:
+        graph = self.ctx.resolve_graph(op.qgn)
+        h = RecordHeader()
+        for name, t in op.empty_fields:
+            m = t.material
+            if isinstance(m, T.CTNodeType):
+                h = header_for_node(name, m, graph.schema, h)
+            elif isinstance(m, T.CTRelationshipType):
+                h = header_for_relationship(name, m, graph.schema, h)
+            else:
+                h = h.with_expr(E.Var(name).with_type(t))
+        return EmptyRecordsOp(graph, self.ctx, h)
+
+    # -- scans ----------------------------------------------------------
+
+    def _plan_NodeScan(self, op: L.NodeScan) -> RelationalOperator:
+        in_plan = self.process(op.in_op)
+        scan = in_plan.graph.scan_operator(op.fld, op.node_type.material, self.ctx)
+        if in_plan.header.expressions:
+            return JoinOp(in_plan, scan, [], "cross")
+        return scan
+
+    # -- unary ----------------------------------------------------------
+
+    def _plan_Filter(self, op: L.Filter) -> RelationalOperator:
+        return FilterOp(self.process(op.in_op), op.predicate)
+
+    def _plan_Project(self, op: L.Project) -> RelationalOperator:
+        in_plan = self.process(op.in_op)
+        expr = op.projection
+        fld = op.fld
+        if fld is None:
+            return in_plan
+        if isinstance(expr, E.Var) and expr.name != fld:
+            # pure alias: share columns (reference Alias op)
+            existing = {v.name for v in in_plan.header.vars}
+            if expr.name in existing and fld not in existing:
+                orig = in_plan.header.var(expr.name)
+                alias = E.Var(fld).with_type(expr.cypher_type or orig.typ)
+                return AliasOp(in_plan, [(orig, alias)])
+        return AddOp(in_plan, expr, fld)
+
+    def _plan_Aggregate(self, op: L.Aggregate) -> RelationalOperator:
+        return AggregateOp(
+            self.process(op.in_op), [n for n, _ in op.group], list(op.aggregations)
+        )
+
+    def _plan_Distinct(self, op: L.Distinct) -> RelationalOperator:
+        return DistinctOp(self.process(op.in_op), list(op.on_fields))
+
+    def _plan_Select(self, op: L.Select) -> RelationalOperator:
+        return SelectOp(self.process(op.in_op), list(op.select_fields))
+
+    def _plan_OrderBy(self, op: L.OrderBy) -> RelationalOperator:
+        items = []
+        for s in op.sort_items:
+            assert isinstance(s.expr, E.Var), "sort exprs are pre-projected"
+            items.append((s.expr.name, s.ascending))
+        return OrderByOp(self.process(op.in_op), items)
+
+    def _plan_Skip(self, op: L.Skip) -> RelationalOperator:
+        return SkipOp(self.process(op.in_op), op.expr)
+
+    def _plan_Limit(self, op: L.Limit) -> RelationalOperator:
+        return LimitOp(self.process(op.in_op), op.expr)
+
+    def _plan_Unwind(self, op: L.Unwind) -> RelationalOperator:
+        return UnwindOp(self.process(op.in_op), op.list_expr, op.fld, op.fld_type)
+
+    def _plan_FromGraph(self, op: L.FromGraph) -> RelationalOperator:
+        in_plan = self.process(op.in_op)
+        graph = self.ctx.resolve_graph(op.qgn)
+        return TableOp(graph, self.ctx, in_plan.header, in_plan.table)
+
+    def _plan_ReturnGraph(self, op: L.ReturnGraph) -> RelationalOperator:
+        return self.process(op.in_op)
+
+    def _plan_ConstructGraph(self, op: L.ConstructGraph) -> RelationalOperator:
+        from .construct import plan_construct
+
+        return plan_construct(self, op)
+
+    # -- joins ----------------------------------------------------------
+
+    def _plan_CartesianProduct(self, op: L.CartesianProduct) -> RelationalOperator:
+        return JoinOp(self.process(op.lhs), self.process(op.rhs), [], "cross")
+
+    def _plan_ValueJoin(self, op: L.ValueJoin) -> RelationalOperator:
+        lhs, rhs = self.process(op.lhs), self.process(op.rhs)
+        pairs: List[Tuple[E.Expr, E.Expr]] = []
+        for eq in op.predicates:
+            assert isinstance(eq, E.Equals)
+            lhs, le = self._ensure_column(lhs, eq.lhs)
+            rhs, re_ = self._ensure_column(rhs, eq.rhs)
+            pairs.append((le, re_))
+        return JoinOp(lhs, rhs, pairs, "inner")
+
+    def _ensure_column(
+        self, plan: RelationalOperator, expr: E.Expr
+    ) -> Tuple[RelationalOperator, E.Expr]:
+        if expr in plan.header:
+            return plan, expr
+        fld = self.fresh("jkey")
+        plan = AddOp(plan, expr, fld)
+        return plan, E.Var(fld).with_type(expr.cypher_type)
+
+    def _common_join_pairs(
+        self, lhs: RelationalOperator, rhs: RelationalOperator
+    ) -> List[Tuple[E.Expr, E.Expr]]:
+        pairs = []
+        lh, rh = lhs.header, rhs.header
+        lvars = {v.name for v in lh.vars}
+        for v in rh.vars:
+            if v.name in lvars:
+                e = rh.id_expr(v)
+                if e in lh:
+                    pairs.append((e, e))
+        return pairs
+
+    def _plan_Optional(self, op: L.Optional) -> RelationalOperator:
+        lhs, rhs = self.process(op.lhs), self.process(op.rhs)
+        pairs = self._common_join_pairs(lhs, rhs)
+        return JoinOp(lhs, rhs, pairs, "left_outer")
+
+    def _plan_ExistsSubQuery(self, op: L.ExistsSubQuery) -> RelationalOperator:
+        lhs, rhs = self.process(op.lhs), self.process(op.rhs)
+        common = [
+            v.name
+            for v in rhs.header.vars
+            if any(v.name == lv.name for lv in lhs.header.vars)
+        ]
+        rhs_sel = DistinctOp(SelectOp(rhs, common), common)
+        flag = self.fresh("flag")
+        rhs_flag = AddOp(rhs_sel, E.Lit(True).with_type(T.CTBoolean), flag)
+        pairs = self._common_join_pairs(lhs, rhs_flag)
+        joined = JoinOp(lhs, rhs_flag, pairs, "left_outer")
+        flag_var = E.Var(flag).with_type(T.CTBoolean)
+        with_target = AddOp(
+            joined, E.IsNotNull(flag_var).with_type(T.CTBoolean), op.target_field
+        )
+        return DropOp(with_target, [flag_var])
+
+    def _plan_TabularUnionAll(self, op: L.TabularUnionAll) -> RelationalOperator:
+        return UnionAllOp(self.process(op.lhs), self.process(op.rhs))
+
+    # -- expands ---------------------------------------------------------
+
+    def _rel_scan(
+        self, graph, rel: str, rel_type, direction: str
+    ) -> RelationalOperator:
+        scan = graph.scan_operator(rel, rel_type.material, self.ctx)
+        if direction == "-":
+            return self._undirected(scan, rel)
+        return scan
+
+    @staticmethod
+    def _undirected(scan: RelationalOperator, rel: str) -> RelationalOperator:
+        """Union of both orientations; the swapped side excludes self-loops
+        (a loop's two orientations are the same variable binding, which
+        openCypher matches once)."""
+        var = scan.header.var(rel)
+        start = RelationalPlanner._start_of(scan, rel)
+        end = RelationalPlanner._end_of(scan, rel)
+        no_loop = FilterOp(
+            scan, E.Neq(start, end).with_type(T.CTBoolean)
+        )
+        return UnionAllOp(scan, SwapStartEndOp(no_loop, var))
+
+    @staticmethod
+    def _id_of(plan: RelationalOperator, name: str) -> E.Expr:
+        return plan.header.id_expr(plan.header.var(name))
+
+    @staticmethod
+    def _start_of(plan: RelationalOperator, rel: str) -> E.Expr:
+        v = plan.header.var(rel)
+        return next(
+            e for e in plan.header.expressions_for(v) if isinstance(e, E.StartNode)
+        )
+
+    @staticmethod
+    def _end_of(plan: RelationalOperator, rel: str) -> E.Expr:
+        v = plan.header.var(rel)
+        return next(
+            e for e in plan.header.expressions_for(v) if isinstance(e, E.EndNode)
+        )
+
+    def _plan_Expand(self, op: L.Expand) -> RelationalOperator:
+        """Reference ``RelationalPlanner.scala:130-165``: rel scan + 2 joins."""
+        lhs = self.process(op.lhs)
+        rhs = self.process(op.rhs)
+        graph = rhs.graph
+        rel_scan = self._rel_scan(graph, op.rel, op.rel_type, op.direction)
+        lhs_fields = {v.name for v in lhs.header.vars}
+        if op.source in lhs_fields:
+            first = JoinOp(
+                lhs,
+                rel_scan,
+                [(self._id_of(lhs, op.source), self._start_of(rel_scan, op.rel))],
+            )
+            return JoinOp(
+                first,
+                rhs,
+                [(self._end_of(first, op.rel), self._id_of(rhs, op.target))],
+            )
+        # lhs solves the target; expand backwards
+        first = JoinOp(
+            lhs,
+            rel_scan,
+            [(self._id_of(lhs, op.target), self._end_of(rel_scan, op.rel))],
+        )
+        return JoinOp(
+            first,
+            rhs,
+            [(self._start_of(first, op.rel), self._id_of(rhs, op.source))],
+        )
+
+    def _plan_ExpandInto(self, op: L.ExpandInto) -> RelationalOperator:
+        """Reference ``RelationalPlanner.scala:167-189``: single join on both
+        endpoints."""
+        in_plan = self.process(op.in_op)
+        graph = in_plan.graph
+        rel_scan = self._rel_scan(graph, op.rel, op.rel_type, op.direction)
+        return JoinOp(
+            in_plan,
+            rel_scan,
+            [
+                (self._id_of(in_plan, op.source), self._start_of(rel_scan, op.rel)),
+                (self._id_of(in_plan, op.target), self._end_of(rel_scan, op.rel)),
+            ],
+        )
+
+    def _plan_BoundedVarLengthExpand(
+        self, op: L.BoundedVarLengthExpand
+    ) -> RelationalOperator:
+        """Reference ``VarLengthExpandPlanner.scala:45-330``: unrolled iterated
+        join with per-step edge-distinctness (isomorphism) filters; union of
+        per-length results."""
+        if op.lower < 1:
+            raise RelationalError(
+                "Zero-length variable expansion (*0..) is not yet supported"
+            )
+        lhs = self.process(op.lhs)
+        rhs = self.process(op.rhs)
+        graph = rhs.graph
+        out_fields = [v.name for v in lhs.header.vars] + [op.target, op.rel]
+        rel_elem_type = op.rel_type.material
+
+        branches: List[RelationalOperator] = []
+        current = lhs
+        step_vars: List[str] = []
+        prev_end: E.Expr = self._id_of(lhs, op.source)
+        for step in range(1, op.upper + 1):
+            step_var = self.fresh(f"step_{op.rel}")
+            scan = graph.scan_operator(step_var, rel_elem_type, self.ctx)
+            if op.direction == "-":
+                scan = self._undirected(scan, step_var)
+            current = JoinOp(
+                current, scan, [(prev_end, self._start_of(scan, step_var))]
+            )
+            # isomorphism: this edge differs from all previous edges
+            for prev in step_vars:
+                neq = E.Neq(
+                    E.Id(E.Var(step_var).with_type(rel_elem_type)).with_type(T.CTInteger),
+                    E.Id(E.Var(prev).with_type(rel_elem_type)).with_type(T.CTInteger),
+                ).with_type(T.CTBoolean)
+                current = FilterOp(current, neq)
+            step_vars.append(step_var)
+            prev_end = self._end_of(current, step_var)
+            if step >= op.lower:
+                branch = JoinOp(
+                    current, rhs, [(prev_end, self._id_of(rhs, op.target))]
+                )
+                # materialize the rel-list variable
+                items = tuple(
+                    E.Var(s).with_type(rel_elem_type) for s in step_vars
+                )
+                list_expr = E.ListLit(items).with_type(T.CTListType(rel_elem_type))
+                branch = AddOp(branch, list_expr, op.rel)
+                branch = SelectOp(branch, out_fields)
+                branches.append(branch)
+        out = branches[0]
+        for b in branches[1:]:
+            out = UnionAllOp(out, b)
+        return out
+
+
+def plan_relational(
+    logical_plan: L.LogicalOperator,
+    ctx: RelationalRuntimeContext,
+    driving_table=None,
+    driving_header=None,
+) -> RelationalOperator:
+    return RelationalPlanner(ctx, driving_table, driving_header).process(logical_plan)

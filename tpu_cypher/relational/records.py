@@ -1,0 +1,64 @@
+"""Cypher records: user-facing result rows.
+
+Re-design of ``RelationalCypherRecords``
+(``okapi-relational/.../api/table/RelationalCypherRecords.scala:56``) and the
+backends' ``rowToCypherMap``: materializes header columns back into Cypher
+values (nodes/relationships reassembled from their id/label/property columns).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence
+
+from ..api import types as T
+from ..api.values import CypherMap, Node, Relationship
+from ..ir import expr as E
+from .header import RecordHeader
+
+
+class RelationalCypherRecords:
+    def __init__(self, header: RecordHeader, table, columns: Optional[Sequence[str]] = None):
+        self.header = header
+        self.table = table
+        if columns is None:
+            columns = [v.name for v in header.vars if not v.name.startswith("__")]
+        self.columns = list(columns)
+
+    @property
+    def size(self) -> int:
+        return self.table.size
+
+    def _materializers(self):
+        from .materialize import node_materializer, relationship_materializer
+
+        h = self.header
+        out = []
+        for name in self.columns:
+            var = h.var(name)
+            m = (var.cypher_type or T.CTAny.nullable).material
+            if isinstance(m, T.CTNodeType):
+                out.append((name, node_materializer(h, var)))
+            elif isinstance(m, T.CTRelationshipType):
+                out.append((name, relationship_materializer(h, var)))
+            else:
+                col = h.column(var)
+                out.append((name, lambda r, c=col: r.get(c)))
+        return out
+
+    def collect(self) -> List[CypherMap]:
+        mats = self._materializers()
+        return [CypherMap((n, f(r)) for n, f in mats) for r in self.table.rows()]
+
+    def to_bag(self):
+        from ..testing.bag import Bag
+
+        return Bag(self.collect())
+
+    def show(self, n: int = 20) -> str:
+        from ..utils.printer import format_rows
+
+        rows = [[m[c] for c in self.columns] for m in self.collect()[: max(n, 0)]]
+        return format_rows(self.columns, rows)
+
+    def __repr__(self) -> str:
+        return f"CypherRecords({self.size} rows: {', '.join(self.columns)})"

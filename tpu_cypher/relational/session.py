@@ -1,0 +1,245 @@
+"""The Cypher session: catalog + full query pipeline.
+
+Re-design of ``RelationalCypherSession``
+(``okapi-relational/.../api/graph/RelationalCypherSession.scala:63-270``) and
+the user-facing ``CypherSession``/``PropertyGraph``
+(``okapi-api/.../api/graph/CypherSession.scala:42`` /
+``PropertyGraph.scala:45``): mounts the ambient graph, runs
+parse -> IR -> logical plan -> optimize -> relational plan (all lazy —
+``RelationalCypherSession.scala:130-267``), manages the catalog of stored
+graphs and views, and supports driving tables (``readFrom``)."""
+
+from __future__ import annotations
+
+import itertools
+import re
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from ..api import types as T
+from ..api.mapping import NodeMapping, RelationshipMapping
+from ..api.schema import PropertyGraphSchema
+from ..frontend import ast as A
+from ..frontend.parser import parse as parse_cypher
+from ..ir import blocks as B
+from ..ir.builder import IRBuildError, IRBuilderContext, build_ir
+from ..logical.optimizer import optimize as optimize_logical
+from ..logical.planner import LogicalPlannerContext, plan_logical
+from ..utils.measurement import time_stage
+from .graphs import ElementTable, EmptyGraph, RelationalCypherGraph, ScanGraph, UnionGraph
+from .header import RecordHeader
+from .ops import RelationalRuntimeContext
+from .planner import plan_relational
+from .records import RelationalCypherRecords
+
+# ambient graphs mount under a reserved namespace ("ambient.") so they can
+# never clobber user catalog entries; one fresh name per query (the reference
+# mounts a fresh temp QGN per query too, RelationalCypherSession.scala:117)
+AMBIENT_NS = "ambient"
+SESSION_NS = "session"
+
+
+class CatalogError(Exception):
+    pass
+
+
+class CypherResult:
+    """Lazy result (reference ``RelationalCypherResult``)."""
+
+    def __init__(self, session, logical_plan, relational_plan, returns, graph=None):
+        self.session = session
+        self.logical_plan = logical_plan
+        self.relational_plan = relational_plan
+        self._returns = returns
+        self._graph = graph
+
+    @property
+    def records(self) -> Optional[RelationalCypherRecords]:
+        if self.relational_plan is None:
+            return None
+        return RelationalCypherRecords(
+            self.relational_plan.header, self.relational_plan.table, self._returns
+        )
+
+    @property
+    def graph(self):
+        if self._graph is not None:
+            return self._graph
+        if self.relational_plan is not None:
+            return self.relational_plan.graph
+        return None
+
+    @property
+    def plans(self) -> str:
+        out = []
+        if self.logical_plan is not None:
+            out.append("=== Logical plan ===\n" + self.logical_plan.pretty())
+        if self.relational_plan is not None:
+            out.append("=== Relational plan ===\n" + self.relational_plan.pretty())
+        return "\n\n".join(out)
+
+    def show(self, n: int = 20) -> str:
+        r = self.records
+        return r.show(n) if r is not None else "(no records)"
+
+
+class PropertyGraph:
+    """User-facing graph handle (reference ``PropertyGraph.scala:45``)."""
+
+    def __init__(self, session: "CypherSession", relational_graph: RelationalCypherGraph):
+        self.session = session
+        self._graph = relational_graph
+
+    @property
+    def schema(self) -> PropertyGraphSchema:
+        return self._graph.schema
+
+    def cypher(self, query: str, parameters: Optional[Dict[str, Any]] = None, **kw) -> CypherResult:
+        return self.session.cypher(query, parameters, graph=self, **kw)
+
+    def nodes(self, var: str = "n", labels: Sequence[str] = ()) -> RelationalCypherRecords:
+        ctx = self.session._runtime_context({})
+        op = self._graph.scan_operator(var, T.CTNodeType(labels), ctx)
+        return RelationalCypherRecords(op.header, op.table, [var])
+
+    def relationships(self, var: str = "r", types: Sequence[str] = ()) -> RelationalCypherRecords:
+        ctx = self.session._runtime_context({})
+        op = self._graph.scan_operator(var, T.CTRelationshipType(types), ctx)
+        return RelationalCypherRecords(op.header, op.table, [var])
+
+    def union(self, *others: "PropertyGraph") -> "PropertyGraph":
+        return PropertyGraph(
+            self.session, UnionGraph([self._graph] + [o._graph for o in others])
+        )
+
+
+class CypherSession:
+    """Reference ``CypherSession``/``RelationalCypherSession``."""
+
+    def __init__(self, table_cls):
+        self.table_cls = table_cls
+        self._catalog: Dict[str, RelationalCypherGraph] = {}
+        self._views: Dict[str, Tuple[Tuple[str, ...], str]] = {}
+        self._counter = itertools.count()
+
+    # -- factories ---------------------------------------------------------
+
+    @staticmethod
+    def local() -> "CypherSession":
+        from ..backend.local.table import LocalTable
+
+        return CypherSession(LocalTable)
+
+    # -- catalog -----------------------------------------------------------
+
+    def _qualify(self, name: str) -> str:
+        return name if "." in name else f"{SESSION_NS}.{name}"
+
+    def store_graph(self, name: str, graph: PropertyGraph):
+        self._catalog[self._qualify(name)] = graph._graph
+
+    def graph(self, name: str) -> PropertyGraph:
+        qgn = self._qualify(name)
+        if qgn not in self._catalog:
+            raise CatalogError(f"Graph {qgn!r} not in catalog")
+        return PropertyGraph(self, self._catalog[qgn])
+
+    def drop_graph(self, name: str):
+        self._catalog.pop(self._qualify(name), None)
+
+    @property
+    def catalog_names(self) -> List[str]:
+        return sorted(
+            n for n in self._catalog if not n.startswith(AMBIENT_NS + ".")
+        )
+
+    # -- graph construction ------------------------------------------------
+
+    def read_from(self, *element_tables: ElementTable) -> PropertyGraph:
+        """Reference ``RelationalCypherSession.readFrom`` (``:81``)."""
+        return PropertyGraph(self, ScanGraph(list(element_tables)))
+
+    def create_graph_from_create_query(self, create_query: str) -> PropertyGraph:
+        from ..testing.create_graph import graph_from_create_query
+
+        return graph_from_create_query(self, create_query)
+
+    # -- runtime -----------------------------------------------------------
+
+    def _runtime_context(self, parameters: Dict[str, Any]) -> RelationalRuntimeContext:
+        def resolve(qgn: str) -> RelationalCypherGraph:
+            if qgn in self._catalog:
+                return self._catalog[qgn]
+            raise CatalogError(f"Graph {qgn!r} not in catalog")
+
+        return RelationalRuntimeContext(resolve, dict(parameters or {}), self.table_cls)
+
+    # -- the pipeline ------------------------------------------------------
+
+    def cypher(
+        self,
+        query: str,
+        parameters: Optional[Dict[str, Any]] = None,
+        graph: Optional[PropertyGraph] = None,
+        driving_table=None,
+    ) -> CypherResult:
+        parameters = dict(parameters or {})
+        ambient = graph._graph if graph is not None else EmptyGraph()
+        ambient_qgn = f"{AMBIENT_NS}.q{next(self._counter)}"
+        self._catalog[ambient_qgn] = ambient  # mountAmbientGraph (reference :117)
+
+        stmt = time_stage("parse", parse_cypher, query)
+
+        input_fields: Dict[str, T.CypherType] = {}
+        driving_header = None
+        if driving_table is not None:
+            driving_header = RecordHeader()
+            from ..ir import expr as E
+
+            for col in driving_table.physical_columns:
+                t = driving_table.column_type(col)
+                input_fields[col] = t
+                driving_header = driving_header.with_expr(E.Var(col).with_type(t), col)
+
+        ir_ctx = IRBuilderContext(
+            schema=ambient.schema,
+            parameters=parameters,
+            catalog_schemas={qgn: g.schema for qgn, g in self._catalog.items()},
+            working_graph=ambient_qgn,
+            input_fields=input_fields,
+        )
+        ir = time_stage("ir", build_ir, stmt, ir_ctx)
+
+        # catalog statements
+        if isinstance(ir, B.CreateGraphIR):
+            inner = self._plan_and_run(ir.inner, parameters, input_fields, driving_table, driving_header, ambient_qgn)
+            result_graph = inner.graph
+            if result_graph is None:
+                raise CatalogError("CREATE GRAPH inner query must return a graph")
+            self._catalog[self._qualify(ir.qgn)] = result_graph
+            return CypherResult(self, None, None, None, graph=PropertyGraph(self, result_graph))
+        if isinstance(ir, B.CreateViewIR):
+            self._views[ir.name] = (ir.params, ir.inner_text)
+            return CypherResult(self, None, None, None)
+        if isinstance(ir, B.DropGraphIR):
+            if ir.view:
+                self._views.pop(ir.qgn, None)
+            else:
+                self.drop_graph(ir.qgn)
+            return CypherResult(self, None, None, None)
+
+        return self._plan_and_run(ir, parameters, input_fields, driving_table, driving_header, ambient_qgn)
+
+    def _plan_and_run(
+        self, ir, parameters, input_fields, driving_table, driving_header, ambient_qgn
+    ) -> CypherResult:
+        lctx = LogicalPlannerContext(ambient_qgn, tuple(input_fields.items()))
+        logical = time_stage("logical", plan_logical, ir, lctx)
+        logical = time_stage(
+            "logical_opt", optimize_logical, logical, self._catalog[ambient_qgn].schema
+        )
+        rctx = self._runtime_context(parameters)
+        relational = time_stage(
+            "relational", plan_relational, logical, rctx, driving_table, driving_header
+        )
+        returns = getattr(ir, "returns", None)
+        return CypherResult(self, logical, relational, returns)

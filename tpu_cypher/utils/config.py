@@ -1,0 +1,56 @@
+"""Typed config flags backed by environment variables.
+
+Re-design of the reference's ``ConfigOption``/``ConfigFlag`` system
+(``okapi-api/.../impl/configuration/ConfigOption.scala:31-60``; per-layer flag
+objects like ``CoraConfiguration.scala:33-39``): JVM system properties become
+environment variables with in-process overrides."""
+
+from __future__ import annotations
+
+import os
+from typing import Callable, Generic, Optional, TypeVar
+
+T = TypeVar("T")
+
+
+class ConfigOption(Generic[T]):
+    def __init__(self, name: str, default: T, parse: Callable[[str], T]):
+        self.name = name
+        self.default = default
+        self.parse = parse
+        self._override: Optional[T] = None
+
+    def get(self) -> T:
+        if self._override is not None:
+            return self._override
+        raw = os.environ.get(self.name)
+        if raw is None:
+            return self.default
+        try:
+            return self.parse(raw)
+        except ValueError:
+            return self.default
+
+    def set(self, value: T):
+        self._override = value
+
+    def reset(self):
+        self._override = None
+
+
+def _parse_bool(s: str) -> bool:
+    return s.strip().lower() in ("1", "true", "yes", "on")
+
+
+class ConfigFlag(ConfigOption[bool]):
+    def __init__(self, name: str, default: bool = False):
+        super().__init__(name, default, _parse_bool)
+
+
+# per-stage debug flags (reference PrintTimings / PrintIr / PrintLogicalPlan /
+# PrintRelationalPlan / PrintOptimizedRelationalPlan, Configuration.scala:36,
+# CoraConfiguration.scala:33-39)
+PRINT_TIMINGS = ConfigFlag("TPU_CYPHER_PRINT_TIMINGS")
+PRINT_IR = ConfigFlag("TPU_CYPHER_PRINT_IR")
+PRINT_LOGICAL = ConfigFlag("TPU_CYPHER_PRINT_LOGICAL_PLAN")
+PRINT_RELATIONAL = ConfigFlag("TPU_CYPHER_PRINT_RELATIONAL_PLAN")

@@ -1,0 +1,28 @@
+"""Stage timing (reference ``Measurement.scala:36-56`` + ``PrintTimings`` flag)."""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, Dict, List, Tuple
+
+from .config import PRINT_TIMINGS
+
+_TIMINGS: List[Tuple[str, float]] = []
+
+
+def time_stage(name: str, fn: Callable, *args, **kw):
+    t0 = time.perf_counter()
+    out = fn(*args, **kw)
+    dt = time.perf_counter() - t0
+    _TIMINGS.append((name, dt))
+    if PRINT_TIMINGS.get():
+        print(f"[timing] {name}: {dt * 1000:.2f} ms")
+    return out
+
+
+def last_timings() -> Dict[str, float]:
+    return dict(_TIMINGS[-16:])
+
+
+def clear_timings():
+    _TIMINGS.clear()
