@@ -2,15 +2,24 @@
 
 Mirrors SURVEY.md §4's "cluster testing without a cluster": sharded plans are
 validated on host CPU devices so no TPU pod is needed (the reference's analog
-is Spark local[*] / Flink local ExecutionEnvironment).
-"""
+is Spark local[*] / Flink local ExecutionEnvironment). The real chip is
+reserved for bench.py."""
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 os.environ.setdefault("JAX_ENABLE_X64", "1")
+
+import jax
+
+# belt and braces: some environments pre-select an accelerator platform
+# before env vars are read (e.g. an externally initialized plugin)
+try:
+    jax.config.update("jax_platforms", "cpu")
+except Exception:
+    pass

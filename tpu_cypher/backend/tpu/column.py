@@ -1,0 +1,261 @@
+"""Device columns: typed JAX arrays + validity masks.
+
+The TPU-native data layout replacing the reference backends' engine columns
+(Spark ``Column`` / Flink ``Expression``): every column is a fixed-width
+device array plus an optional validity mask (Cypher null != padding; the
+table-level row mask lives in ``TpuTable``). Strings are dictionary-encoded
+with an ORDER-PRESERVING vocabulary (sorted), so <,<=,ORDER BY work on codes
+without touching host strings. Ids are int64 (graph tag in high bits — see
+``ir.expr.PrefixId``)."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+import jax
+
+# int64 element ids are load-bearing (graph tags live in bits 54+); the
+# backend cannot run in 32-bit mode
+jax.config.update("jax_enable_x64", True)
+
+import jax.numpy as jnp
+
+from ...api import types as T
+from ...api.types import CypherType
+
+# column kinds
+I64 = "i64"
+F64 = "f64"
+BOOL = "bool"
+STR = "str"  # dictionary-encoded int32 codes
+OBJ = "obj"  # host-side Python objects (lists, elements) — not device resident
+
+_NULL_CODE = np.int32(-1)
+
+
+class TpuBackendError(Exception):
+    pass
+
+
+@dataclass
+class Column:
+    kind: str
+    data: Any  # jnp array (device) or np object array for OBJ
+    valid: Optional[Any]  # jnp bool array or None (= all valid)
+    vocab: Optional[List[str]] = None  # sorted, for STR
+
+    def __len__(self) -> int:
+        return int(self.data.shape[0]) if self.kind != OBJ else len(self.data)
+
+    # -- conversion --------------------------------------------------------
+
+    @staticmethod
+    def from_values(values: Sequence[Any]) -> "Column":
+        """Infer kind from Python values (None = null)."""
+        non_null = [v for v in values if v is not None]
+        n = len(values)
+        valid_np = np.array([v is not None for v in values], dtype=bool)
+        has_null = not valid_np.all()
+        if not non_null:
+            return Column(I64, jnp.zeros(n, jnp.int64), jnp.zeros(n, bool))
+        if all(isinstance(v, bool) for v in non_null):
+            data = np.array([bool(v) if v is not None else False for v in values])
+            return Column(BOOL, jnp.asarray(data), jnp.asarray(valid_np) if has_null else None)
+        if all(isinstance(v, int) and not isinstance(v, bool) for v in non_null):
+            data = np.array([int(v) if v is not None else 0 for v in values], dtype=np.int64)
+            return Column(I64, jnp.asarray(data), jnp.asarray(valid_np) if has_null else None)
+        if all(isinstance(v, (int, float)) and not isinstance(v, bool) for v in non_null):
+            data = np.array(
+                [float(v) if v is not None else 0.0 for v in values], dtype=np.float64
+            )
+            return Column(F64, jnp.asarray(data), jnp.asarray(valid_np) if has_null else None)
+        if all(isinstance(v, str) for v in non_null):
+            vocab = sorted(set(non_null))
+            index = {s: i for i, s in enumerate(vocab)}
+            codes = np.array(
+                [index[v] if v is not None else _NULL_CODE for v in values],
+                dtype=np.int32,
+            )
+            return Column(
+                STR,
+                jnp.asarray(codes),
+                jnp.asarray(valid_np) if has_null else None,
+                vocab,
+            )
+        # fallback: host objects
+        arr = np.empty(n, dtype=object)
+        for i, v in enumerate(values):
+            arr[i] = v
+        return Column(OBJ, arr, None)
+
+    def to_values(self, row_mask: Optional[np.ndarray] = None) -> List[Any]:
+        """Decode to Python values (respecting validity)."""
+        if self.kind == OBJ:
+            vals = list(self.data)
+        else:
+            data = np.asarray(self.data)
+            valid = np.asarray(self.valid) if self.valid is not None else None
+            if self.kind == I64:
+                vals = [
+                    int(v) if (valid is None or valid[i]) else None
+                    for i, v in enumerate(data)
+                ]
+            elif self.kind == F64:
+                vals = [
+                    float(v) if (valid is None or valid[i]) else None
+                    for i, v in enumerate(data)
+                ]
+            elif self.kind == BOOL:
+                vals = [
+                    bool(v) if (valid is None or valid[i]) else None
+                    for i, v in enumerate(data)
+                ]
+            elif self.kind == STR:
+                vocab = self.vocab or []
+                vals = [
+                    (vocab[v] if v >= 0 else None)
+                    if (valid is None or valid[i])
+                    else None
+                    for i, v in enumerate(data)
+                ]
+            else:  # pragma: no cover
+                raise TpuBackendError(self.kind)
+        if row_mask is not None:
+            vals = [v for v, keep in zip(vals, row_mask) if keep]
+        return vals
+
+    # -- ops ---------------------------------------------------------------
+
+    def take(self, idx) -> "Column":
+        """Gather rows by index array (device gather)."""
+        if self.kind == OBJ:
+            return Column(OBJ, self.data[np.asarray(idx)], None)
+        data = jnp.take(self.data, idx, axis=0)
+        valid = jnp.take(self.valid, idx, axis=0) if self.valid is not None else None
+        return Column(self.kind, data, valid, self.vocab)
+
+    def take_or_null(self, idx, in_bounds) -> "Column":
+        """Gather; rows where ``in_bounds`` is False become null (outer joins)."""
+        n = int(idx.shape[0]) if hasattr(idx, "shape") else len(idx)
+        if len(self) == 0:
+            # empty build side: every row is an outer-join null
+            if self.kind == OBJ:
+                out = np.empty(n, dtype=object)
+                return Column(OBJ, out, None)
+            dtype = self.data.dtype
+            return Column(
+                self.kind,
+                jnp.zeros(n, dtype),
+                jnp.zeros(n, bool),
+                self.vocab,
+            )
+        if self.kind == OBJ:
+            out = np.empty(len(idx), dtype=object)
+            idx_np = np.asarray(idx)
+            ib = np.asarray(in_bounds)
+            for i in range(len(idx_np)):
+                out[i] = self.data[idx_np[i]] if ib[i] else None
+            return Column(OBJ, out, None)
+        safe = jnp.where(in_bounds, idx, 0)
+        data = jnp.take(self.data, safe, axis=0)
+        valid = (
+            jnp.take(self.valid, safe, axis=0) if self.valid is not None else jnp.ones(len(idx), bool)
+        )
+        return Column(self.kind, data, valid & in_bounds, self.vocab)
+
+    def concat(self, other: "Column") -> "Column":
+        a, b = self, other
+        if a.kind != b.kind:
+            # unify: promote numerics, else objects
+            if {a.kind, b.kind} == {I64, F64}:
+                a = a.cast_f64()
+                b = b.cast_f64()
+            else:
+                a = a.to_obj()
+                b = b.to_obj()
+        if a.kind == OBJ:
+            return Column(OBJ, np.concatenate([a.data, b.data]), None)
+        if a.kind == STR:
+            a, b = _unify_vocab(a, b)
+        data = jnp.concatenate([a.data, b.data])
+        if a.valid is None and b.valid is None:
+            valid = None
+        else:
+            av = a.valid if a.valid is not None else jnp.ones(len(a), bool)
+            bv = b.valid if b.valid is not None else jnp.ones(len(b), bool)
+            valid = jnp.concatenate([av, bv])
+        return Column(a.kind, data, valid, a.vocab)
+
+    def cast_f64(self) -> "Column":
+        if self.kind == F64:
+            return self
+        if self.kind == I64:
+            return Column(F64, self.data.astype(jnp.float64), self.valid)
+        raise TpuBackendError(f"Cannot cast {self.kind} to f64")
+
+    def to_obj(self) -> "Column":
+        return Column(OBJ, np.array(self.to_values(), dtype=object), None)
+
+    def valid_mask(self) -> Any:
+        if self.kind == OBJ:
+            return jnp.asarray(np.array([v is not None for v in self.data], bool))
+        if self.valid is None:
+            return jnp.ones(len(self), bool)
+        return self.valid
+
+    def sort_key(self, descending: bool = False):
+        """A numeric array whose ascending order == Cypher orderability
+        (nulls last ascending). Returns (primary, is_null) pair arrays."""
+        if self.kind == OBJ:
+            raise TpuBackendError("Cannot sort object columns on device")
+        null = ~np.asarray(self.valid_mask())
+        data = np.asarray(self.data, dtype=np.float64 if self.kind == F64 else None)
+        return data, null
+
+    def cypher_type(self) -> CypherType:
+        base = {
+            I64: T.CTInteger,
+            F64: T.CTFloat,
+            BOOL: T.CTBoolean,
+            STR: T.CTString,
+            OBJ: T.CTAny,
+        }[self.kind]
+        has_null = self.valid is not None or self.kind == OBJ
+        return base.nullable if has_null else base
+
+
+def _unify_vocab(a: Column, b: Column) -> Tuple[Column, Column]:
+    if a.vocab == b.vocab:
+        return a, b
+    merged = sorted(set(a.vocab or []) | set(b.vocab or []))
+    return _remap(a, merged), _remap(b, merged)
+
+
+def _remap(c: Column, merged: List[str]) -> Column:
+    old = c.vocab or []
+    lut = np.array(
+        [merged.index(s) for s in old] + [0], dtype=np.int32
+    )  # extra slot for null code indexing
+    codes = np.asarray(c.data)
+    new_codes = np.where(codes >= 0, lut[np.clip(codes, 0, len(old) - 1 if old else 0)], _NULL_CODE)
+    return Column(STR, jnp.asarray(new_codes.astype(np.int32)), c.valid, merged)
+
+
+def constant_column(value: Any, n: int) -> Column:
+    if value is None:
+        return Column(I64, jnp.zeros(n, jnp.int64), jnp.zeros(n, bool))
+    if isinstance(value, bool):
+        return Column(BOOL, jnp.full(n, value, dtype=bool), None)
+    if isinstance(value, int):
+        return Column(I64, jnp.full(n, value, dtype=jnp.int64), None)
+    if isinstance(value, float):
+        return Column(F64, jnp.full(n, value, dtype=jnp.float64), None)
+    if isinstance(value, str):
+        return Column(STR, jnp.zeros(n, jnp.int32), None, [value])
+    arr = np.empty(n, dtype=object)
+    for i in range(n):
+        arr[i] = value
+    return Column(OBJ, arr, None)

@@ -1,0 +1,329 @@
+"""Expr -> JAX compiler: typed expressions over device columns.
+
+The TPU analog of the reference's SQL expression mappers
+(``FlinkSQLExprMapper.scala:48`` / ``SparkSQLExprMapper.scala``): each Expr
+becomes vectorized jnp ops over ``Column``s with (data, valid) null masks and
+Kleene three-valued logic on booleans. Expressions this compiler does not
+support raise ``TpuUnsupportedExpr`` and the table falls back to the
+reference (local) evaluator — the hot relational path (ids, labels, numeric
+predicates, arithmetic) is fully device-resident."""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from ...api import types as T
+from ...ir import expr as E
+from .column import BOOL, F64, I64, OBJ, STR, Column, TpuBackendError, constant_column
+
+
+class TpuUnsupportedExpr(TpuBackendError):
+    pass
+
+
+class TpuEvaluator:
+    def __init__(self, table, header, parameters: Dict[str, Any]):
+        self.table = table
+        self.header = header
+        self.params = parameters or {}
+        self.n = table.size
+
+    # ------------------------------------------------------------------
+
+    def eval(self, expr: E.Expr) -> Column:
+        col = self.header.get(expr) if self.header is not None else None
+        if col is not None and col in self.table._cols:
+            return self.table._cols[col]
+
+        if isinstance(expr, E.Lit):
+            return constant_column(expr.value, self.n)
+        if isinstance(expr, E.Param):
+            return constant_column(self.params.get(expr.name), self.n)
+        if isinstance(expr, E.PrefixId):
+            inner = self.eval(expr.expr)
+            if inner.kind != I64:
+                raise TpuUnsupportedExpr("prefix on non-id column")
+            return Column(I64, inner.data | (jnp.int64(expr.tag) << 54), inner.valid)
+        if isinstance(expr, E.IsNull):
+            inner = self.eval(expr.expr)
+            return Column(BOOL, ~inner.valid_mask(), None)
+        if isinstance(expr, E.IsNotNull):
+            inner = self.eval(expr.expr)
+            return Column(BOOL, inner.valid_mask(), None)
+        if isinstance(expr, E.Not):
+            inner = self._as_bool(self.eval(expr.expr))
+            return Column(BOOL, ~inner.data, inner.valid)
+        if isinstance(expr, E.Ands):
+            return self._connective(expr.exprs, is_and=True)
+        if isinstance(expr, E.Ors):
+            return self._connective(expr.exprs, is_and=False)
+        if isinstance(expr, E.Xor):
+            l = self._as_bool(self.eval(expr.lhs))
+            r = self._as_bool(self.eval(expr.rhs))
+            valid = _and_valid(l, r)
+            return Column(BOOL, l.data ^ r.data, valid)
+        if isinstance(expr, (E.Equals, E.Neq)):
+            return self._equality(expr)
+        if isinstance(
+            expr, (E.LessThan, E.LessThanOrEqual, E.GreaterThan, E.GreaterThanOrEqual)
+        ):
+            return self._comparison(expr)
+        if isinstance(expr, E.In):
+            return self._in(expr)
+        if isinstance(expr, E.Neg):
+            inner = self.eval(expr.expr)
+            if inner.kind not in (I64, F64):
+                raise TpuUnsupportedExpr("negate non-numeric")
+            return Column(inner.kind, -inner.data, inner.valid)
+        if isinstance(expr, E.ArithmeticExpr):
+            return self._arith(expr)
+        if isinstance(expr, E.CaseExpr):
+            return self._case(expr)
+        if isinstance(expr, E.FunctionCall):
+            return self._function(expr)
+        raise TpuUnsupportedExpr(type(expr).__name__)
+
+    # ------------------------------------------------------------------
+
+    def _as_bool(self, c: Column) -> Column:
+        if c.kind != BOOL:
+            raise TpuUnsupportedExpr(f"expected boolean, got {c.kind}")
+        return c
+
+    def _connective(self, exprs, is_and: bool) -> Column:
+        cols = [self._as_bool(self.eval(e)) for e in exprs]
+        vals = [c.data for c in cols]
+        valids = [c.valid_mask() for c in cols]
+        if is_and:
+            # false if any (valid & ~val); true if all (valid & val)
+            any_false = jnp.zeros(self.n, bool)
+            all_true = jnp.ones(self.n, bool)
+            for v, m in zip(vals, valids):
+                any_false = any_false | (m & ~v)
+                all_true = all_true & (m & v)
+            return Column(BOOL, all_true, any_false | all_true)
+        any_true = jnp.zeros(self.n, bool)
+        all_false = jnp.ones(self.n, bool)
+        for v, m in zip(vals, valids):
+            any_true = any_true | (m & v)
+            all_false = all_false & (m & ~v)
+        return Column(BOOL, any_true, any_true | all_false)
+
+    def _coerce_pair(self, l: Column, r: Column):
+        if l.kind == r.kind:
+            if l.kind == STR:
+                from .column import _unify_vocab
+
+                return _unify_vocab(l, r)
+            return l, r
+        if {l.kind, r.kind} == {I64, F64}:
+            return l.cast_f64(), r.cast_f64()
+        raise TpuUnsupportedExpr(f"compare {l.kind} vs {r.kind}")
+
+    def _equality(self, expr) -> Column:
+        l, r = self.eval(expr.lhs), self.eval(expr.rhs)
+        if OBJ in (l.kind, r.kind):
+            raise TpuUnsupportedExpr("equality on object columns")
+        try:
+            l, r = self._coerce_pair(l, r)
+            eq = l.data == r.data
+        except TpuUnsupportedExpr:
+            # cross-kind equality (e.g. string vs int) is False, not error
+            eq = jnp.zeros(self.n, bool)
+        valid = _and_valid(l, r)
+        if isinstance(expr, E.Neq):
+            eq = ~eq
+        return Column(BOOL, eq, valid)
+
+    def _comparison(self, expr) -> Column:
+        l, r = self.eval(expr.lhs), self.eval(expr.rhs)
+        if OBJ in (l.kind, r.kind) or BOOL in (l.kind, r.kind):
+            raise TpuUnsupportedExpr("comparison on object/bool columns")
+        l, r = self._coerce_pair(l, r)
+        if isinstance(expr, E.LessThan):
+            v = l.data < r.data
+        elif isinstance(expr, E.LessThanOrEqual):
+            v = l.data <= r.data
+        elif isinstance(expr, E.GreaterThan):
+            v = l.data > r.data
+        else:
+            v = l.data >= r.data
+        valid = _and_valid(l, r)
+        if l.kind == F64:
+            nan = jnp.isnan(l.data) | jnp.isnan(r.data)
+            v = jnp.where(nan, False, v)
+        return Column(BOOL, v, valid)
+
+    def _in(self, expr) -> Column:
+        if not isinstance(expr.rhs, E.ListLit) or not all(
+            isinstance(i, E.Lit) for i in expr.rhs.items
+        ):
+            raise TpuUnsupportedExpr("IN on non-literal list")
+        values = [i.value for i in expr.rhs.items]
+        l = self.eval(expr.lhs)
+        if l.kind == I64 and any(isinstance(v, float) for v in values):
+            # cross-type numeric equality: 23 IN [23.0] is true
+            l = l.cast_f64()
+        if l.kind == I64:
+            cand = [v for v in values if isinstance(v, int) and not isinstance(v, bool)]
+            arr = jnp.asarray(np.array(cand, dtype=np.int64)) if cand else None
+        elif l.kind == F64:
+            cand = [
+                float(v)
+                for v in values
+                if isinstance(v, (int, float)) and not isinstance(v, bool)
+            ]
+            arr = jnp.asarray(np.array(cand, dtype=np.float64)) if cand else None
+        elif l.kind == STR:
+            vocab = l.vocab or []
+            cand = [vocab.index(v) for v in values if isinstance(v, str) and v in vocab]
+            arr = jnp.asarray(np.array(cand, dtype=np.int32)) if cand else None
+        else:
+            raise TpuUnsupportedExpr(f"IN over {l.kind}")
+        has_null_item = any(v is None for v in values)
+        if arr is None:
+            hit = jnp.zeros(self.n, bool)
+        else:
+            hit = jnp.isin(l.data, arr)
+        valid = l.valid_mask()
+        if has_null_item:
+            # null list element: non-hits become unknown
+            valid = valid & hit
+        return Column(BOOL, hit & valid, valid)
+
+    def _arith(self, expr) -> Column:
+        l, r = self.eval(expr.lhs), self.eval(expr.rhs)
+        if l.kind not in (I64, F64) or r.kind not in (I64, F64):
+            raise TpuUnsupportedExpr(f"arithmetic on {l.kind}/{r.kind}")
+        valid = _and_valid(l, r)
+        both_int = l.kind == I64 and r.kind == I64
+        if isinstance(expr, E.Add):
+            if both_int:
+                return Column(I64, l.data + r.data, valid)
+            return Column(F64, l.cast_f64().data + r.cast_f64().data, valid)
+        if isinstance(expr, E.Subtract):
+            if both_int:
+                return Column(I64, l.data - r.data, valid)
+            return Column(F64, l.cast_f64().data - r.cast_f64().data, valid)
+        if isinstance(expr, E.Multiply):
+            if both_int:
+                return Column(I64, l.data * r.data, valid)
+            return Column(F64, l.cast_f64().data * r.cast_f64().data, valid)
+        if isinstance(expr, E.Divide):
+            if both_int:
+                rr = jnp.where(r.data == 0, 1, r.data)
+                q = jnp.sign(l.data) * jnp.sign(r.data) * (jnp.abs(l.data) // jnp.abs(rr))
+                return Column(I64, q, _mask_and(valid, r.data != 0))
+            return Column(F64, l.cast_f64().data / r.cast_f64().data, valid)
+        if isinstance(expr, E.Modulo):
+            if both_int:
+                rr = jnp.where(r.data == 0, 1, r.data)
+                m = jnp.sign(l.data) * (jnp.abs(l.data) % jnp.abs(rr))
+                return Column(I64, m, _mask_and(valid, r.data != 0))
+            ld, rd = l.cast_f64().data, r.cast_f64().data
+            m = jnp.sign(ld) * (jnp.abs(ld) % jnp.abs(rd))
+            return Column(F64, m, valid)
+        if isinstance(expr, E.Pow):
+            return Column(F64, l.cast_f64().data ** r.cast_f64().data, valid)
+        raise TpuUnsupportedExpr(type(expr).__name__)
+
+    def _case(self, expr: E.CaseExpr) -> Column:
+        if expr.operand is not None:
+            conds = [
+                self._equality(E.Equals(expr.operand, w)) for w in expr.whens
+            ]
+        else:
+            conds = [self._as_bool(self.eval(w)) for w in expr.whens]
+        thens = [self.eval(t) for t in expr.thens]
+        default = (
+            self.eval(expr.default)
+            if expr.default is not None
+            else constant_column(None, self.n)
+        )
+        kinds = {c.kind for c in thens} | {default.kind}
+        if kinds <= {I64, F64} and len(kinds) > 1:
+            thens = [c.cast_f64() for c in thens]
+            default = default.cast_f64() if default.kind in (I64, F64) else default
+            kinds = {F64}
+        if len(kinds - {default.kind}) > 0 and len(kinds) > 1:
+            raise TpuUnsupportedExpr("heterogeneous CASE branches")
+        if kinds == {STR}:
+            # remap every branch onto one merged dictionary so codes blend
+            from .column import _remap
+
+            merged = sorted({s for c in thens + [default] for s in (c.vocab or [])})
+            thens = [_remap(c, merged) for c in thens]
+            default = _remap(default, merged)
+        out = default
+        # evaluate from last WHEN to first so earlier WHENs win
+        for cond, then in zip(reversed(conds), reversed(thens)):
+            take = cond.data & cond.valid_mask()
+            data = jnp.where(take, then.data, out.data)
+            valid = jnp.where(take, then.valid_mask(), out.valid_mask())
+            out = Column(then.kind, data, valid, then.vocab)
+        return out
+
+    def _function(self, expr: E.FunctionCall) -> Column:
+        name = expr.name
+        args = [self.eval(a) for a in expr.args]
+        if name == "abs" and args[0].kind in (I64, F64):
+            return Column(args[0].kind, jnp.abs(args[0].data), args[0].valid)
+        if name == "sign" and args[0].kind in (I64, F64):
+            return Column(I64, jnp.sign(args[0].data).astype(jnp.int64), args[0].valid)
+        if name in ("ceil", "floor", "round", "sqrt", "exp", "log", "log10", "sin", "cos", "tan") and args[0].kind in (I64, F64):
+            x = args[0].cast_f64().data
+            fn = {
+                "ceil": jnp.ceil,
+                "floor": jnp.floor,
+                "round": lambda v: jnp.where(v >= 0, jnp.floor(v + 0.5), jnp.ceil(v - 0.5)),
+                "sqrt": jnp.sqrt,
+                "exp": jnp.exp,
+                "log": jnp.log,
+                "log10": jnp.log10,
+                "sin": jnp.sin,
+                "cos": jnp.cos,
+                "tan": jnp.tan,
+            }[name]
+            return Column(F64, fn(x), args[0].valid)
+        if name == "tofloat" and args[0].kind in (I64, F64):
+            return args[0].cast_f64()
+        if name == "tointeger" and args[0].kind in (I64, F64):
+            return Column(I64, args[0].data.astype(jnp.int64), args[0].valid)
+        if name == "coalesce":
+            kinds = {a.kind for a in args}
+            if kinds <= {I64, F64} and len(kinds) > 1:
+                args = [a.cast_f64() for a in args]
+            elif kinds == {STR}:
+                # blend on one merged dictionary or codes are meaningless
+                from .column import _remap
+
+                merged = sorted({s for a in args for s in (a.vocab or [])})
+                args = [_remap(a, merged) for a in args]
+            elif len(kinds) > 1:
+                raise TpuUnsupportedExpr("heterogeneous coalesce")
+            out = args[-1]
+            for a in reversed(args[:-1]):
+                take = a.valid_mask()
+                out = Column(
+                    a.kind,
+                    jnp.where(take, a.data, out.data),
+                    jnp.where(take, True, out.valid_mask()),
+                    a.vocab,
+                )
+            return out
+        raise TpuUnsupportedExpr(f"function {name}")
+
+
+def _mask_and(valid, cond):
+    return cond if valid is None else (valid & cond)
+
+
+def _and_valid(l: Column, r: Column):
+    lv, rv = l.valid, r.valid
+    if lv is None and rv is None:
+        return None
+    return l.valid_mask() & r.valid_mask()

@@ -129,6 +129,12 @@ class CypherSession:
 
         return CypherSession(LocalTable)
 
+    @staticmethod
+    def tpu() -> "CypherSession":
+        from ..backend.tpu.table import TpuTable
+
+        return CypherSession(TpuTable)
+
     # -- catalog -----------------------------------------------------------
 
     def _qualify(self, name: str) -> str:
