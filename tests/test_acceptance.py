@@ -715,3 +715,147 @@ class TestDrivingTable:
             driving_table=driving,
         )
         assert r.records.to_bag() == Bag([{"p.name": "Alice"}])
+
+
+# ---------------------------------------------------------------------------
+# Multiple graphs: CONSTRUCT / CATALOG / union (reference MultipleGraphTests,
+# CatalogDDLTests)
+# ---------------------------------------------------------------------------
+
+
+class TestMultipleGraphs:
+    @pytest.fixture(scope="class")
+    def g(self, session):
+        return init_graph(
+            session,
+            "CREATE (a:Person {name:'Alice'})-[:KNOWS {since:2020}]->"
+            "(b:Person {name:'Bob'})",
+        )
+
+    def test_construct_new_node(self, g):
+        ng = g.cypher("CONSTRUCT NEW (:Copy {v: 1}) RETURN GRAPH").graph
+        assert_results(ng, "MATCH (n:Copy) RETURN n.v", [{"n.v": 1}])
+
+    def test_construct_new_per_row(self, g):
+        ng = g.cypher(
+            "MATCH (p:Person) CONSTRUCT NEW (c:Clone {name: p.name}) RETURN GRAPH"
+        ).graph
+        assert_results(
+            ng,
+            "MATCH (c:Clone) RETURN c.name",
+            [{"c.name": "Alice"}, {"c.name": "Bob"}],
+        )
+
+    def test_construct_clone_and_new_rel(self, g):
+        ng = g.cypher(
+            "MATCH (a:Person)-[r:KNOWS]->(b:Person) "
+            "CONSTRUCT CLONE a, b NEW (a)-[:K2 {w: 2}]->(b) RETURN GRAPH"
+        ).graph
+        assert_results(
+            ng,
+            "MATCH (x)-[e:K2]->(y) RETURN x.name, e.w, y.name",
+            [{"x.name": "Alice", "e.w": 2, "y.name": "Bob"}],
+        )
+
+    def test_construct_implicit_clone(self, g):
+        ng = g.cypher(
+            "MATCH (a:Person) CONSTRUCT NEW (a)-[:SELF]->(a) RETURN GRAPH"
+        ).graph
+        assert_results(
+            ng,
+            "MATCH (x)-[:SELF]->(y) RETURN x.name = y.name AS same",
+            [{"same": True}, {"same": True}],
+        )
+
+    def test_construct_set_property(self, g):
+        ng = g.cypher(
+            "MATCH (a:Person {name:'Alice'}) "
+            "CONSTRUCT CLONE a SET a.age = 33 RETURN GRAPH"
+        ).graph
+        assert_results(
+            ng, "MATCH (n:Person) RETURN n.name, n.age",
+            [{"n.name": "Alice", "n.age": 33}],
+        )
+
+    def test_catalog_create_graph_and_on(self, session):
+        g1 = init_graph(session, "CREATE (:A {v: 1})")
+        g2 = init_graph(session, "CREATE (:B {w: 2})")
+        session.store_graph("cg1", g1)
+        session.store_graph("cg2", g2)
+        session.cypher(
+            "CATALOG CREATE GRAPH merged { FROM GRAPH session.cg1 "
+            "CONSTRUCT ON session.cg2 NEW (:C) RETURN GRAPH }"
+        )
+        m = session.graph("merged")
+        assert_results(
+            m,
+            "MATCH (n) RETURN labels(n) AS l",
+            [{"l": ["B"]}, {"l": ["C"]}],
+        )
+
+    def test_graph_union_all(self, session):
+        g1 = init_graph(session, "CREATE (:A {v: 1})")
+        g2 = init_graph(session, "CREATE (:A {v: 2})")
+        u = g1.union(g2)
+        assert_results(u, "MATCH (n:A) RETURN n.v", [{"n.v": 1}, {"n.v": 2}])
+
+
+class TestZeroLengthVarExpand:
+    @pytest.fixture(scope="class")
+    def g(self, session):
+        return init_graph(
+            session,
+            "CREATE (a:P {n: 1})-[:K]->(b:P {n: 2})-[:K]->(c:P {n: 3})",
+        )
+
+    def test_zero_to_two(self, g):
+        assert_results(
+            g,
+            "MATCH (a:P {n: 1})-[rs:K*0..2]->(b) RETURN b.n, size(rs) AS ln",
+            [{"b.n": 1, "ln": 0}, {"b.n": 2, "ln": 1}, {"b.n": 3, "ln": 2}],
+        )
+
+    def test_zero_only(self, g):
+        assert_results(
+            g,
+            "MATCH (a:P {n: 2})-[rs:K*0..0]->(b) RETURN b.n",
+            [{"b.n": 2}],
+        )
+
+    def test_from_graph_labeled_match(self, session):
+        # regression: label-scan pruning must use the FROM graph's schema,
+        # not the ambient graph's
+        g = init_graph(session, "CREATE (:OnlyHere {name:'Alice'})")
+        session.store_graph("fg_base", g)
+        r = session.cypher(
+            "FROM GRAPH session.fg_base MATCH (a:OnlyHere) RETURN a.name"
+        )
+        assert r.records.to_bag() == Bag([{"a.name": "Alice"}])
+
+    def test_construct_standalone_bound_var(self, session):
+        g = init_graph(session, "CREATE (:Person {name:'A'})")
+        ng = g.cypher("MATCH (a:Person) CONSTRUCT NEW (a) RETURN GRAPH").graph
+        assert_results(ng, "MATCH (n) RETURN n.name", [{"n.name": "A"}])
+
+    def test_construct_ids_unique_across_constructs(self, session):
+        ga = session.cypher("CONSTRUCT NEW (:A {v:1}) RETURN GRAPH").graph
+        session.store_graph("uq_x", ga)
+        session.cypher(
+            "CATALOG CREATE GRAPH uq_y { FROM GRAPH session.uq_x "
+            "CONSTRUCT ON session.uq_x NEW (:B {v:2}) RETURN GRAPH }"
+        )
+        rows = session.graph("uq_y").cypher("MATCH (n) RETURN id(n) AS i").records.collect()
+        ids = [r["i"] for r in rows]
+        assert len(ids) == 2 and len(set(ids)) == 2
+
+    def test_construct_on_clone_set_supersedes(self, session):
+        g = init_graph(session, "CREATE (:P {name:'Alice', age:1})")
+        session.store_graph("ov_base", g)
+        ng = session.cypher(
+            "FROM GRAPH session.ov_base MATCH (a:P) "
+            "CONSTRUCT ON session.ov_base CLONE a SET a.age = 33 RETURN GRAPH"
+        ).graph
+        assert_results(
+            ng, "MATCH (n:P) RETURN n.name, n.age",
+            [{"n.name": "Alice", "n.age": 33}],
+        )

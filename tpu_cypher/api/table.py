@@ -117,6 +117,19 @@ class Table(ABC):
         """One output row per element of the evaluated list expr (UNWIND)."""
         ...
 
+    def project(self, pairs: Sequence[Tuple[str, str]]) -> "Table":
+        """Project (source column, output column) pairs; unlike select+rename
+        a source column may appear multiple times (e.g. a self-loop relationship
+        whose start and end map to the same physical column)."""
+        raise NotImplementedError
+
+    @abstractmethod
+    def with_row_index(self, col: str) -> "Table":
+        """Append a 0..n-1 int64 row-index column (id generation for new
+        elements — the analog of the reference's partitioned id assignment,
+        ``MorpheusFunctions.scala:76`` / ``TableOps.scala:217``)."""
+        ...
+
     def cache(self) -> "Table":
         return self
 

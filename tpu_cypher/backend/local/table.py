@@ -246,6 +246,14 @@ class LocalTable(Table):
             out[col] = ev.evaluate(expr)
         return LocalTable(out, self._nrows)
 
+    def project(self, pairs) -> "LocalTable":
+        return LocalTable({new: self._cols[old] for old, new in pairs}, self._nrows)
+
+    def with_row_index(self, col: str) -> "LocalTable":
+        out = dict(self._cols)
+        out[col] = list(range(self._nrows))
+        return LocalTable(out, self._nrows)
+
     def explode(self, expr, col: str, header, parameters) -> "LocalTable":
         lists = Evaluator(self, header, parameters).evaluate(expr)
         idx: List[int] = []

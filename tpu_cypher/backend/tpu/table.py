@@ -310,6 +310,14 @@ class TpuTable(Table):
             lt = self._to_local().with_columns(items, header, parameters)
             return self._from_local(lt)
 
+    def project(self, pairs) -> "TpuTable":
+        return TpuTable({new: self._cols[old] for old, new in pairs}, self._nrows)
+
+    def with_row_index(self, col: str) -> "TpuTable":
+        out = dict(self._cols)
+        out[col] = Column(I64, jnp.arange(self._nrows, dtype=jnp.int64), None)
+        return TpuTable(out, self._nrows)
+
     def explode(self, expr, col: str, header, parameters) -> "TpuTable":
         lt = self._to_local().explode(expr, col, header, parameters)
         return self._from_local(lt)

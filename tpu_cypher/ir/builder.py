@@ -430,11 +430,17 @@ class IRBuilder:
             clone_env[new] = env[src]
         new_pattern = IRPattern()
         new_props: List[Tuple[str, str, E.Expr]] = []
+        cloned = {new for new, _ in clones}
         for pat in c.news:
             ir, preds = self.convert_pattern(pat, clone_env)
             for n, t in ir.node_types.items():
                 if n in clone_env:
-                    continue  # references an existing/cloned entity
+                    # references an existing/cloned entity: an implicit clone
+                    # (reference: bound vars in NEW patterns are cloned)
+                    if n in env and n not in cloned:
+                        clones.append((n, n))
+                        cloned.add(n)
+                    continue
                 new_pattern.node_types[n] = t
             for r, t in ir.rel_types.items():
                 new_pattern.rel_types[r] = t

@@ -24,23 +24,46 @@ from . import ops as L
 
 
 def optimize(
-    plan: L.LogicalOperator, schema: Optional[PropertyGraphSchema] = None
+    plan: L.LogicalOperator,
+    schema: Optional[PropertyGraphSchema] = None,
+    catalog_schemas: Optional[Dict[str, PropertyGraphSchema]] = None,
+    ambient_qgn: Optional[str] = None,
 ) -> L.LogicalOperator:
     if schema is not None:
-        plan = discard_scans_for_nonexistent_labels(plan, schema)
+        plan = discard_scans_for_nonexistent_labels(
+            plan, schema, catalog_schemas, ambient_qgn
+        )
     plan = replace_cartesian_with_value_join(plan)
     return plan
 
 
 def discard_scans_for_nonexistent_labels(
-    plan: L.LogicalOperator, schema: PropertyGraphSchema
+    plan: L.LogicalOperator,
+    schema: PropertyGraphSchema,
+    catalog_schemas: Optional[Dict[str, PropertyGraphSchema]] = None,
+    ambient_qgn: Optional[str] = None,
 ) -> L.LogicalOperator:
-    known = schema.labels
+    """A scan whose labels can't exist in its source graph's schema becomes
+    EmptyRecords. The scan's OWN graph (``n.graph_name``) decides — a scan
+    after FROM GRAPH must be pruned against that graph's schema, not the
+    ambient one (reference ``LogicalOptimizer.discardScansForNonexistentLabels``)."""
+
+    def schema_for(qgn: str) -> Optional[PropertyGraphSchema]:
+        if ambient_qgn is not None and qgn == ambient_qgn:
+            return schema
+        if catalog_schemas is not None and qgn in catalog_schemas:
+            return catalog_schemas[qgn]
+        if ambient_qgn is None and catalog_schemas is None:
+            return schema  # legacy single-schema call
+        return None  # unknown graph (e.g. mid-query CONSTRUCT result): keep scan
 
     def rule(n: TreeNode) -> TreeNode:
         if isinstance(n, L.NodeScan):
             t = n.node_type
-            if isinstance(t, T.CTNodeType) and not (t.labels <= known):
+            s = schema_for(n.graph_name)
+            if s is not None and isinstance(t, T.CTNodeType) and not (
+                t.labels <= s.labels
+            ):
                 return L.EmptyRecords(n.graph_name, n.fields)
         return n
 

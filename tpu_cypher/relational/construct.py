@@ -1,0 +1,270 @@
+"""CONSTRUCT planning: build a new property graph from query bindings.
+
+Re-design of the reference's ``ConstructGraphPlanner``
+(``okapi-relational/.../impl/planning/ConstructGraphPlanner.scala:52-514``):
+
+* CLONE keeps element identity (ids pass through unchanged); the reference
+  retags cloned ids with a per-source-graph byte prefix (``computePrefixes
+  :87``) because its ids are varint byte arrays — our ids are fixed-width
+  int64 with the graph tag in the high bits (``Expr.PrefixId``), so clones
+  simply keep their already-tagged ids.
+* NEW elements get generated ids (``generateId :273`` — partitioned
+  monotonic ids): here ``(row_index * n_new + j) | (NEW_ELEMENT_TAG << 54)``
+  computed via the backend's ``with_row_index`` — a dense, device-friendly
+  id assignment with no host round-trip.
+* The result is a ``ScanGraph`` over per-element tables extracted from the
+  binding table (``extractScanGraph :291-360``); ``CONSTRUCT ON g1, g2``
+  overlays the constructed scans on the base graphs WITHOUT retagging so new
+  relationships can attach to base-graph nodes.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional as Opt, Tuple
+
+from ..api import types as T
+from ..api.mapping import NodeMapping, RelationshipMapping
+from ..ir import expr as E
+from .graphs import ElementTable, EmptyGraph, OverlayGraph, ScanGraph
+from .header import RecordHeader, _sanitize
+from .ops import RelationalError, TableOp
+
+# Reserved graph tag for CONSTRUCT-created elements; member graphs of a
+# UnionGraph are tagged 1..510, so new elements never collide with clones.
+NEW_ELEMENT_TAG = 511
+
+# Distinct CONSTRUCT invocations get disjoint id ranges: bits 40..53 hold a
+# per-process construct sequence number, bits 0..39 the per-row element index
+# (the analog of the reference's partitioned monotonic id generation,
+# ``ConstructGraphPlanner.generateId :273``).
+_CONSTRUCT_SEQ = __import__("itertools").count()
+_SEQ_SHIFT = 40
+_SEQ_LIMIT = 1 << 14
+
+
+def plan_construct(planner, op):
+    blk = op.construct
+    ctx = planner.ctx
+    in_plan = planner.process(op.in_op)
+    header = in_plan.header
+    table = in_plan.table
+    params = ctx.parameters
+
+    env = {v.name for v in header.vars}
+
+    new_nodes: Dict[str, T.CypherType] = {
+        n: t for n, t in blk.new_pattern.node_types.items() if n not in env
+    }
+    new_rels: Dict[str, T.CypherType] = dict(blk.new_pattern.rel_types)
+    if blk.new_pattern.base_entities:
+        raise RelationalError("CONSTRUCT ... COPY OF is not yet supported")
+
+    clones: Dict[str, str] = {}  # constructed name -> source binding
+    for endpointed in blk.new_pattern.topology.values():
+        for endpoint in (endpointed.source, endpointed.target):
+            if endpoint in new_nodes:
+                continue
+            if endpoint not in env:
+                raise RelationalError(
+                    f"CONSTRUCT references unbound variable {endpoint!r}"
+                )
+            clones[endpoint] = endpoint
+    for new, src in blk.clones:
+        clones[new] = src
+
+    # SET/property-map items grouped per constructed element (last one wins)
+    prop_exprs: Dict[Tuple[str, str], E.Expr] = {}
+    for owner, key, expr in tuple(blk.new_properties) + tuple(blk.sets):
+        prop_exprs[(owner, key)] = expr
+    extra_labels: Dict[str, set] = {}
+    for owner, labels in blk.set_labels:
+        extra_labels.setdefault(owner, set()).update(labels)
+
+    # extend the header with clone aliases so SET exprs naming the alias
+    # resolve to the source binding's columns
+    hdr = header
+    for new, src in clones.items():
+        if new != src and src in env:
+            sv = hdr.var(src)
+            hdr = hdr.with_alias(E.Var(new).with_type(sv.typ), sv)
+
+    # ------------------------------------------------------------------
+    # 1. compute all derived columns over the binding table in one pass
+    # ------------------------------------------------------------------
+    new_names = list(new_nodes) + list(new_rels)
+    work = table
+    id_cols: Dict[str, str] = {}
+    items: List[Tuple[E.Expr, str]] = []
+    if new_names:
+        row_col = "__construct_row"
+        work = work.with_row_index(row_col)
+        row_var = E.Var(row_col).with_type(T.CTInteger)
+        hdr = hdr.with_expr(row_var, row_col)
+        n_new = len(new_names)
+        seq = next(_CONSTRUCT_SEQ) % _SEQ_LIMIT
+        seq_base = seq << _SEQ_SHIFT
+        for j, name in enumerate(new_names):
+            raw = E.Add(
+                E.Multiply(row_var, E.Lit(n_new).with_type(T.CTInteger)).with_type(
+                    T.CTInteger
+                ),
+                E.Lit(seq_base + j).with_type(T.CTInteger),
+            ).with_type(T.CTInteger)
+            col = f"__construct_{_sanitize(name)}_id"
+            items.append(
+                (E.PrefixId(raw, NEW_ELEMENT_TAG).with_type(T.CTInteger), col)
+            )
+            id_cols[name] = col
+
+    prop_cols: Dict[Tuple[str, str], str] = {}
+    for (owner, key), expr in prop_exprs.items():
+        col = f"__construct_{_sanitize(owner)}_prop_{_sanitize(key)}"
+        items.append((expr, col))
+        prop_cols[(owner, key)] = col
+
+    if items:
+        work = work.with_columns(items, hdr, params)
+
+    # ------------------------------------------------------------------
+    # 2. per-element tables
+    # ------------------------------------------------------------------
+    tables: List[ElementTable] = []
+
+    def props_for(owner: str, base: Dict[str, str]) -> Tuple[Tuple[str, str], ...]:
+        out = dict(base)
+        for (o, key), col in prop_cols.items():
+            if o == owner:
+                out[key] = col
+        return tuple(sorted(out.items()))
+
+    for name, ct in new_nodes.items():
+        labels = set(ct.material.labels) | extra_labels.get(name, set())
+        prop_map = props_for(name, {})
+        cols = [id_cols[name]] + [c for _, c in prop_map]
+        mapping = NodeMapping(
+            id_key=id_cols[name],
+            implied_labels=frozenset(labels),
+            property_mapping=prop_map,
+        )
+        tables.append(ElementTable(mapping, work.select(cols)))
+
+    for new, src in clones.items():
+        v = hdr.var(src)
+        m = v.typ.material
+        if isinstance(m, T.CTNodeType):
+            tables.append(
+                _clone_node_table(work, hdr, new, src, extra_labels, props_for, params)
+            )
+        elif isinstance(m, T.CTRelationshipType):
+            tables.extend(
+                _clone_rel_tables(work, hdr, new, src, props_for, params)
+            )
+        else:
+            raise RelationalError(f"Cannot CLONE non-element variable {src!r}")
+
+    for name, ct in new_rels.items():
+        conn = blk.new_pattern.topology.get(name)
+        if conn is None:
+            raise RelationalError(f"New relationship {name!r} has no topology")
+        m = ct.material
+        types = sorted(m.types)
+        if len(types) != 1:
+            raise RelationalError(
+                f"New relationship {name!r} must have exactly one type, got {types}"
+            )
+
+        def endpoint_col(ep: str) -> str:
+            if ep in id_cols:
+                return id_cols[ep]
+            v = hdr.var(ep)
+            return hdr.column(hdr.id_expr(v))
+
+        prop_map = props_for(name, {})
+        src_col = endpoint_col(conn.source)
+        dst_col = endpoint_col(conn.target)
+        mapping = RelationshipMapping(
+            id_key=id_cols[name],
+            source_key=src_col,
+            target_key=dst_col,
+            rel_type=types[0],
+            property_mapping=prop_map,
+        )
+        cols = list(
+            dict.fromkeys([id_cols[name], src_col, dst_col] + [c for _, c in prop_map])
+        )
+        tables.append(ElementTable(mapping, work.select(cols)))
+
+    # ------------------------------------------------------------------
+    # 3. assemble the result graph
+    # ------------------------------------------------------------------
+    constructed = ScanGraph(tables) if tables else EmptyGraph()
+    members = [ctx.resolve_graph(q) for q in blk.on_graphs]
+    # constructed first: OverlayGraph dedups per element id keeping the FIRST
+    # occurrence, so a CLONE ... SET row supersedes the base graph's row
+    graph = OverlayGraph([constructed] + members) if members else constructed
+    return TableOp(graph, ctx, RecordHeader(), ctx.table_cls.unit())
+
+
+def _clone_node_table(
+    work, hdr: RecordHeader, new: str, src: str, extra_labels, props_for, params
+) -> ElementTable:
+    v = hdr.var(src)
+    id_col = hdr.column(hdr.id_expr(v))
+    opt_labels: List[Tuple[str, str]] = [
+        (e.label, hdr.column(e)) for e in hdr.labels_for(v)
+    ]
+    base_props = {e.key: hdr.column(e) for e in hdr.properties_for(v)}
+    prop_map = props_for(new, base_props)
+    implied = frozenset(extra_labels.get(new, set()))
+    opt_labels = [(l, c) for l, c in opt_labels if l not in implied]
+    cols = list(
+        dict.fromkeys(
+            [id_col] + [c for _, c in opt_labels] + [c for _, c in prop_map]
+        )
+    )
+    mapping = NodeMapping(
+        id_key=id_col,
+        implied_labels=implied,
+        optional_labels=tuple(opt_labels),
+        property_mapping=prop_map,
+    )
+    return ElementTable(mapping, work.select(cols).distinct())
+
+
+def _clone_rel_tables(
+    work, hdr: RecordHeader, new: str, src: str, props_for, params
+) -> List[ElementTable]:
+    v = hdr.var(src)
+    id_col = hdr.column(hdr.id_expr(v))
+    start_e = next(e for e in hdr.expressions_for(v) if isinstance(e, E.StartNode))
+    end_e = next(e for e in hdr.expressions_for(v) if isinstance(e, E.EndNode))
+    start_col, end_col = hdr.column(start_e), hdr.column(end_e)
+    base_props = {e.key: hdr.column(e) for e in hdr.properties_for(v)}
+    prop_map = props_for(new, base_props)
+    cols = list(
+        dict.fromkeys([id_col, start_col, end_col] + [c for _, c in prop_map])
+    )
+    type_exprs = hdr.types_for(v)
+    out: List[ElementTable] = []
+    if not type_exprs:
+        m = v.typ.material
+        types = sorted(m.types)
+        if len(types) != 1:
+            raise RelationalError(f"Cannot determine type of cloned rel {src!r}")
+        type_exprs = [None]
+        known = types
+    else:
+        known = [e.rel_type for e in type_exprs]
+    for te, rel_type in zip(type_exprs, known):
+        t = work
+        if te is not None and len(known) > 1:
+            t = t.filter(te, hdr, params)
+        mapping = RelationshipMapping(
+            id_key=id_col,
+            source_key=start_col,
+            target_key=end_col,
+            rel_type=rel_type,
+            property_mapping=prop_map,
+        )
+        out.append(ElementTable(mapping, t.select(cols).distinct()))
+    return out

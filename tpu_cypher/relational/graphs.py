@@ -188,24 +188,24 @@ class ScanGraph(RelationalCypherGraph):
                 continue
             props = dict(m.property_mapping)
             t = et.table
-            rename: Dict[str, str] = {}
+            pairs: List[Tuple[str, str]] = []
             consts: List[Tuple[E.Expr, str]] = []
             for e in target.expressions:
                 col = target.column(e)
                 if isinstance(e, E.Id):
-                    rename[m.id_key] = col
+                    pairs.append((m.id_key, col))
                 elif isinstance(e, E.StartNode):
-                    rename[m.source_key] = col
+                    pairs.append((m.source_key, col))
                 elif isinstance(e, E.EndNode):
-                    rename[m.target_key] = col
+                    pairs.append((m.target_key, col))
                 elif isinstance(e, E.HasType):
                     consts.append((E.Lit(e.rel_type == m.rel_type), col))
                 elif isinstance(e, E.Property):
                     if e.key in props:
-                        rename[props[e.key]] = col
+                        pairs.append((props[e.key], col))
                     else:
                         consts.append((E.Lit(None), col))
-            t = t.select([c for c in rename]).rename(rename)
+            t = t.project(pairs)
             if consts:
                 t = t.with_columns(consts, None, {})
             t = t.select(target.columns)
@@ -270,8 +270,10 @@ class UnionGraph(RelationalCypherGraph):
 
         for g in graphs:
             flatten(g)
-        if len(leaves) >= (1 << 9):
-            raise ValueError("UnionGraph supports at most 511 member graphs")
+        # tags 1..510; tag 511 is reserved for CONSTRUCT-created elements
+        # (relational/construct.py NEW_ELEMENT_TAG)
+        if len(leaves) > 510:
+            raise ValueError("UnionGraph supports at most 510 member graphs")
         self.members = [PrefixedGraph(g, i + 1) for i, g in enumerate(leaves)]
         schema = PropertyGraphSchema.empty()
         for g in graphs:
@@ -279,24 +281,68 @@ class UnionGraph(RelationalCypherGraph):
         self.schema = schema
 
     def scan_operator(self, var_name, ct, ctx) -> RelationalOperator:
-        if isinstance(ct, T.CTNodeType):
-            target = header_for_node(var_name, ct, self.schema)
-        else:
-            target = header_for_relationship(var_name, ct, self.schema)
-        ops = []
+        return _member_union_scan(self, self.members, var_name, ct, ctx)
+
+
+class OverlayGraph(RelationalCypherGraph):
+    """Union of member graphs WITHOUT re-tagging ids.
+
+    Used by ``CONSTRUCT ON g1, g2``: constructed elements must keep identity
+    with the base graphs' elements so new relationships can attach to base
+    nodes (reference ``ConstructGraphPlanner`` ON-graph handling —
+    cloned/base ids keep their existing graph tag). Scans are deduplicated
+    per element id, keeping the FIRST member's row — the construct planner
+    lists the constructed part first so CLONE ... SET values supersede the
+    base graph's rows."""
+
+    def __init__(self, members: Sequence[RelationalCypherGraph]):
+        if not members:
+            raise ValueError("OverlayGraph requires at least one member")
+        self.members = list(members)
+        schema = PropertyGraphSchema.empty()
         for g in self.members:
-            member_schema = g.schema
-            if isinstance(ct, T.CTNodeType):
-                if ct.labels and not member_schema.combinations_for(ct.labels):
-                    continue
-            op = g.scan_operator(var_name, ct, ctx)
-            ops.append(_align_to(op, target, self, ctx))
-        if not ops:
-            return EmptyRecordsOp(self, ctx, target)
-        out = ops[0]
-        for o in ops[1:]:
-            out = UnionAllOp(out, o)
-        return out
+            schema = schema + g.schema
+        self.schema = schema
+
+    def scan_operator(self, var_name, ct, ctx) -> RelationalOperator:
+        return _member_union_scan(
+            self, self.members, var_name, ct, ctx, dedup_var=var_name
+        )
+
+
+def _member_union_scan(
+    graph: RelationalCypherGraph,
+    members: Sequence[RelationalCypherGraph],
+    var_name: str,
+    ct: T.CypherType,
+    ctx: RelationalRuntimeContext,
+    dedup_var: Optional[str] = None,
+) -> RelationalOperator:
+    """Union the members' scans aligned to the combined schema's header.
+
+    ``dedup_var``: when set, rows are deduplicated on that variable's id
+    column (keep-first) — OverlayGraph semantics; UnionGraph members have
+    disjoint id tags so no dedup is needed there."""
+    if isinstance(ct, T.CTNodeType):
+        target = header_for_node(var_name, ct, graph.schema)
+    else:
+        target = header_for_relationship(var_name, ct, graph.schema)
+    ops = []
+    for g in members:
+        if isinstance(ct, T.CTNodeType) and ct.labels:
+            if not g.schema.combinations_for(ct.labels):
+                continue
+        op = g.scan_operator(var_name, ct, ctx)
+        ops.append(_align_to(op, target, graph, ctx))
+    if not ops:
+        return EmptyRecordsOp(graph, ctx, target)
+    out = ops[0]
+    for o in ops[1:]:
+        out = UnionAllOp(out, o)
+    if dedup_var is not None and len(ops) > 1:
+        id_col = target.column(target.id_expr(target.var(dedup_var)))
+        return TableOp(graph, ctx, target, out.table.distinct([id_col]))
+    return out
 
 
 def _align_to(

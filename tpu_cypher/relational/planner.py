@@ -324,10 +324,6 @@ class RelationalPlanner:
         """Reference ``VarLengthExpandPlanner.scala:45-330``: unrolled iterated
         join with per-step edge-distinctness (isomorphism) filters; union of
         per-length results."""
-        if op.lower < 1:
-            raise RelationalError(
-                "Zero-length variable expansion (*0..) is not yet supported"
-            )
         lhs = self.process(op.lhs)
         rhs = self.process(op.rhs)
         graph = rhs.graph
@@ -335,6 +331,15 @@ class RelationalPlanner:
         rel_elem_type = op.rel_type.material
 
         branches: List[RelationalOperator] = []
+        if op.lower == 0:
+            # length 0: target IS the source; empty relationship list
+            # (reference VarLengthExpandPlanner zero-length init branch)
+            zero = JoinOp(
+                lhs, rhs, [(self._id_of(lhs, op.source), self._id_of(rhs, op.target))]
+            )
+            empty_list = E.ListLit(()).with_type(T.CTListType(rel_elem_type))
+            zero = AddOp(zero, empty_list, op.rel)
+            branches.append(SelectOp(zero, out_fields))
         current = lhs
         step_vars: List[str] = []
         prev_end: E.Expr = self._id_of(lhs, op.source)

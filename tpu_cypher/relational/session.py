@@ -65,7 +65,7 @@ class CypherResult:
         if self._graph is not None:
             return self._graph
         if self.relational_plan is not None:
-            return self.relational_plan.graph
+            return PropertyGraph(self.session, self.relational_plan.graph)
         return None
 
     @property
@@ -221,8 +221,8 @@ class CypherSession:
             result_graph = inner.graph
             if result_graph is None:
                 raise CatalogError("CREATE GRAPH inner query must return a graph")
-            self._catalog[self._qualify(ir.qgn)] = result_graph
-            return CypherResult(self, None, None, None, graph=PropertyGraph(self, result_graph))
+            self._catalog[self._qualify(ir.qgn)] = result_graph._graph
+            return CypherResult(self, None, None, None, graph=result_graph)
         if isinstance(ir, B.CreateViewIR):
             self._views[ir.name] = (ir.params, ir.inner_text)
             return CypherResult(self, None, None, None)
@@ -241,7 +241,12 @@ class CypherSession:
         lctx = LogicalPlannerContext(ambient_qgn, tuple(input_fields.items()))
         logical = time_stage("logical", plan_logical, ir, lctx)
         logical = time_stage(
-            "logical_opt", optimize_logical, logical, self._catalog[ambient_qgn].schema
+            "logical_opt",
+            optimize_logical,
+            logical,
+            self._catalog[ambient_qgn].schema,
+            {qgn: g.schema for qgn, g in self._catalog.items()},
+            ambient_qgn,
         )
         rctx = self._runtime_context(parameters)
         relational = time_stage(
