@@ -859,3 +859,16 @@ class TestZeroLengthVarExpand:
             ng, "MATCH (n:P) RETURN n.name, n.age",
             [{"n.name": "Alice", "n.age": 33}],
         )
+
+    def test_union_graph_query_big_ids(self, session):
+        # regression: graph-tagged ids live at 2**54+; float64 hash keys
+        # collapsed adjacent ids, turning joins into cross products
+        g1 = init_graph(session, "CREATE (:A)-[:R1]->(:A)")
+        g2 = init_graph(session, "CREATE (:B {v:1})")
+        u = g1.union(g2)
+        assert_results(u, "MATCH ()-[r:R1]->() RETURN count(r) AS c", [{"c": 1}])
+        assert_results(
+            u,
+            "MATCH (x)-[:R1]->(y) RETURN id(x) <> id(y) AS diff",
+            [{"diff": True}],
+        )

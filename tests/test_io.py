@@ -1,0 +1,145 @@
+"""IO subsystem tests: FS graph persistence roundtrip, edge lists, caching,
+namespace mounting (reference ``PGDSAcceptanceTest``,
+``okapi-testing/.../PGDSAcceptanceTest.scala:42-160``)."""
+
+import os
+
+import pytest
+
+from tpu_cypher import CypherSession
+from tpu_cypher.io import (
+    CachedDataSource,
+    DataSourceError,
+    EdgeListDataSource,
+    FSGraphSource,
+)
+from tpu_cypher.testing.bag import Bag
+
+
+@pytest.fixture()
+def session():
+    return CypherSession.local()
+
+
+@pytest.fixture()
+def graph(session):
+    return session.create_graph_from_create_query(
+        "CREATE (a:Person {name:'Alice', age:23})-[:KNOWS {since:2019}]->"
+        "(b:Person:Admin {name:'Bob'}),"
+        "(a)-[:LIKES {tags:['x','y']}]->"
+        "(c:Thing {d: date('2020-01-02'), dur: duration({days:2})})"
+    )
+
+
+@pytest.mark.parametrize("fmt", ["parquet", "csv"])
+class TestFSGraphSource:
+    def test_roundtrip(self, tmp_path, session, graph, fmt):
+        src = FSGraphSource(str(tmp_path), fmt)
+        session.register_source("fs", src)
+        session.store_graph("fs.g1", graph)
+        assert "fs.g1" in session.catalog_names
+        g2 = session.graph("fs.g1")
+        assert g2.schema == graph.schema
+        got = g2.cypher(
+            "MATCH (a:Person)-[k:KNOWS]->(b) RETURN a.name, k.since, b.name"
+        ).records.to_bag()
+        assert got == Bag([{"a.name": "Alice", "k.since": 2019, "b.name": "Bob"}])
+
+    def test_exotic_values_roundtrip(self, tmp_path, session, graph, fmt):
+        src = FSGraphSource(str(tmp_path), fmt)
+        src.store("g", graph._graph)
+        from tpu_cypher.relational.session import PropertyGraph
+
+        pg = PropertyGraph(session, src.graph("g", session))
+        got = pg.cypher(
+            "MATCH (t:Thing) RETURN t.d.year AS y, t.dur.days AS days"
+        ).records.to_bag()
+        assert got == Bag([{"y": 2020, "days": 2}])
+        got = pg.cypher("MATCH ()-[l:LIKES]->() RETURN l.tags").records.to_bag()
+        assert got == Bag([{"l.tags": ["x", "y"]}])
+
+    def test_from_graph_query(self, tmp_path, session, graph, fmt):
+        src = FSGraphSource(str(tmp_path), fmt)
+        session.register_source("fs", src)
+        session.store_graph("fs.g1", graph)
+        got = session.cypher(
+            "FROM GRAPH fs.g1 MATCH (n:Admin) RETURN n.name"
+        ).records.to_bag()
+        assert got == Bag([{"n.name": "Bob"}])
+
+    def test_store_twice_errors(self, tmp_path, session, graph, fmt):
+        src = FSGraphSource(str(tmp_path), fmt)
+        src.store("g", graph._graph)
+        with pytest.raises(DataSourceError):
+            src.store("g", graph._graph)
+        src.delete("g")
+        src.store("g", graph._graph)  # after delete it works again
+
+    def test_directory_layout(self, tmp_path, session, graph, fmt):
+        src = FSGraphSource(str(tmp_path), fmt)
+        src.store("g", graph._graph)
+        base = tmp_path / "g"
+        assert (base / "propertyGraphSchema.json").is_file()
+        assert (base / "metadata.json").is_file()
+        assert (base / "nodes" / "Person").is_dir()
+        assert (base / "nodes" / "Admin_Person").is_dir()
+        assert (base / "relationships" / "KNOWS").is_dir()
+
+
+class TestEdgeList:
+    def test_load(self, tmp_path, session):
+        p = tmp_path / "toy.txt"
+        p.write_text("# comment\n0 1\n1 2\n2 0\n")
+        src = EdgeListDataSource(str(tmp_path))
+        session.register_source("snap", src)
+        g = session.graph("snap.toy.txt")
+        got = g.cypher("MATCH (:V)-[:E]->(b:V) RETURN count(b) AS c").records.to_bag()
+        assert got == Bag([{"c": 3}])
+        two_hop = g.cypher(
+            "MATCH (a:V)-[:E]->()-[:E]->(c:V) RETURN count(*) AS c"
+        ).records.to_bag()
+        assert two_hop == Bag([{"c": 3}])
+
+    def test_read_only(self, tmp_path, session):
+        src = EdgeListDataSource(str(tmp_path))
+        with pytest.raises(DataSourceError):
+            src.store("x", None)
+
+
+class TestCachedDataSource:
+    def test_caches_loads(self, tmp_path, session, graph):
+        inner = FSGraphSource(str(tmp_path), "parquet")
+        inner.store("g", graph._graph)
+        calls = {"n": 0}
+        orig = inner.graph
+
+        def counting(name, sess):
+            calls["n"] += 1
+            return orig(name, sess)
+
+        inner.graph = counting
+        cached = CachedDataSource(inner)
+        session.register_source("c", cached)
+        session.graph("c.g")
+        session.graph("c.g")
+        assert calls["n"] == 1
+
+    def test_delete_invalidates(self, tmp_path, session, graph):
+        inner = FSGraphSource(str(tmp_path), "parquet")
+        cached = CachedDataSource(inner)
+        cached.store("g", graph._graph)
+        assert cached.has_graph("g")
+        cached.delete("g")
+        assert not cached.has_graph("g")
+
+
+class TestSessionNamespaces:
+    def test_reserved_namespaces(self, session):
+        with pytest.raises(Exception):
+            session.register_source("session", None)
+
+    def test_unknown_graph(self, session):
+        from tpu_cypher.relational.session import CatalogError
+
+        with pytest.raises(CatalogError):
+            session.graph("nope.g")

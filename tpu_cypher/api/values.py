@@ -262,10 +262,13 @@ def cypher_equals(a, b) -> Optional[bool]:
     if isinstance(a, bool):
         return a == b
     if isinstance(a, (int, float, Decimal)) and isinstance(b, (int, float, Decimal)):
-        af, bf = float(a), float(b)
-        if math.isnan(af) or math.isnan(bf):
+        if isinstance(a, float) and math.isnan(a):
             return False
-        return af == bf
+        if isinstance(b, float) and math.isnan(b):
+            return False
+        # Python's cross-type numeric == is exact — no float64 collapse of
+        # ints beyond 2**53 (graph-tagged element ids live at 2**54+)
+        return a == b
     if isinstance(a, (list, tuple)) and isinstance(b, (list, tuple)):
         if len(a) != len(b):
             return False
@@ -312,10 +315,11 @@ def cypher_equivalent(a, b) -> bool:
     if isinstance(a, bool) != isinstance(b, bool):
         return False
     if isinstance(a, (int, float, Decimal)) and isinstance(b, (int, float, Decimal)):
-        af, bf = float(a), float(b)
-        if math.isnan(af) and math.isnan(bf):
-            return True
-        return af == bf
+        a_nan = isinstance(a, float) and math.isnan(a)
+        b_nan = isinstance(b, float) and math.isnan(b)
+        if a_nan or b_nan:
+            return a_nan and b_nan
+        return a == b  # exact cross-type numeric equality
     if isinstance(a, (list, tuple)) and isinstance(b, (list, tuple)):
         return len(a) == len(b) and all(cypher_equivalent(x, y) for x, y in zip(a, b))
     if (
@@ -337,9 +341,21 @@ def _equiv_key(v) -> Any:
     if isinstance(v, bool):
         return ("bool", v)
     if isinstance(v, (int, float, Decimal)):
+        # ints/Decimals exactly representable in float64 share the float's
+        # key (Cypher equivalence: 1 = 1.0); beyond 2**53 the float would
+        # collapse distinct ids (graph-tagged element ids live at 2**54+),
+        # so non-representable values key on their exact integral value
+        if isinstance(v, int):
+            f = float(v)
+            if not math.isinf(f) and int(f) == v:
+                return ("num", f)
+            return ("num", v)
         f = float(v)
         if math.isnan(f):
             return ("nan",)
+        if isinstance(v, Decimal):
+            if v == v.to_integral_value() and (math.isinf(f) or int(v) != int(f)):
+                return ("num", int(v))  # exact integral Decimal beyond 2**53
         return ("num", f)
     if isinstance(v, (list, tuple)):
         return ("list", tuple(_equiv_key(x) for x in v))
@@ -394,8 +410,13 @@ def order_key(v):
     cls = _order_class(v)
     o = _TYPE_ORDER.get(cls, 8)
     if cls == "number":
-        f = float(v)
-        key = (math.isnan(f), f)  # NaN greater than all numbers
+        if isinstance(v, int):
+            # keep ints exact: float64 would collapse ids beyond 2**53
+            # (Python orders int vs float exactly, so mixing is safe)
+            key = (False, v)
+        else:
+            f = float(v)
+            key = (math.isnan(f), f)  # NaN greater than all numbers
     elif cls == "boolean":
         key = v
     elif cls == "string":

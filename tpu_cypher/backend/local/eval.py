@@ -437,6 +437,14 @@ class Evaluator:
                 if _num(l) and _num(rr):
                     return l - rr
                 raise CypherTypeError("Cannot subtract")
+            if op == "Multiply" and isinstance(l, Duration) and _num(rr):
+                return _scale_duration(l, rr)
+            if op == "Multiply" and isinstance(rr, Duration) and _num(l):
+                return _scale_duration(rr, l)
+            if op == "Divide" and isinstance(l, Duration) and _num(rr):
+                if rr == 0:
+                    raise CypherTypeError("/ by zero")
+                return _scale_duration(l, 1.0 / rr)
             if not (_num(l) and _num(rr)):
                 raise CypherTypeError(f"Numeric operator {op} on non-numbers")
             if op == "Multiply":
@@ -611,6 +619,18 @@ def _to_str_concat(v):
     if isinstance(v, bool):
         return "true" if v else "false"
     raise CypherTypeError(f"Cannot concatenate {type(v).__name__} with string")
+
+
+def _scale_duration(d: Duration, factor) -> Duration:
+    """duration * number / duration / number (reference ``TemporalConversions``
+    duration arithmetic): component-wise scale, fractional parts cascade via
+    ``Duration.of``."""
+    return Duration.of(
+        months=d.months * factor,
+        days=d.days * factor,
+        seconds=d.seconds * factor,
+        microseconds=d.microseconds * factor,
+    )
 
 
 def _add_duration(dt_val, dur: Duration):

@@ -1,0 +1,24 @@
+"""IO subsystem: property-graph data sources and persistence.
+
+Mirrors the reference's PGDS layer (SURVEY.md section 2.2): session/catalog
+sources, filesystem parquet/CSV persistence with the reference's directory
+layout, SNAP edge lists, and a caching decorator."""
+
+from .datasource import (
+    CachedDataSource,
+    DataSourceError,
+    PropertyGraphDataSource,
+    SessionGraphDataSource,
+)
+from .edge_list import EdgeListDataSource, load_edge_list
+from .fs import FSGraphSource
+
+__all__ = [
+    "CachedDataSource",
+    "DataSourceError",
+    "EdgeListDataSource",
+    "FSGraphSource",
+    "PropertyGraphDataSource",
+    "SessionGraphDataSource",
+    "load_edge_list",
+]

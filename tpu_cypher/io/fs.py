@@ -1,0 +1,353 @@
+"""Filesystem graph persistence.
+
+Re-design of the reference's FS data sources
+(``morpheus/.../api/io/fs/FSGraphSource.scala``,
+``AbstractPropertyGraphDataSource.scala:73-190``,
+``GraphDirectoryStructure.scala:85``). Same directory layout:
+
+    <root>/<graphName>/propertyGraphSchema.json
+    <root>/<graphName>/metadata.json
+    <root>/<graphName>/nodes/<labelCombo>/part.<fmt>
+    <root>/<graphName>/relationships/<relType>/part.<fmt>
+
+Formats: ``parquet`` (pyarrow, default — typed, null-safe) and ``csv``
+(lists/maps stored as JSON strings). Node tables are canonical: column
+``id`` plus one column per property key; relationship tables add ``source``
+and ``target``. The schema JSON mirrors the reference's upickle
+serialization (``JsonSerialization.scala``) with our type-string lattice.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import urllib.parse
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+import pandas as pd
+
+from ..api import types as T
+from ..api.mapping import NodeMapping, RelationshipMapping
+from ..api.schema import PropertyGraphSchema
+from ..api.values import Duration
+from ..ir import expr as E
+from ..relational.graphs import ElementTable, ScanGraph
+from .datasource import DataSourceError, PropertyGraphDataSource
+
+SCHEMA_FILE = "propertyGraphSchema.json"
+METADATA_FILE = "metadata.json"
+
+
+def _combo_dir(labels) -> str:
+    return urllib.parse.quote("_".join(sorted(labels)) or "__no_label__", safe="")
+
+
+def _rel_dir(rel_type: str) -> str:
+    return urllib.parse.quote(rel_type, safe="")
+
+
+# ---------------------------------------------------------------------------
+# canonical tables <-> pandas
+# ---------------------------------------------------------------------------
+
+
+def canonical_node_columns(graph, combo, ctx) -> Tuple[pd.DataFrame, Dict[str, T.CypherType]]:
+    """Rows whose label set is EXACTLY ``combo``, as columns id + props
+    (reference ``MorpheusGraphExport.canonicalNodeTable``)."""
+    from ..relational.ops import FilterOp
+
+    op = graph.scan_operator("n", T.CTNodeType(frozenset(combo)), ctx)
+    h = op.header
+    v = h.var("n")
+    # exact-combo filter: all other labels false
+    for e in h.labels_for(v):
+        if e.label not in combo:
+            op = FilterOp(op, E.Not(e).with_type(T.CTBoolean))
+    h = op.header
+    prop_types = graph.schema.node_property_keys(frozenset(combo))
+    pairs = [(h.column(h.id_expr(v)), "id")]
+    for e in h.properties_for(v):
+        if e.key in prop_types:
+            pairs.append((h.column(e), e.key))
+    t = op.table.project(pairs)
+    return _table_to_pandas(t), {"id": T.CTInteger, **prop_types}
+
+
+def canonical_rel_columns(graph, rel_type: str, ctx) -> Tuple[pd.DataFrame, Dict[str, T.CypherType]]:
+    op = graph.scan_operator("r", T.CTRelationshipType(frozenset({rel_type})), ctx)
+    h = op.header
+    v = h.var("r")
+    start = next(e for e in h.expressions_for(v) if isinstance(e, E.StartNode))
+    end = next(e for e in h.expressions_for(v) if isinstance(e, E.EndNode))
+    prop_types = graph.schema.relationship_property_keys(rel_type)
+    pairs = [
+        (h.column(h.id_expr(v)), "id"),
+        (h.column(start), "source"),
+        (h.column(end), "target"),
+    ]
+    for e in h.properties_for(v):
+        if e.key in prop_types:
+            pairs.append((h.column(e), e.key))
+    t = op.table.project(pairs)
+    return _table_to_pandas(t), {
+        "id": T.CTInteger,
+        "source": T.CTInteger,
+        "target": T.CTInteger,
+        **prop_types,
+    }
+
+
+def _table_to_pandas(t) -> pd.DataFrame:
+    cols: Dict[str, List] = {c: [] for c in t.physical_columns}
+    for row in t.rows():
+        for c in cols:
+            cols[c].append(row.get(c))
+    return pd.DataFrame(cols, columns=list(cols))
+
+
+def _pandas_to_values(df: pd.DataFrame, types: Dict[str, T.CypherType]) -> Dict[str, List]:
+    out: Dict[str, List] = {}
+    for c in df.columns:
+        t = types.get(c)
+        mat = t.material if t is not None else None
+        vals = []
+        for v in df[c].tolist():
+            if v is None or (np.isscalar(v) and isinstance(v, float) and np.isnan(v)):
+                vals.append(None)
+            elif mat is T.CTInteger or c in ("id", "source", "target"):
+                vals.append(int(v))
+            elif mat is T.CTFloat:
+                vals.append(float(v))
+            elif mat is T.CTBoolean:
+                vals.append(bool(v))
+            elif mat is T.CTString:
+                vals.append(str(v))
+            elif isinstance(v, np.ndarray):
+                vals.append(v.tolist())
+            else:
+                vals.append(v)
+        out[c] = vals
+    return out
+
+
+# ---------------------------------------------------------------------------
+# serialization of exotic values for parquet/csv
+# ---------------------------------------------------------------------------
+
+_JSON_TAG = "__tpu_cypher_json__:"
+
+
+def _encode_cell(v):
+    import datetime as _dt
+
+    if isinstance(v, Duration):
+        return _JSON_TAG + json.dumps(
+            {"__duration__": [v.months, v.days, v.seconds, v.microseconds]}
+        )
+    if isinstance(v, _dt.datetime):
+        return _JSON_TAG + json.dumps({"__localdatetime__": v.isoformat()})
+    if isinstance(v, _dt.date):
+        return _JSON_TAG + json.dumps({"__date__": v.isoformat()})
+    if isinstance(v, (list, tuple, dict)):
+        return _JSON_TAG + json.dumps(v)
+    return v
+
+
+def _decode_cell(v):
+    import datetime as _dt
+
+    if isinstance(v, str) and v.startswith(_JSON_TAG):
+        doc = json.loads(v[len(_JSON_TAG):])
+        if isinstance(doc, dict) and "__duration__" in doc:
+            m, d, s, us = doc["__duration__"]
+            return Duration(m, d, s, us)
+        if isinstance(doc, dict) and "__date__" in doc:
+            return _dt.date.fromisoformat(doc["__date__"])
+        if isinstance(doc, dict) and "__localdatetime__" in doc:
+            return _dt.datetime.fromisoformat(doc["__localdatetime__"])
+        return doc
+    return v
+
+
+def _needs_encoding(t: Optional[T.CypherType]) -> bool:
+    if t is None:
+        return True
+    m = t.material
+    return not (
+        m is T.CTInteger or m is T.CTFloat or m is T.CTBoolean or m is T.CTString
+    )
+
+
+# ---------------------------------------------------------------------------
+# the data source
+# ---------------------------------------------------------------------------
+
+
+class FSGraphSource(PropertyGraphDataSource):
+    """Parquet/CSV graph persistence with the reference's directory layout."""
+
+    def __init__(self, root: str, fmt: str = "parquet"):
+        if fmt not in ("parquet", "csv"):
+            raise DataSourceError(f"Unsupported format {fmt!r}")
+        self.root = root
+        self.fmt = fmt
+        os.makedirs(root, exist_ok=True)
+
+    # -- helpers -----------------------------------------------------------
+
+    def _graph_dir(self, name: str) -> str:
+        return os.path.join(self.root, urllib.parse.quote(name, safe=""))
+
+    def _part(self, d: str) -> str:
+        return os.path.join(d, f"part.{self.fmt}")
+
+    def _write_df(self, df: pd.DataFrame, types: Dict[str, T.CypherType], path: str):
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        df = df.copy()
+        for c in df.columns:
+            if _needs_encoding(types.get(c)):
+                df[c] = [
+                    None if v is None else _encode_cell(v) for v in df[c].tolist()
+                ]
+        if self.fmt == "parquet":
+            df.to_parquet(path, index=False)
+        else:
+            df.to_csv(path, index=False, na_rep="")
+
+    def _read_df(self, path: str, types: Dict[str, T.CypherType]) -> pd.DataFrame:
+        if self.fmt == "parquet":
+            df = pd.read_parquet(path)
+        else:
+            df = pd.read_csv(path, keep_default_na=True)
+            df = df.astype(object).where(pd.notnull(df), None)
+        for c in df.columns:
+            if _needs_encoding(types.get(c)):
+                df[c] = [
+                    None if v is None else _decode_cell(v) for v in df[c].tolist()
+                ]
+        return df
+
+    # -- PGDS --------------------------------------------------------------
+
+    def has_graph(self, name: str) -> bool:
+        return os.path.isfile(os.path.join(self._graph_dir(name), SCHEMA_FILE))
+
+    def graph_names(self) -> List[str]:
+        if not os.path.isdir(self.root):
+            return []
+        return sorted(
+            urllib.parse.unquote(d)
+            for d in os.listdir(self.root)
+            if os.path.isfile(os.path.join(self.root, d, SCHEMA_FILE))
+        )
+
+    def schema(self, name: str) -> Optional[PropertyGraphSchema]:
+        p = os.path.join(self._graph_dir(name), SCHEMA_FILE)
+        if not os.path.isfile(p):
+            return None
+        with open(p) as f:
+            return PropertyGraphSchema.from_json(f.read())
+
+    def store(self, name: str, graph) -> None:
+        if self.has_graph(name):
+            raise DataSourceError(f"Graph {name!r} already exists; delete it first")
+        d = self._graph_dir(name)
+        schema = graph.schema
+        os.makedirs(d, exist_ok=True)
+        with open(os.path.join(d, SCHEMA_FILE), "w") as f:
+            f.write(schema.to_json())
+        with open(os.path.join(d, METADATA_FILE), "w") as f:
+            json.dump({"format": self.fmt, "version": 1}, f)
+        ctx = _plain_ctx(graph)
+        for combo in schema.label_combinations:
+            df, types = canonical_node_columns(graph, combo, ctx)
+            self._write_df(df, types, self._part(os.path.join(d, "nodes", _combo_dir(combo))))
+        for rt in schema.relationship_types:
+            df, types = canonical_rel_columns(graph, rt, ctx)
+            self._write_df(
+                df, types, self._part(os.path.join(d, "relationships", _rel_dir(rt)))
+            )
+
+    def graph(self, name: str, session):
+        schema = self.schema(name)
+        if schema is None:
+            raise DataSourceError(f"Graph {name!r} not found under {self.root}")
+        d = self._graph_dir(name)
+        tables: List[ElementTable] = []
+        for combo in schema.label_combinations:
+            prop_types = schema.node_property_keys(combo)
+            types = {"id": T.CTInteger, **prop_types}
+            df = self._read_df(
+                self._part(os.path.join(d, "nodes", _combo_dir(combo))), types
+            )
+            cols = _pandas_to_values(df, types)
+            mapping = NodeMapping(
+                id_key="id",
+                implied_labels=frozenset(combo),
+                property_mapping=tuple(sorted((k, k) for k in prop_types)),
+            )
+            tables.append(ElementTable(mapping, session.table_cls.from_columns(cols)))
+        for rt in schema.relationship_types:
+            prop_types = schema.relationship_property_keys(rt)
+            types = {
+                "id": T.CTInteger,
+                "source": T.CTInteger,
+                "target": T.CTInteger,
+                **prop_types,
+            }
+            df = self._read_df(
+                self._part(os.path.join(d, "relationships", _rel_dir(rt))), types
+            )
+            cols = _pandas_to_values(df, types)
+            mapping = RelationshipMapping(
+                id_key="id",
+                source_key="source",
+                target_key="target",
+                rel_type=rt,
+                property_mapping=tuple(sorted((k, k) for k in prop_types)),
+            )
+            tables.append(ElementTable(mapping, session.table_cls.from_columns(cols)))
+        return ScanGraph(tables, schema)
+
+    def delete(self, name: str) -> None:
+        d = self._graph_dir(name)
+        if os.path.isdir(d):
+            shutil.rmtree(d)
+
+
+def _plain_ctx(graph):
+    """Runtime context for canonical-table extraction: the table factory is
+    taken from the graph's own tables so empty scans (e.g. a union member
+    lacking a relationship type) build tables of the right backend."""
+    from ..relational.ops import RelationalRuntimeContext
+
+    return RelationalRuntimeContext(
+        resolve_graph=lambda qgn: None,
+        parameters={},
+        table_cls=_graph_table_cls(graph),
+    )
+
+
+def _graph_table_cls(graph):
+    cls = _find_table_cls(graph)
+    if cls is not None:
+        return cls
+    from ..backend.local.table import LocalTable
+
+    return LocalTable
+
+
+def _find_table_cls(graph):
+    scans = getattr(graph, "scans", None)
+    if scans:
+        return type(scans[0].table)
+    for member in getattr(graph, "members", []) or []:
+        cls = _find_table_cls(member)
+        if cls is not None:
+            return cls
+    inner = getattr(graph, "graph", None)
+    if inner is not None:
+        return _find_table_cls(inner)
+    return None

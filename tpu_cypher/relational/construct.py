@@ -59,18 +59,15 @@ def plan_construct(planner, op):
     if blk.new_pattern.base_entities:
         raise RelationalError("CONSTRUCT ... COPY OF is not yet supported")
 
-    clones: Dict[str, str] = {}  # constructed name -> source binding
-    for endpointed in blk.new_pattern.topology.values():
-        for endpoint in (endpointed.source, endpointed.target):
-            if endpoint in new_nodes:
-                continue
-            if endpoint not in env:
+    # explicit CLONE items plus builder-derived implicit clones (bound vars
+    # referenced in NEW patterns — ir/builder._convert_construct)
+    clones: Dict[str, str] = {new: src for new, src in blk.clones}
+    for conn in blk.new_pattern.topology.values():
+        for endpoint in (conn.source, conn.target):
+            if endpoint not in new_nodes and endpoint not in clones:
                 raise RelationalError(
                     f"CONSTRUCT references unbound variable {endpoint!r}"
                 )
-            clones[endpoint] = endpoint
-    for new, src in blk.clones:
-        clones[new] = src
 
     # SET/property-map items grouped per constructed element (last one wins)
     prop_exprs: Dict[Tuple[str, str], E.Expr] = {}

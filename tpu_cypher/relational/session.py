@@ -119,7 +119,24 @@ class CypherSession:
         self.table_cls = table_cls
         self._catalog: Dict[str, RelationalCypherGraph] = {}
         self._views: Dict[str, Tuple[Tuple[str, ...], str]] = {}
+        self._sources: Dict[str, "PropertyGraphDataSource"] = {}
         self._counter = itertools.count()
+
+    # -- data source namespaces (reference PropertyGraphCatalog.register) --
+
+    def register_source(self, namespace: str, source) -> None:
+        """Mount a ``PropertyGraphDataSource`` under ``namespace.*``
+        (reference ``CypherSession.registerSource``)."""
+        if namespace in (SESSION_NS, AMBIENT_NS):
+            raise CatalogError(f"Namespace {namespace!r} is reserved")
+        self._sources[namespace] = source
+
+    def deregister_source(self, namespace: str) -> None:
+        self._sources.pop(namespace, None)
+
+    def _split(self, qgn: str) -> Tuple[str, str]:
+        ns, _, rest = qgn.partition(".")
+        return ns, rest
 
     # -- factories ---------------------------------------------------------
 
@@ -141,22 +158,39 @@ class CypherSession:
         return name if "." in name else f"{SESSION_NS}.{name}"
 
     def store_graph(self, name: str, graph: PropertyGraph):
-        self._catalog[self._qualify(name)] = graph._graph
+        qgn = self._qualify(name)
+        ns, rest = self._split(qgn)
+        if ns in self._sources:
+            self._sources[ns].store(rest, graph._graph)
+        else:
+            self._catalog[qgn] = graph._graph
 
     def graph(self, name: str) -> PropertyGraph:
         qgn = self._qualify(name)
-        if qgn not in self._catalog:
-            raise CatalogError(f"Graph {qgn!r} not in catalog")
-        return PropertyGraph(self, self._catalog[qgn])
+        return PropertyGraph(self, self._resolve_qgn(qgn))
+
+    def _resolve_qgn(self, qgn: str) -> RelationalCypherGraph:
+        if qgn in self._catalog:
+            return self._catalog[qgn]
+        ns, rest = self._split(qgn)
+        if ns in self._sources:
+            return self._sources[ns].graph(rest, self)
+        raise CatalogError(f"Graph {qgn!r} not in catalog")
 
     def drop_graph(self, name: str):
-        self._catalog.pop(self._qualify(name), None)
+        qgn = self._qualify(name)
+        ns, rest = self._split(qgn)
+        if ns in self._sources:
+            self._sources[ns].delete(rest)
+        else:
+            self._catalog.pop(qgn, None)
 
     @property
     def catalog_names(self) -> List[str]:
-        return sorted(
-            n for n in self._catalog if not n.startswith(AMBIENT_NS + ".")
-        )
+        names = [n for n in self._catalog if not n.startswith(AMBIENT_NS + ".")]
+        for ns, src in self._sources.items():
+            names.extend(f"{ns}.{g}" for g in src.graph_names())
+        return sorted(names)
 
     # -- graph construction ------------------------------------------------
 
@@ -172,12 +206,36 @@ class CypherSession:
     # -- runtime -----------------------------------------------------------
 
     def _runtime_context(self, parameters: Dict[str, Any]) -> RelationalRuntimeContext:
-        def resolve(qgn: str) -> RelationalCypherGraph:
-            if qgn in self._catalog:
-                return self._catalog[qgn]
-            raise CatalogError(f"Graph {qgn!r} not in catalog")
+        return RelationalRuntimeContext(
+            self._resolve_qgn, dict(parameters or {}), self.table_cls
+        )
 
-        return RelationalRuntimeContext(resolve, dict(parameters or {}), self.table_cls)
+    def _catalog_schemas(self) -> Dict[str, Any]:
+        """qgn -> schema for every known graph; source-backed graphs resolve
+        their schema lazily on first access (stored schema JSON — no full
+        graph load, reference ``AbstractPropertyGraphDataSource.schema``)."""
+        session = self
+
+        class _LazySchemas(dict):
+            def __missing__(self, qgn: str):
+                ns, _, rest = qgn.partition(".")
+                if ns in session._sources:
+                    s = session._sources[ns].schema(rest)
+                    if s is not None:
+                        self[qgn] = s
+                        return s
+                raise KeyError(qgn)
+
+            def __contains__(self, qgn) -> bool:
+                try:
+                    self[qgn]
+                    return True
+                except KeyError:
+                    return False
+
+        return _LazySchemas(
+            {qgn: g.schema for qgn, g in self._catalog.items()}
+        )
 
     # -- the pipeline ------------------------------------------------------
 
@@ -206,10 +264,11 @@ class CypherSession:
                 input_fields[col] = t
                 driving_header = driving_header.with_expr(E.Var(col).with_type(t), col)
 
+        schemas = self._catalog_schemas()
         ir_ctx = IRBuilderContext(
             schema=ambient.schema,
             parameters=parameters,
-            catalog_schemas={qgn: g.schema for qgn, g in self._catalog.items()},
+            catalog_schemas=schemas,
             working_graph=ambient_qgn,
             input_fields=input_fields,
         )
@@ -217,11 +276,11 @@ class CypherSession:
 
         # catalog statements
         if isinstance(ir, B.CreateGraphIR):
-            inner = self._plan_and_run(ir.inner, parameters, input_fields, driving_table, driving_header, ambient_qgn)
+            inner = self._plan_and_run(ir.inner, parameters, input_fields, driving_table, driving_header, ambient_qgn, schemas)
             result_graph = inner.graph
             if result_graph is None:
                 raise CatalogError("CREATE GRAPH inner query must return a graph")
-            self._catalog[self._qualify(ir.qgn)] = result_graph._graph
+            self.store_graph(ir.qgn, result_graph)
             return CypherResult(self, None, None, None, graph=result_graph)
         if isinstance(ir, B.CreateViewIR):
             self._views[ir.name] = (ir.params, ir.inner_text)
@@ -233,10 +292,11 @@ class CypherSession:
                 self.drop_graph(ir.qgn)
             return CypherResult(self, None, None, None)
 
-        return self._plan_and_run(ir, parameters, input_fields, driving_table, driving_header, ambient_qgn)
+        return self._plan_and_run(ir, parameters, input_fields, driving_table, driving_header, ambient_qgn, schemas)
 
     def _plan_and_run(
-        self, ir, parameters, input_fields, driving_table, driving_header, ambient_qgn
+        self, ir, parameters, input_fields, driving_table, driving_header, ambient_qgn,
+        schemas=None,
     ) -> CypherResult:
         lctx = LogicalPlannerContext(ambient_qgn, tuple(input_fields.items()))
         logical = time_stage("logical", plan_logical, ir, lctx)
@@ -245,7 +305,7 @@ class CypherSession:
             optimize_logical,
             logical,
             self._catalog[ambient_qgn].schema,
-            {qgn: g.schema for qgn, g in self._catalog.items()},
+            schemas if schemas is not None else self._catalog_schemas(),
             ambient_qgn,
         )
         rctx = self._runtime_context(parameters)
