@@ -1,0 +1,380 @@
+"""Graph DDL tests: parser, semantic resolution, SQL PGDS end-to-end
+(reference ``GraphDdlParserTest.scala``, ``GraphDdlTest.scala``,
+``SqlPropertyGraphDataSourceTest``)."""
+
+import pytest
+
+from tpu_cypher import CypherSession
+from tpu_cypher.api import types as T
+from tpu_cypher.graph_ddl import (
+    ElementTypeDefinition,
+    GraphDdl,
+    GraphDdlError,
+    GraphDdlParseError,
+    GraphDefinition,
+    GraphTypeDefinition,
+    NodeType,
+    NodeTypeDefinition,
+    RelationshipType,
+    RelationshipTypeDefinition,
+    SetSchemaDefinition,
+    parse_ddl,
+)
+from tpu_cypher.io.sql import (
+    IdGenerationStrategy,
+    InMemoryTables,
+    SqlPropertyGraphDataSource,
+    hash64,
+)
+from tpu_cypher.testing.bag import Bag
+
+FOO_DDL = """
+SET SCHEMA dataSourceName.fooDatabaseName
+
+CREATE GRAPH TYPE fooSchema (
+ Person ( name STRING, age INTEGER ),
+ Book   ( title STRING ) ,
+ READS  ( rating FLOAT ) ,
+ (Person),
+ (Book),
+ (Person)-[READS]->(Book)
+)
+CREATE GRAPH fooGraph OF fooSchema (
+  (Person) FROM personView1 ( person_name1 AS name )
+           FROM personView2 ( person_name2 AS name ),
+  (Book)   FROM bookView    ( book_title AS title ),
+
+  (Person)-[READS]->(Book)
+    FROM readsView1 e ( value1 AS rating )
+      START NODES (Person) FROM personView1 p JOIN ON p.person_id1 = e.person
+      END   NODES (Book)   FROM bookView    b JOIN ON e.book       = b.book_id
+    FROM readsView2 e ( value2 AS rating )
+      START NODES (Person) FROM personView2 p JOIN ON p.person_id2 = e.person
+      END   NODES (Book)   FROM bookView    b JOIN ON e.book       = b.book_id
+)
+"""
+
+
+class TestParser:
+    def test_set_schema(self):
+        ddl = parse_ddl("SET SCHEMA ds.db;")
+        assert ddl.statements == (SetSchemaDefinition("ds", "db"),)
+
+    def test_element_type(self):
+        ddl = parse_ddl("CREATE ELEMENT TYPE Person ( name STRING, age INTEGER? )")
+        (et,) = ddl.statements
+        assert et == ElementTypeDefinition(
+            "Person",
+            properties=(
+                ("name", T.CTString),
+                ("age", T.CTInteger.nullable),
+            ),
+        )
+
+    def test_element_type_extends_and_key(self):
+        ddl = parse_ddl(
+            "CREATE ELEMENT TYPE Employee EXTENDS Person, Worker "
+            "( dept STRING ) KEY pk (dept)"
+        )
+        (et,) = ddl.statements
+        assert et.parents == ("Person", "Worker")
+        assert et.key == ("pk", ("dept",))
+
+    def test_graph_type(self):
+        ddl = parse_ddl(
+            "CREATE GRAPH TYPE gt ( A (x INTEGER), B, (A), (B), (A)-[B]->(A) )"
+        )
+        (gt,) = ddl.statements
+        assert isinstance(gt, GraphTypeDefinition)
+        kinds = [type(s).__name__ for s in gt.statements]
+        assert kinds == [
+            "ElementTypeDefinition",
+            "ElementTypeDefinition",
+            "NodeTypeDefinition",
+            "NodeTypeDefinition",
+            "RelationshipTypeDefinition",
+        ]
+        rel = gt.statements[-1]
+        assert rel == RelationshipTypeDefinition(
+            NodeTypeDefinition(("A",)), ("B",), NodeTypeDefinition(("A",))
+        )
+
+    def test_full_script(self):
+        ddl = parse_ddl(FOO_DDL)
+        assert [type(s).__name__ for s in ddl.statements] == [
+            "SetSchemaDefinition",
+            "GraphTypeDefinition",
+            "GraphDefinition",
+        ]
+        graph = ddl.statements[2]
+        assert isinstance(graph, GraphDefinition)
+        assert graph.graph_type_name == "fooSchema"
+        node_map, book_map, rel_map = graph.statements
+        assert len(node_map.node_to_view) == 2
+        assert len(rel_map.rel_type_to_view) == 2
+        rtv = rel_map.rel_type_to_view[0]
+        assert rtv.view_def.alias == "e"
+        assert rtv.property_mapping == (("rating", "value1"),)
+        # join orientation is resolved later by alias
+        assert rtv.start_node.join_on.join_predicates == (
+            (("p", "person_id1"), ("e", "person")),
+        )
+
+    def test_comments_and_backticks(self):
+        ddl = parse_ddl(
+            """
+            -- line comment
+            /* block
+               comment */
+            CREATE ELEMENT TYPE X ( `weird prop` STRING )
+            // another
+            """
+        )
+        (et,) = ddl.statements
+        assert et.properties == (("weird prop", T.CTString),)
+
+    def test_parse_error(self):
+        with pytest.raises(GraphDdlParseError):
+            parse_ddl("CREATE GRAPH TYPE ( broken")
+
+
+class TestModel:
+    def test_resolution(self):
+        ddl = GraphDdl.parse(FOO_DDL)
+        g = ddl.graphs["fooGraph"]
+        gt = g.graph_type
+        assert set(gt.element_types_by_name) == {"Person", "Book", "READS"}
+        assert NodeType.of("Person") in gt.node_types
+        assert RelationshipType.of("Person", "READS", "Book") in gt.rel_types
+
+        person1 = next(
+            m
+            for m in g.node_to_view_mappings
+            if m.view.table_name == "personView1"
+        )
+        # explicit mapping for name, default for age
+        assert dict(person1.property_mappings) == {
+            "name": "person_name1",
+            "age": "age",
+        }
+        assert person1.view.resolved == (
+            "dataSourceName",
+            "fooDatabaseName",
+            "personView1",
+        )
+        # node id columns come from the first referencing edge's join
+        assert g.node_id_columns_for(person1.key) == ("person_id1",)
+
+        evm = g.edge_to_view_mappings[0]
+        assert evm.start_node.join_predicates[0].node_column == "person_id1"
+        assert evm.start_node.join_predicates[0].edge_column == "person"
+        # reversed textual order in END NODES still orients node/edge correctly
+        assert evm.end_node.join_predicates[0].node_column == "book_id"
+        assert evm.end_node.join_predicates[0].edge_column == "book"
+
+    def test_schema_lowering(self):
+        g = GraphDdl.parse(FOO_DDL).graphs["fooGraph"]
+        s = g.schema
+        assert s.node_property_keys(("Person",)) == {
+            "name": T.CTString,
+            "age": T.CTInteger,
+        }
+        assert s.relationship_property_keys("READS") == {"rating": T.CTFloat}
+
+    def test_extends_expands_labels_and_merges_properties(self):
+        ddl = GraphDdl.parse(
+            """
+            CREATE ELEMENT TYPE Person ( name STRING )
+            CREATE ELEMENT TYPE Employee EXTENDS Person ( dept STRING )
+            CREATE GRAPH g (
+              (Employee) FROM v
+            )
+            """
+        )
+        g = ddl.graphs["g"]
+        nt = g.node_to_view_mappings[0].node_type
+        assert nt.labels == frozenset({"Employee", "Person"})
+        assert g.graph_type.node_property_keys(nt) == {
+            "name": T.CTString,
+            "dept": T.CTString,
+        }
+
+    def test_circular_extends_rejected(self):
+        with pytest.raises(GraphDdlError, match="Circular"):
+            GraphDdl.parse(
+                """
+                CREATE ELEMENT TYPE A EXTENDS B ( )
+                CREATE ELEMENT TYPE B EXTENDS A ( )
+                CREATE GRAPH g ( (A) FROM v )
+                """
+            )
+
+    def test_property_conflict_rejected(self):
+        with pytest.raises(GraphDdlError, match="conflicting"):
+            GraphDdl.parse(
+                """
+                CREATE ELEMENT TYPE A ( x STRING )
+                CREATE ELEMENT TYPE B ( x INTEGER )
+                CREATE GRAPH g ( (A, B) FROM v )
+                """
+            ).graphs["g"].schema
+
+    def test_duplicates_rejected(self):
+        with pytest.raises(GraphDdlError, match="Duplicate graph"):
+            GraphDdl.parse("CREATE GRAPH g ( ) CREATE GRAPH g ( )")
+
+    def test_unresolved_graph_type(self):
+        with pytest.raises(GraphDdlError, match="Unresolved graph type"):
+            GraphDdl.parse("CREATE GRAPH g OF missing ( )")
+
+    def test_relative_view_requires_set_schema(self):
+        ddl = GraphDdl.parse(
+            "CREATE ELEMENT TYPE A (x STRING) CREATE GRAPH g ( (A) FROM v )"
+        )
+        vid = ddl.graphs["g"].node_to_view_mappings[0].view
+        with pytest.raises(GraphDdlError, match="SET SCHEMA"):
+            vid.resolved
+
+    def test_union(self):
+        a = GraphDdl.parse("CREATE GRAPH a ( )")
+        b = GraphDdl.parse("CREATE GRAPH b ( )")
+        assert set(a.union(b).graphs) == {"a", "b"}
+
+
+TABLES = {
+    "db.persons": {
+        "person_id": [1, 2, 3],
+        "name": ["Alice", "Bob", "Carl"],
+        "age": [23, 42, 19],
+    },
+    "db.books": {
+        "book_id": [10, 20],
+        "title": ["Morpheus", "Okapi"],
+    },
+    "db.reads": {
+        "person": [1, 1, 2],
+        "book": [10, 20, 10],
+        "rating": [5.0, 3.5, 4.0],
+    },
+}
+
+PGDS_DDL = """
+SET SCHEMA sql.db
+
+CREATE GRAPH TYPE library (
+  Person ( name STRING, age INTEGER ),
+  Book   ( title STRING ),
+  READS  ( rating FLOAT ),
+  (Person), (Book),
+  (Person)-[READS]->(Book)
+)
+CREATE GRAPH books OF library (
+  (Person) FROM persons,
+  (Book)   FROM books,
+  (Person)-[READS]->(Book)
+    FROM reads e
+      START NODES (Person) FROM persons p JOIN ON p.person_id = e.person
+      END   NODES (Book)   FROM books   b JOIN ON b.book_id   = e.book
+)
+"""
+
+
+@pytest.mark.parametrize(
+    "strategy", [IdGenerationStrategy.HASHED_ID, IdGenerationStrategy.SERIALIZED_ID]
+)
+class TestSqlPgds:
+    def _mount(self, strategy):
+        session = CypherSession.local()
+        source = SqlPropertyGraphDataSource(
+            PGDS_DDL,
+            {"sql": InMemoryTables(TABLES)},
+            id_strategy=strategy,
+        )
+        session.register_source("sql", source)
+        return session
+
+    def test_graph_names_and_schema(self, strategy):
+        session = self._mount(strategy)
+        g = session.graph("sql.books")
+        assert g.schema.node_property_keys(("Person",)) == {
+            "name": T.CTString,
+            "age": T.CTInteger,
+        }
+
+    def test_match_nodes(self, strategy):
+        session = self._mount(strategy)
+        res = session.graph("sql.books").cypher(
+            "MATCH (p:Person) RETURN p.name AS name, p.age AS age"
+        )
+        assert Bag(res.records.collect()) == Bag(
+            [
+                {"name": "Alice", "age": 23},
+                {"name": "Bob", "age": 42},
+                {"name": "Carl", "age": 19},
+            ]
+        )
+
+    def test_expand_across_views(self, strategy):
+        session = self._mount(strategy)
+        res = session.graph("sql.books").cypher(
+            "MATCH (p:Person)-[r:READS]->(b:Book) "
+            "WHERE r.rating >= 4.0 "
+            "RETURN p.name AS reader, b.title AS title, r.rating AS rating "
+            "ORDER BY rating DESC"
+        )
+        assert res.records.collect() == [
+            {"reader": "Alice", "title": "Morpheus", "rating": 5.0},
+            {"reader": "Bob", "title": "Morpheus", "rating": 4.0},
+        ]
+
+    def test_aggregation(self, strategy):
+        session = self._mount(strategy)
+        res = session.graph("sql.books").cypher(
+            "MATCH (p:Person)-[:READS]->(b:Book) "
+            "RETURN b.title AS title, count(*) AS readers"
+        )
+        assert Bag(res.records.collect()) == Bag(
+            [
+                {"title": "Morpheus", "readers": 2},
+                {"title": "Okapi", "readers": 1},
+            ]
+        )
+
+
+class TestSqlPgdsErrors:
+    def test_missing_view(self):
+        session = CypherSession.local()
+        source = SqlPropertyGraphDataSource(
+            "SET SCHEMA sql.db CREATE ELEMENT TYPE A (x STRING) "
+            "CREATE GRAPH g ( (A) FROM nope )",
+            {"sql": InMemoryTables(TABLES)},
+        )
+        session.register_source("sql", source)
+        from tpu_cypher.io import DataSourceError
+
+        with pytest.raises((DataSourceError, GraphDdlError)):
+            session.graph("sql.g")
+
+    def test_serialized_dangling_edge(self):
+        tables = dict(TABLES)
+        tables["db.reads"] = {
+            "person": [99],
+            "book": [10],
+            "rating": [1.0],
+        }
+        session = CypherSession.local()
+        source = SqlPropertyGraphDataSource(
+            PGDS_DDL,
+            {"sql": InMemoryTables(tables)},
+            id_strategy=IdGenerationStrategy.SERIALIZED_ID,
+        )
+        session.register_source("sql", source)
+        from tpu_cypher.io import DataSourceError
+
+        with pytest.raises(DataSourceError, match="missing node"):
+            session.graph("sql.books")
+
+    def test_hash64_stable_and_positive(self):
+        assert hash64("a", 1) == hash64("a", 1)
+        assert hash64("a", 1) != hash64("a", 2)
+        assert 0 <= hash64("x") < 2**63

@@ -143,3 +143,44 @@ class TestSessionNamespaces:
 
         with pytest.raises(CatalogError):
             session.graph("nope.g")
+
+
+class TestReviewRegressions:
+    """Regressions from code review: CSV NA-token mangling, label-combo
+    directory collisions, stored-format metadata, malformed edge lists."""
+
+    def test_csv_na_like_strings_roundtrip(self, tmp_path, session):
+        g = session.create_graph_from_create_query(
+            "CREATE (:S {v:'NA'}), (:S {v:'null'}), (:S {v:''}), (:S {v:'NaN'}),"
+            " (:S {v:'ok'}), (:S)"
+        )
+        src = FSGraphSource(str(tmp_path), "csv")
+        src.store("g", g._graph)
+        loaded = src.graph("g", session)
+        from tpu_cypher.relational.session import PropertyGraph
+
+        rows = PropertyGraph(session, loaded).cypher("MATCH (n:S) RETURN n.v AS v")
+        got = sorted(
+            (r["v"] for r in rows.records.collect()), key=lambda x: (x is None, x)
+        )
+        assert got == ["", "NA", "NaN", "null", "ok", None]
+
+    def test_combo_dir_no_collision(self):
+        from tpu_cypher.io.fs import _combo_dir
+
+        assert _combo_dir({"Admin", "Person"}) != _combo_dir({"Admin_Person"})
+        assert _combo_dir({"A", "B_C"}) != _combo_dir({"A_B", "C"})
+
+    def test_format_mismatch_reads_stored_format(self, tmp_path, session, graph):
+        FSGraphSource(str(tmp_path), "parquet").store("g", graph._graph)
+        other = FSGraphSource(str(tmp_path), "csv")
+        loaded = other.graph("g", session)  # metadata says parquet
+        assert loaded.schema == graph.schema
+
+    def test_malformed_edge_list(self, tmp_path, session):
+        p = tmp_path / "bad"
+        p.write_text("1 2\n7\n")
+        from tpu_cypher.io.edge_list import load_edge_list
+
+        with pytest.raises(DataSourceError, match="line 2"):
+            load_edge_list(str(p), session)

@@ -84,3 +84,28 @@ def test_to_cypher_string():
     assert to_cypher_string("a'b") == "'a\\'b'"
     assert to_cypher_string([1, "x"]) == "[1, 'x']"
     assert to_cypher_string(dt.date(2020, 1, 2)) == "'2020-01-02'"
+
+
+def test_equivalence_decimal_and_huge_ints():
+    """Review regressions: _equiv_key must not crash on >float-range ints and
+    must agree with cypher_equivalent for Decimals."""
+    from decimal import Decimal
+
+    from tpu_cypher.api.values import _equiv_key
+
+    huge = 10**400
+    assert _equiv_key(huge) == ("num", huge)
+    assert _equiv_key(huge) != _equiv_key(huge + 1)
+    assert cypher_equivalent(Decimal("NaN"), Decimal("NaN"))
+    assert cypher_equivalent(Decimal("NaN"), float("nan"))
+    assert _equiv_key(Decimal("NaN")) == _equiv_key(float("nan"))
+    # exactly-representable decimal shares the float key; equivalence agrees
+    assert cypher_equivalent(Decimal("0.5"), 0.5)
+    assert _equiv_key(Decimal("0.5")) == _equiv_key(0.5)
+    # 0.1 is NOT exactly 0.1f — distinct per equivalence, distinct keys
+    assert not cypher_equivalent(Decimal("0.1"), 0.1)
+    assert _equiv_key(Decimal("0.1")) != _equiv_key(0.1)
+    # integral decimal beyond 2**53 keys with the exact int
+    assert _equiv_key(Decimal(2**53 + 1)) == _equiv_key(2**53 + 1)
+    assert cypher_equivalent(Decimal(2**53 + 1), 2**53 + 1)
+    assert _equiv_key(Decimal(10**400)) == _equiv_key(10**400)

@@ -315,8 +315,8 @@ def cypher_equivalent(a, b) -> bool:
     if isinstance(a, bool) != isinstance(b, bool):
         return False
     if isinstance(a, (int, float, Decimal)) and isinstance(b, (int, float, Decimal)):
-        a_nan = isinstance(a, float) and math.isnan(a)
-        b_nan = isinstance(b, float) and math.isnan(b)
+        a_nan = _num_is_nan(a)
+        b_nan = _num_is_nan(b)
         if a_nan or b_nan:
             return a_nan and b_nan
         return a == b  # exact cross-type numeric equality
@@ -334,8 +334,15 @@ def cypher_equivalent(a, b) -> bool:
     return a == b
 
 
+def _num_is_nan(x) -> bool:
+    return (isinstance(x, float) and math.isnan(x)) or (
+        isinstance(x, Decimal) and x.is_nan()
+    )
+
+
 def _equiv_key(v) -> Any:
-    """A hashable key st. equivalence-equal values share a key."""
+    """A hashable key st. equivalence-equal values share a key — must agree
+    with :func:`cypher_equivalent` (used for DISTINCT/grouping/hash joins)."""
     if v is None:
         return ("null",)
     if isinstance(v, bool):
@@ -344,18 +351,30 @@ def _equiv_key(v) -> Any:
         # ints/Decimals exactly representable in float64 share the float's
         # key (Cypher equivalence: 1 = 1.0); beyond 2**53 the float would
         # collapse distinct ids (graph-tagged element ids live at 2**54+),
-        # so non-representable values key on their exact integral value
+        # so non-representable values key on their exact value
         if isinstance(v, int):
-            f = float(v)
+            try:
+                f = float(v)
+            except OverflowError:  # ints >= ~1.8e308
+                return ("num", v)
             if not math.isinf(f) and int(f) == v:
                 return ("num", f)
             return ("num", v)
-        f = float(v)
+        if isinstance(v, Decimal):
+            if v.is_nan():
+                return ("nan",)
+            try:
+                f = float(v)
+            except OverflowError:
+                f = math.inf if v > 0 else -math.inf
+            if not math.isinf(f) and Decimal(f) == v:
+                return ("num", f)  # exactly representable: shares float key
+            if v == v.to_integral_value():
+                return ("num", int(v))  # exact integral beyond float range
+            return ("num", "dec", str(v.normalize()))  # exact non-integral
+        f = v  # plain float
         if math.isnan(f):
             return ("nan",)
-        if isinstance(v, Decimal):
-            if v == v.to_integral_value() and (math.isinf(f) or int(v) != int(f)):
-                return ("num", int(v))  # exact integral Decimal beyond 2**53
         return ("num", f)
     if isinstance(v, (list, tuple)):
         return ("list", tuple(_equiv_key(x) for x in v))

@@ -32,13 +32,18 @@ def load_edge_list(path: str, session, delimiter: Optional[str] = None) -> ScanG
     src: List[int] = []
     dst: List[int] = []
     with open(path) as f:
-        for line in f:
+        for lineno, line in enumerate(f, start=1):
             line = line.strip()
             if not line or line.startswith("#"):
                 continue
             parts = line.replace(",", " ").split() if delimiter is None else line.split(delimiter)
-            src.append(int(parts[0]))
-            dst.append(int(parts[1]))
+            try:
+                src.append(int(parts[0]))
+                dst.append(int(parts[1]))
+            except (IndexError, ValueError) as e:
+                raise DataSourceError(
+                    f"Malformed edge-list line {lineno} in {path!r}: {line!r} ({e})"
+                )
     src_a = np.asarray(src, dtype=np.int64)
     dst_a = np.asarray(dst, dtype=np.int64)
     node_ids = np.unique(np.concatenate([src_a, dst_a])) if len(src_a) else np.zeros(0, np.int64)

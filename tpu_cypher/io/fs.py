@@ -40,8 +40,14 @@ SCHEMA_FILE = "propertyGraphSchema.json"
 METADATA_FILE = "metadata.json"
 
 
+def _escape_label(label: str) -> str:
+    # '_' is the combo separator, and quote() leaves it unescaped — escape it
+    # so {'A','B_C'} and {'A_B','C'} map to distinct directories
+    return urllib.parse.quote(label, safe="").replace("_", "%5F")
+
+
 def _combo_dir(labels) -> str:
-    return urllib.parse.quote("_".join(sorted(labels)) or "__no_label__", safe="")
+    return "_".join(_escape_label(l) for l in sorted(labels)) or "__no_label__"
 
 
 def _rel_dir(rel_type: str) -> str:
@@ -152,6 +158,9 @@ def _encode_cell(v):
         return _JSON_TAG + json.dumps({"__date__": v.isoformat()})
     if isinstance(v, (list, tuple, dict)):
         return _JSON_TAG + json.dumps(v)
+    if isinstance(v, str):
+        # protects CSV strings from NA-token mangling ('NA', 'null', '')
+        return _JSON_TAG + json.dumps(v)
     return v
 
 
@@ -171,10 +180,13 @@ def _decode_cell(v):
     return v
 
 
-def _needs_encoding(t: Optional[T.CypherType]) -> bool:
+def _needs_encoding(t: Optional[T.CypherType], csv: bool = False) -> bool:
     if t is None:
         return True
     m = t.material
+    if csv and m is T.CTString:
+        # CSV cannot distinguish null from 'NA'/'null'/'NaN'/'' — JSON-wrap
+        return True
     return not (
         m is T.CTInteger or m is T.CTFloat or m is T.CTBoolean or m is T.CTString
     )
@@ -200,14 +212,14 @@ class FSGraphSource(PropertyGraphDataSource):
     def _graph_dir(self, name: str) -> str:
         return os.path.join(self.root, urllib.parse.quote(name, safe=""))
 
-    def _part(self, d: str) -> str:
-        return os.path.join(d, f"part.{self.fmt}")
+    def _part(self, d: str, fmt: Optional[str] = None) -> str:
+        return os.path.join(d, f"part.{fmt or self.fmt}")
 
     def _write_df(self, df: pd.DataFrame, types: Dict[str, T.CypherType], path: str):
         os.makedirs(os.path.dirname(path), exist_ok=True)
         df = df.copy()
         for c in df.columns:
-            if _needs_encoding(types.get(c)):
+            if _needs_encoding(types.get(c), csv=self.fmt == "csv"):
                 df[c] = [
                     None if v is None else _encode_cell(v) for v in df[c].tolist()
                 ]
@@ -216,18 +228,34 @@ class FSGraphSource(PropertyGraphDataSource):
         else:
             df.to_csv(path, index=False, na_rep="")
 
-    def _read_df(self, path: str, types: Dict[str, T.CypherType]) -> pd.DataFrame:
-        if self.fmt == "parquet":
+    def _read_df(
+        self, path: str, types: Dict[str, T.CypherType], fmt: Optional[str] = None
+    ) -> pd.DataFrame:
+        fmt = fmt or self.fmt
+        if not os.path.isfile(path):
+            raise DataSourceError(f"Missing graph table file {path}")
+        if fmt == "parquet":
             df = pd.read_parquet(path)
         else:
             df = pd.read_csv(path, keep_default_na=True)
             df = df.astype(object).where(pd.notnull(df), None)
         for c in df.columns:
-            if _needs_encoding(types.get(c)):
+            if _needs_encoding(types.get(c), csv=fmt == "csv"):
                 df[c] = [
                     None if v is None else _decode_cell(v) for v in df[c].tolist()
                 ]
         return df
+
+    def _stored_format(self, name: str) -> str:
+        """The format the graph was written with (``metadata.json``) — reads
+        succeed even when the source is configured with the other format."""
+        p = os.path.join(self._graph_dir(name), METADATA_FILE)
+        if os.path.isfile(p):
+            with open(p) as f:
+                fmt = json.load(f).get("format")
+            if fmt in ("parquet", "csv"):
+                return fmt
+        return self.fmt
 
     # -- PGDS --------------------------------------------------------------
 
@@ -275,12 +303,13 @@ class FSGraphSource(PropertyGraphDataSource):
         if schema is None:
             raise DataSourceError(f"Graph {name!r} not found under {self.root}")
         d = self._graph_dir(name)
+        fmt = self._stored_format(name)
         tables: List[ElementTable] = []
         for combo in schema.label_combinations:
             prop_types = schema.node_property_keys(combo)
             types = {"id": T.CTInteger, **prop_types}
             df = self._read_df(
-                self._part(os.path.join(d, "nodes", _combo_dir(combo))), types
+                self._part(os.path.join(d, "nodes", _combo_dir(combo)), fmt), types, fmt
             )
             cols = _pandas_to_values(df, types)
             mapping = NodeMapping(
@@ -298,7 +327,9 @@ class FSGraphSource(PropertyGraphDataSource):
                 **prop_types,
             }
             df = self._read_df(
-                self._part(os.path.join(d, "relationships", _rel_dir(rt))), types
+                self._part(os.path.join(d, "relationships", _rel_dir(rt)), fmt),
+                types,
+                fmt,
             )
             cols = _pandas_to_values(df, types)
             mapping = RelationshipMapping(
