@@ -1,0 +1,107 @@
+Feature: Aggregation
+
+  Background:
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:E {team: 'a', sal: 10}),
+             (:E {team: 'a', sal: 20}),
+             (:E {team: 'b', sal: 30}),
+             (:E {team: 'b'})
+      """
+
+  Scenario: count star groups by remaining columns
+    When executing query:
+      """
+      MATCH (e:E) RETURN e.team AS team, count(*) AS n
+      """
+    Then the result should be, in any order:
+      | team | n |
+      | 'a'  | 2 |
+      | 'b'  | 2 |
+
+  Scenario: count of expression skips nulls
+    When executing query:
+      """
+      MATCH (e:E) RETURN e.team AS team, count(e.sal) AS n
+      """
+    Then the result should be, in any order:
+      | team | n |
+      | 'a'  | 2 |
+      | 'b'  | 1 |
+
+  Scenario: sum avg min max
+    When executing query:
+      """
+      MATCH (e:E) RETURN sum(e.sal) AS s, avg(e.sal) AS a, min(e.sal) AS mn, max(e.sal) AS mx
+      """
+    Then the result should be, in any order:
+      | s  | a    | mn | mx |
+      | 60 | 20.0 | 10 | 30 |
+
+  Scenario: collect gathers non-null values
+    When executing query:
+      """
+      MATCH (e:E {team: 'b'}) RETURN collect(e.sal) AS c
+      """
+    Then the result should be, in any order:
+      | c    |
+      | [30] |
+
+  Scenario: count distinct
+    When executing query:
+      """
+      MATCH (e:E) RETURN count(DISTINCT e.team) AS n
+      """
+    Then the result should be, in any order:
+      | n |
+      | 2 |
+
+  Scenario: aggregation over empty match
+    Given an empty graph
+    When executing query:
+      """
+      MATCH (e:E) RETURN count(*) AS n, sum(e.sal) AS s, collect(e.sal) AS c
+      """
+    Then the result should be, in any order:
+      | n | s | c  |
+      | 0 | 0 | [] |
+
+  Scenario: stdev of known distribution
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:V {x: 2}), (:V {x: 4}), (:V {x: 6})
+      """
+    When executing query:
+      """
+      MATCH (v:V) RETURN stDev(v.x) AS sd
+      """
+    Then the result should be, in any order:
+      | sd  |
+      | 2.0 |
+
+  Scenario: percentileDisc
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:W {x: 1}), (:W {x: 2}), (:W {x: 3}), (:W {x: 4})
+      """
+    When executing query:
+      """
+      MATCH (w:W) RETURN percentileDisc(w.x, 0.5) AS p
+      """
+    Then the result should be, in any order:
+      | p |
+      | 2 |
+
+  Scenario: aggregation after WITH
+    When executing query:
+      """
+      MATCH (e:E) WITH e.team AS team, e.sal AS sal WHERE sal IS NOT NULL
+      RETURN team, sum(sal) AS total ORDER BY team
+      """
+    Then the result should be, in order:
+      | team | total |
+      | 'a'  | 30    |
+      | 'b'  | 30    |

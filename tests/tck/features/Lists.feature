@@ -1,0 +1,96 @@
+Feature: Lists
+
+  Scenario: List literals and indexing
+    Given an empty graph
+    When executing query:
+      """
+      RETURN [1, 2, 3][0] AS first, [1, 2, 3][-1] AS last, [1, 2, 3][1..] AS tail
+      """
+    Then the result should be, in any order:
+      | first | last | tail   |
+      | 1     | 3    | [2, 3] |
+
+  Scenario: List comprehension with filter and map
+    Given an empty graph
+    When executing query:
+      """
+      RETURN [x IN range(1, 5) WHERE x % 2 = 1 | x * x] AS squares
+      """
+    Then the result should be, in any order:
+      | squares    |
+      | [1, 9, 25] |
+
+  Scenario: reduce over a list
+    Given an empty graph
+    When executing query:
+      """
+      RETURN reduce(acc = 1, x IN [2, 3, 4] | acc * x) AS product
+      """
+    Then the result should be, in any order:
+      | product |
+      | 24      |
+
+  Scenario: size head last reverse
+    Given an empty graph
+    When executing query:
+      """
+      WITH [1, 2, 3] AS l
+      RETURN size(l) AS s, head(l) AS h, last(l) AS t, reverse(l) AS r
+      """
+    Then the result should be, in any order:
+      | s | h | t | r         |
+      | 3 | 1 | 3 | [3, 2, 1] |
+
+  Scenario: range with step
+    Given an empty graph
+    When executing query:
+      """
+      RETURN range(0, 10, 5) AS r
+      """
+    Then the result should be, in any order:
+      | r          |
+      | [0, 5, 10] |
+
+  Scenario: IN over list of lists
+    Given an empty graph
+    When executing query:
+      """
+      RETURN [1, 2] IN [[1, 2], [3]] AS a, [9] IN [[1, 2]] AS b
+      """
+    Then the result should be, in any order:
+      | a    | b     |
+      | true | false |
+
+  Scenario: Comparing lists element order matters by default
+    Given an empty graph
+    When executing query:
+      """
+      RETURN [1, 2] = [2, 1] AS eq
+      """
+    Then the result should be, in any order:
+      | eq    |
+      | false |
+
+  Scenario: Ignoring element order for lists when asked
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:N {v: 2}), (:N {v: 1})
+      """
+    When executing query:
+      """
+      MATCH (n:N) RETURN collect(n.v) AS vs
+      """
+    Then the result should be, in any order, ignoring element order for lists:
+      | vs     |
+      | [1, 2] |
+
+  Scenario: List concatenation with plus
+    Given an empty graph
+    When executing query:
+      """
+      RETURN [1] + [2, 3] AS l
+      """
+    Then the result should be, in any order:
+      | l         |
+      | [1, 2, 3] |

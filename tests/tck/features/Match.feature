@@ -1,0 +1,155 @@
+Feature: Match
+
+  Scenario: Match all nodes in an empty graph
+    Given an empty graph
+    When executing query:
+      """
+      MATCH (n) RETURN n
+      """
+    Then the result should be empty
+    And no side effects
+
+  Scenario: Match nodes by label
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:Person {name: 'Alice'}), (:Person {name: 'Bob'}), (:Animal {name: 'Rex'})
+      """
+    When executing query:
+      """
+      MATCH (p:Person) RETURN p.name AS name
+      """
+    Then the result should be, in any order:
+      | name    |
+      | 'Alice' |
+      | 'Bob'   |
+    And no side effects
+
+  Scenario: Match returns whole nodes
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:Person:Admin {name: 'Alice', age: 23})
+      """
+    When executing query:
+      """
+      MATCH (p:Person) RETURN p
+      """
+    Then the result should be, in any order:
+      | p                                        |
+      | (:Person:Admin {name: 'Alice', age: 23}) |
+
+  Scenario: Match a single hop
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (a:A {v: 1})-[:KNOWS {since: 2019}]->(b:B {v: 2}), (b)-[:KNOWS]->(a)
+      """
+    When executing query:
+      """
+      MATCH (x)-[r:KNOWS]->(y) RETURN x.v AS xv, y.v AS yv
+      """
+    Then the result should be, in any order:
+      | xv | yv |
+      | 1  | 2  |
+      | 2  | 1  |
+
+  Scenario: Match returns whole relationships
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:A)-[:LIKES {stars: 5}]->(:B)
+      """
+    When executing query:
+      """
+      MATCH ()-[r]->() RETURN r
+      """
+    Then the result should be, in any order:
+      | r                    |
+      | [:LIKES {stars: 5}]  |
+
+  Scenario: Undirected match sees both orientations
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (a:A {v: 1})-[:R]->(b:B {v: 2})
+      """
+    When executing query:
+      """
+      MATCH (x)-[:R]-(y) RETURN x.v AS xv, y.v AS yv
+      """
+    Then the result should be, in any order:
+      | xv | yv |
+      | 1  | 2  |
+      | 2  | 1  |
+
+  Scenario: Two-hop chain match
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (a:P {n: 'a'})-[:K]->(b:P {n: 'b'})-[:K]->(c:P {n: 'c'})
+      """
+    When executing query:
+      """
+      MATCH (x)-[:K]->(y)-[:K]->(z) RETURN x.n AS x, y.n AS y, z.n AS z
+      """
+    Then the result should be, in order:
+      | x   | y   | z   |
+      | 'a' | 'b' | 'c' |
+
+  Scenario: Match with multiple labels on a node
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:A:B {v: 1}), (:A {v: 2}), (:B {v: 3})
+      """
+    When executing query:
+      """
+      MATCH (n:A:B) RETURN n.v AS v
+      """
+    Then the result should be, in any order:
+      | v |
+      | 1 |
+
+  Scenario: Cartesian product of disconnected patterns
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:X {v: 1}), (:X {v: 2}), (:Y {v: 10})
+      """
+    When executing query:
+      """
+      MATCH (x:X), (y:Y) RETURN x.v AS xv, y.v AS yv
+      """
+    Then the result should be, in any order:
+      | xv | yv |
+      | 1  | 10 |
+      | 2  | 10 |
+
+  Scenario: Inline property predicate in node pattern
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:P {name: 'a', age: 1}), (:P {name: 'b', age: 2})
+      """
+    When executing query:
+      """
+      MATCH (p:P {age: 2}) RETURN p.name AS name
+      """
+    Then the result should be, in any order:
+      | name |
+      | 'b'  |
+
+  Scenario: Named path binding
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:A)-[:R]->(:B)
+      """
+    When executing query:
+      """
+      MATCH p = (:A)-[:R]->(:B) RETURN p
+      """
+    Then the result should be, in any order:
+      | p                 |
+      | <(:A)-[:R]->(:B)> |

@@ -1,0 +1,90 @@
+Feature: NullSemantics
+
+  Scenario: Ternary logic of AND OR
+    Given an empty graph
+    When executing query:
+      """
+      RETURN null AND true AS a, null AND false AS b, null OR true AS c, null OR false AS d
+      """
+    Then the result should be, in any order:
+      | a    | b     | c    | d    |
+      | null | false | true | null |
+
+  Scenario: NOT null is null
+    Given an empty graph
+    When executing query:
+      """
+      RETURN NOT null AS n
+      """
+    Then the result should be, in any order:
+      | n    |
+      | null |
+
+  Scenario: Arithmetic with null is null
+    Given an empty graph
+    When executing query:
+      """
+      RETURN 1 + null AS a, null * 2 AS b
+      """
+    Then the result should be, in any order:
+      | a    | b    |
+      | null | null |
+
+  Scenario: Equality with null is null
+    Given an empty graph
+    When executing query:
+      """
+      RETURN (null = null) IS NULL AS a, (1 = null) IS NULL AS b
+      """
+    Then the result should be, in any order:
+      | a    | b    |
+      | true | true |
+
+  Scenario: Missing property access yields null
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:N {a: 1})
+      """
+    When executing query:
+      """
+      MATCH (n:N) RETURN n.missing AS m
+      """
+    Then the result should be, in any order:
+      | m    |
+      | null |
+
+  Scenario: coalesce picks first non-null
+    Given an empty graph
+    When executing query:
+      """
+      RETURN coalesce(null, null, 3, 4) AS c
+      """
+    Then the result should be, in any order:
+      | c |
+      | 3 |
+
+  Scenario: DISTINCT treats nulls as equivalent
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:N), (:N), (:N {v: 1})
+      """
+    When executing query:
+      """
+      MATCH (n:N) RETURN DISTINCT n.v AS v
+      """
+    Then the result should be, in any order:
+      | v    |
+      | null |
+      | 1    |
+
+  Scenario: IN with null element is null not false
+    Given an empty graph
+    When executing query:
+      """
+      RETURN (3 IN [1, null]) IS NULL AS a, 1 IN [1, null] AS b
+      """
+    Then the result should be, in any order:
+      | a    | b    |
+      | true | true |

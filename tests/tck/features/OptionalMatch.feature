@@ -1,0 +1,58 @@
+Feature: OptionalMatch
+
+  Scenario: Optional match with no matches binds null
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:A {v: 1})
+      """
+    When executing query:
+      """
+      MATCH (a:A) OPTIONAL MATCH (a)-[:MISSING]->(b) RETURN a.v AS v, b
+      """
+    Then the result should be, in any order:
+      | v | b    |
+      | 1 | null |
+
+  Scenario: Optional match keeps existing matches
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (a:A {v: 1})-[:R]->(:B {v: 2}), (:A {v: 3})
+      """
+    When executing query:
+      """
+      MATCH (a:A) OPTIONAL MATCH (a)-[:R]->(b:B) RETURN a.v AS av, b.v AS bv
+      """
+    Then the result should be, in any order:
+      | av | bv   |
+      | 1  | 2    |
+      | 3  | null |
+
+  Scenario: Optional match properties of null are null
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:A)
+      """
+    When executing query:
+      """
+      MATCH (a:A) OPTIONAL MATCH (a)-[:NOPE]->(b) RETURN b.prop AS p
+      """
+    Then the result should be, in any order:
+      | p    |
+      | null |
+
+  Scenario: Optional match with WHERE filter
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (a:A {v: 1})-[:R]->(:B {v: 2}), (a)-[:R]->(:B {v: 5})
+      """
+    When executing query:
+      """
+      MATCH (a:A) OPTIONAL MATCH (a)-[:R]->(b:B) WHERE b.v > 3 RETURN a.v AS av, b.v AS bv
+      """
+    Then the result should be, in any order:
+      | av | bv |
+      | 1  | 5  |

@@ -1,0 +1,58 @@
+Feature: Parameters
+
+  Scenario: Scalar parameter in predicate
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:P {v: 1}), (:P {v: 2})
+      """
+    And parameters are:
+      | threshold | 1 |
+    When executing query:
+      """
+      MATCH (p:P) WHERE p.v > $threshold RETURN p.v AS v
+      """
+    Then the result should be, in any order:
+      | v |
+      | 2 |
+
+  Scenario: List parameter with UNWIND
+    Given an empty graph
+    And parameters are:
+      | xs | [1, 2, 3] |
+    When executing query:
+      """
+      UNWIND $xs AS x RETURN x * 2 AS d
+      """
+    Then the result should be, in order:
+      | d |
+      | 2 |
+      | 4 |
+      | 6 |
+
+  Scenario: Map parameter property access
+    Given an empty graph
+    And parameters are:
+      | conf | {lo: 1, hi: 3} |
+    When executing query:
+      """
+      UNWIND range($conf.lo, $conf.hi) AS x RETURN x
+      """
+    Then the result should be, in order:
+      | x |
+      | 1 |
+      | 2 |
+      | 3 |
+
+  Scenario: String and null parameters
+    Given an empty graph
+    And parameters are:
+      | name    | 'Alice' |
+      | nothing | null    |
+    When executing query:
+      """
+      RETURN $name AS n, $nothing IS NULL AS isnull
+      """
+    Then the result should be, in any order:
+      | n       | isnull |
+      | 'Alice' | true   |

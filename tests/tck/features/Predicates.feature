@@ -1,0 +1,110 @@
+Feature: Predicates
+
+  Background:
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:P {name: 'a', age: 10, tags: ['x']}),
+             (:P {name: 'b', age: 20}),
+             (:P {name: 'c'})
+      """
+
+  Scenario: Comparison operators
+    When executing query:
+      """
+      MATCH (p:P) WHERE p.age >= 20 RETURN p.name AS name
+      """
+    Then the result should be, in any order:
+      | name |
+      | 'b'  |
+
+  Scenario: Null property comparisons are filtered out
+    When executing query:
+      """
+      MATCH (p:P) WHERE p.age < 100 RETURN p.name AS name
+      """
+    Then the result should be, in any order:
+      | name |
+      | 'a'  |
+      | 'b'  |
+
+  Scenario: IS NULL predicate
+    When executing query:
+      """
+      MATCH (p:P) WHERE p.age IS NULL RETURN p.name AS name
+      """
+    Then the result should be, in any order:
+      | name |
+      | 'c'  |
+
+  Scenario: AND OR NOT combinations
+    When executing query:
+      """
+      MATCH (p:P) WHERE (p.age = 10 OR p.age = 20) AND NOT p.name = 'a' RETURN p.name AS name
+      """
+    Then the result should be, in any order:
+      | name |
+      | 'b'  |
+
+  Scenario: IN list predicate
+    When executing query:
+      """
+      MATCH (p:P) WHERE p.name IN ['a', 'c'] RETURN p.name AS name
+      """
+    Then the result should be, in any order:
+      | name |
+      | 'a'  |
+      | 'c'  |
+
+  Scenario: String predicates
+    When executing query:
+      """
+      UNWIND ['apple', 'banana', 'avocado'] AS f
+      WITH f WHERE f STARTS WITH 'a' AND f CONTAINS 'o'
+      RETURN f
+      """
+    Then the result should be, in any order:
+      | f         |
+      | 'avocado' |
+
+  Scenario: Pattern predicate in WHERE
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (a:A {v: 1})-[:R]->(:B), (:A {v: 2})
+      """
+    When executing query:
+      """
+      MATCH (a:A) WHERE (a)-[:R]->() RETURN a.v AS v
+      """
+    Then the result should be, in any order:
+      | v |
+      | 1 |
+
+  Scenario: Negated pattern predicate
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (a:A {v: 1})-[:R]->(:B), (:A {v: 2})
+      """
+    When executing query:
+      """
+      MATCH (a:A) WHERE NOT (a)-[:R]->() RETURN a.v AS v
+      """
+    Then the result should be, in any order:
+      | v |
+      | 2 |
+
+  Scenario: HasLabel predicate on bound variable
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:A:Extra {v: 1}), (:A {v: 2})
+      """
+    When executing query:
+      """
+      MATCH (n:A) WHERE n:Extra RETURN n.v AS v
+      """
+    Then the result should be, in any order:
+      | v |
+      | 1 |

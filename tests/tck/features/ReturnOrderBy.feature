@@ -1,0 +1,89 @@
+Feature: ReturnOrderBy
+
+  Background:
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:N {v: 3}), (:N {v: 1}), (:N {v: 2}), (:N)
+      """
+
+  Scenario: ORDER BY ascending puts nulls last
+    When executing query:
+      """
+      MATCH (n:N) RETURN n.v AS v ORDER BY v
+      """
+    Then the result should be, in order:
+      | v    |
+      | 1    |
+      | 2    |
+      | 3    |
+      | null |
+
+  Scenario: ORDER BY descending
+    When executing query:
+      """
+      MATCH (n:N) WHERE n.v IS NOT NULL RETURN n.v AS v ORDER BY v DESC
+      """
+    Then the result should be, in order:
+      | v |
+      | 3 |
+      | 2 |
+      | 1 |
+
+  Scenario: SKIP and LIMIT
+    When executing query:
+      """
+      MATCH (n:N) WHERE n.v IS NOT NULL RETURN n.v AS v ORDER BY v SKIP 1 LIMIT 1
+      """
+    Then the result should be, in order:
+      | v |
+      | 2 |
+
+  Scenario: RETURN DISTINCT
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:M {v: 1}), (:M {v: 1}), (:M {v: 2})
+      """
+    When executing query:
+      """
+      MATCH (m:M) RETURN DISTINCT m.v AS v
+      """
+    Then the result should be, in any order:
+      | v |
+      | 1 |
+      | 2 |
+
+  Scenario: Returning expressions
+    When executing query:
+      """
+      MATCH (n:N) WHERE n.v = 1 RETURN n.v + 10 AS a, n.v * 2.5 AS b, -n.v AS c
+      """
+    Then the result should be, in any order:
+      | a  | b   | c  |
+      | 11 | 2.5 | -1 |
+
+  Scenario: Return star
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:Q {v: 7})
+      """
+    When executing query:
+      """
+      MATCH (q:Q) RETURN *
+      """
+    Then the result should be, in any order:
+      | q           |
+      | (:Q {v: 7}) |
+
+  Scenario: ORDER BY on expression not in RETURN
+    When executing query:
+      """
+      MATCH (n:N) WHERE n.v IS NOT NULL RETURN n.v AS v ORDER BY -n.v
+      """
+    Then the result should be, in order:
+      | v |
+      | 3 |
+      | 2 |
+      | 1 |

@@ -1,0 +1,41 @@
+Feature: SyntaxErrors
+
+  Scenario: Unclosed node pattern
+    Given an empty graph
+    When executing query:
+      """
+      MATCH (n RETURN n
+      """
+    Then a SyntaxError should be raised at compile time: UnclosedPattern
+
+  Scenario: Undefined variable
+    Given an empty graph
+    When executing query:
+      """
+      MATCH (n) RETURN m
+      """
+    Then a SyntaxError should be raised at compile time: UndefinedVariable
+
+  Scenario: Aggregation inside WHERE
+    Given an empty graph
+    When executing query:
+      """
+      MATCH (n) WHERE count(n) > 1 RETURN n
+      """
+    Then a SyntaxError should be raised at compile time: InvalidAggregation
+
+  Scenario: UNION with different columns
+    Given an empty graph
+    When executing query:
+      """
+      RETURN 1 AS x UNION RETURN 2 AS y
+      """
+    Then a SyntaxError should be raised at compile time: DifferentColumnsInUnion
+
+  Scenario: ORDER BY without RETURN or WITH
+    Given an empty graph
+    When executing query:
+      """
+      MATCH (n) ORDER BY n.v RETURN n
+      """
+    Then a SyntaxError should be raised at compile time: InvalidClauseComposition

@@ -1,0 +1,120 @@
+Feature: UnionFunctions
+
+  Scenario: UNION merges and deduplicates
+    Given an empty graph
+    When executing query:
+      """
+      RETURN 1 AS x UNION RETURN 1 AS x UNION RETURN 2 AS x
+      """
+    Then the result should be, in any order:
+      | x |
+      | 1 |
+      | 2 |
+
+  Scenario: UNION ALL keeps duplicates
+    Given an empty graph
+    When executing query:
+      """
+      RETURN 1 AS x UNION ALL RETURN 1 AS x
+      """
+    Then the result should be, in any order:
+      | x |
+      | 1 |
+      | 1 |
+
+  Scenario: String functions compose
+    Given an empty graph
+    When executing query:
+      """
+      RETURN toUpper('ab') AS u, trim('  x  ') AS t, substring('hello', 1, 3) AS s,
+             replace('axa', 'x', 'y') AS r, left('abcdef', 2) AS l
+      """
+    Then the result should be, in any order:
+      | u    | t   | s     | r     | l    |
+      | 'AB' | 'x' | 'ell' | 'aya' | 'ab' |
+
+  Scenario: Math functions
+    Given an empty graph
+    When executing query:
+      """
+      RETURN abs(-3) AS a, sign(-2) AS s, floor(1.7) AS f, ceil(1.2) AS c,
+             round(2.5) AS r, sqrt(16.0) AS q
+      """
+    Then the result should be, in any order:
+      | a | s  | f   | c   | r   | q   |
+      | 3 | -1 | 1.0 | 2.0 | 3.0 | 4.0 |
+
+  Scenario: Type conversions
+    Given an empty graph
+    When executing query:
+      """
+      RETURN toInteger('42') AS i, toFloat('1.5') AS f, toBoolean('true') AS b,
+             toString(7) AS s, toInteger('nope') AS bad
+      """
+    Then the result should be, in any order:
+      | i  | f   | b    | s   | bad  |
+      | 42 | 1.5 | true | '7' | null |
+
+  Scenario: id labels type keys of elements
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:A:B {p: 1, q: 'x'})-[:T {w: 1}]->(:C)
+      """
+    When executing query:
+      """
+      MATCH (a:A)-[r]->() RETURN labels(a) AS l, type(r) AS t, keys(a) AS k
+      """
+    Then the result should be, in any order, ignoring element order for lists:
+      | l          | t   | k          |
+      | ['A', 'B'] | 'T' | ['p', 'q'] |
+
+  Scenario: exists function on properties
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:N {v: 1}), (:N)
+      """
+    When executing query:
+      """
+      MATCH (n:N) WHERE exists(n.v) RETURN n.v AS v
+      """
+    Then the result should be, in any order:
+      | v |
+      | 1 |
+
+  Scenario: CASE expression simple and searched
+    Given an empty graph
+    When executing query:
+      """
+      UNWIND [1, 2, 3] AS x
+      RETURN x,
+             CASE x WHEN 1 THEN 'one' WHEN 2 THEN 'two' ELSE 'many' END AS simple,
+             CASE WHEN x > 2 THEN 'big' ELSE 'small' END AS searched
+      """
+    Then the result should be, in order:
+      | x | simple | searched |
+      | 1 | 'one'  | 'small'  |
+      | 2 | 'two'  | 'small'  |
+      | 3 | 'many' | 'big'    |
+
+  Scenario: Temporal accessors
+    Given an empty graph
+    When executing query:
+      """
+      WITH date('2020-03-14') AS d
+      RETURN d.year AS y, d.month AS m, d.day AS dd
+      """
+    Then the result should be, in any order:
+      | y    | m | dd |
+      | 2020 | 3 | 14 |
+
+  Scenario: Duration arithmetic on dates
+    Given an empty graph
+    When executing query:
+      """
+      RETURN (date('2020-01-30') + duration({days: 3})).day AS d
+      """
+    Then the result should be, in any order:
+      | d |
+      | 2 |

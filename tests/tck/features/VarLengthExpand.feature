@@ -1,0 +1,76 @@
+Feature: VarLengthExpand
+
+  Background:
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (a:N {n: 'a'})-[:R]->(b:N {n: 'b'})-[:R]->(c:N {n: 'c'})-[:R]->(d:N {n: 'd'})
+      """
+
+  Scenario: Fixed range variable expand
+    When executing query:
+      """
+      MATCH (x:N {n: 'a'})-[:R*2..2]->(y) RETURN y.n AS n
+      """
+    Then the result should be, in any order:
+      | n   |
+      | 'c' |
+
+  Scenario: Bounded range reaches all depths
+    When executing query:
+      """
+      MATCH (x:N {n: 'a'})-[:R*1..3]->(y) RETURN y.n AS n
+      """
+    Then the result should be, in any order:
+      | n   |
+      | 'b' |
+      | 'c' |
+      | 'd' |
+
+  Scenario: Zero length includes the start node
+    When executing query:
+      """
+      MATCH (x:N {n: 'a'})-[:R*0..1]->(y) RETURN y.n AS n
+      """
+    Then the result should be, in any order:
+      | n   |
+      | 'a' |
+      | 'b' |
+
+  Scenario: Relationship isomorphism prevents edge reuse
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (a:M {n: 'a'})-[:R]->(b:M {n: 'b'}), (b)-[:R]->(a)
+      """
+    When executing query:
+      """
+      MATCH (x:M {n: 'a'})-[:R*2..2]->(y) RETURN y.n AS n
+      """
+    Then the result should be, in any order:
+      | n   |
+      | 'a' |
+
+  Scenario: Undirected variable expand
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (a:U {n: 'a'})-[:R]->(b:U {n: 'b'}), (c:U {n: 'c'})-[:R]->(b)
+      """
+    When executing query:
+      """
+      MATCH (x:U {n: 'a'})-[:R*2..2]-(y) RETURN y.n AS n
+      """
+    Then the result should be, in any order:
+      | n   |
+      | 'c' |
+
+  Scenario: Variable expand binds the edge list
+    When executing query:
+      """
+      MATCH (x:N {n: 'a'})-[rs:R*1..2]->(y) RETURN y.n AS n, size(rs) AS hops
+      """
+    Then the result should be, in any order:
+      | n   | hops |
+      | 'b' | 1    |
+      | 'c' | 2    |

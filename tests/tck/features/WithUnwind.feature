@@ -1,0 +1,116 @@
+Feature: WithUnwind
+
+  Scenario: WITH projects and renames
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:N {a: 1, b: 2})
+      """
+    When executing query:
+      """
+      MATCH (n:N) WITH n.a AS x, n.b AS y RETURN x + y AS s
+      """
+    Then the result should be, in any order:
+      | s |
+      | 3 |
+
+  Scenario: WITH WHERE filters mid-pipeline
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:N {v: 1}), (:N {v: 2}), (:N {v: 3})
+      """
+    When executing query:
+      """
+      MATCH (n:N) WITH n.v AS v WHERE v >= 2 RETURN v ORDER BY v
+      """
+    Then the result should be, in order:
+      | v |
+      | 2 |
+      | 3 |
+
+  Scenario: WITH DISTINCT deduplicates
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:N {v: 1}), (:N {v: 1}), (:N {v: 2})
+      """
+    When executing query:
+      """
+      MATCH (n:N) WITH DISTINCT n.v AS v RETURN v ORDER BY v
+      """
+    Then the result should be, in order:
+      | v |
+      | 1 |
+      | 2 |
+
+  Scenario: WITH ORDER BY LIMIT then continue
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:N {v: 3}), (:N {v: 1}), (:N {v: 2})
+      """
+    When executing query:
+      """
+      MATCH (n:N) WITH n ORDER BY n.v DESC LIMIT 2 RETURN n.v AS v ORDER BY v
+      """
+    Then the result should be, in order:
+      | v |
+      | 2 |
+      | 3 |
+
+  Scenario: UNWIND a literal list
+    Given an empty graph
+    When executing query:
+      """
+      UNWIND [1, 2, 3] AS x RETURN x
+      """
+    Then the result should be, in order:
+      | x |
+      | 1 |
+      | 2 |
+      | 3 |
+
+  Scenario: UNWIND an empty list produces no rows
+    Given an empty graph
+    When executing query:
+      """
+      UNWIND [] AS x RETURN x
+      """
+    Then the result should be empty
+
+  Scenario: UNWIND null produces no rows
+    Given an empty graph
+    When executing query:
+      """
+      UNWIND null AS x RETURN x
+      """
+    Then the result should be empty
+
+  Scenario: Nested UNWIND cross product
+    Given an empty graph
+    When executing query:
+      """
+      UNWIND [1, 2] AS x UNWIND ['a', 'b'] AS y RETURN x, y
+      """
+    Then the result should be, in any order:
+      | x | y   |
+      | 1 | 'a' |
+      | 1 | 'b' |
+      | 2 | 'a' |
+      | 2 | 'b' |
+
+  Scenario: UNWIND a collected aggregate
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:N {v: 2}), (:N {v: 1})
+      """
+    When executing query:
+      """
+      MATCH (n:N) WITH collect(n.v) AS vs UNWIND vs AS v RETURN v ORDER BY v
+      """
+    Then the result should be, in order:
+      | v |
+      | 1 |
+      | 2 |

@@ -378,3 +378,12 @@ class TestSqlPgdsErrors:
         assert hash64("a", 1) == hash64("a", 1)
         assert hash64("a", 1) != hash64("a", 2)
         assert 0 <= hash64("x") < 2**63
+
+
+def test_parenthesized_property_types():
+    (et,) = parse_ddl(
+        "CREATE ELEMENT TYPE A ( xs LIST(STRING), y INTEGER )"
+    ).statements
+    props = dict(et.properties)
+    assert props["y"] == T.CTInteger
+    assert "LIST" in str(props["xs"])

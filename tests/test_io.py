@@ -184,3 +184,13 @@ class TestReviewRegressions:
 
         with pytest.raises(DataSourceError, match="line 2"):
             load_edge_list(str(p), session)
+
+
+def test_label_dirs_cannot_path_traverse():
+    from tpu_cypher.io.fs import _combo_dir, _rel_dir
+
+    for evil in (".", "..", "a/../../b"):
+        assert "/" not in _combo_dir({evil})
+        assert _combo_dir({evil}) not in (".", "..")
+        assert "/" not in _rel_dir(evil)
+        assert _rel_dir(evil) not in (".", "..")

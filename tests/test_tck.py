@@ -1,0 +1,43 @@
+"""TCK conformance suite (reference ``TckSparkCypherTest.scala:39-76``):
+whitelisted scenarios must pass; blacklisted scenarios must FAIL — a passing
+blacklisted scenario is a false positive and fails the build."""
+
+import os
+
+import pytest
+
+from tpu_cypher import CypherSession
+from tpu_cypher.tck import ScenariosFor, TckRunner, load_features
+from tpu_cypher.tck.runner import load_blacklist
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+FEATURES = os.path.join(HERE, "tck", "features")
+BLACKLIST = os.path.join(HERE, "tck", "blacklist")
+
+_scenarios = ScenariosFor(load_features(FEATURES), load_blacklist(BLACKLIST))
+_runner = TckRunner(CypherSession.local)
+
+
+@pytest.mark.parametrize(
+    "scenario", _scenarios.white_list, ids=lambda s: str(s)
+)
+def test_whitelist(scenario):
+    r = _runner.run(scenario)
+    assert r.passed, r.message
+
+
+@pytest.mark.parametrize(
+    "scenario", _scenarios.black_list, ids=lambda s: str(s)
+)
+def test_blacklist_still_fails(scenario):
+    r = _runner.run(scenario)
+    assert not r.passed, (
+        f"Blacklisted scenario passed (false positive) — remove it from the "
+        f"blacklist: {scenario}"
+    )
+
+
+def test_blacklist_entries_resolve():
+    # ScenariosFor raises on unknown/stale entries; constructing it at module
+    # scope is the real check — an EMPTY blacklist is the success end-state
+    assert _scenarios.scenarios

@@ -109,3 +109,13 @@ def test_equivalence_decimal_and_huge_ints():
     assert _equiv_key(Decimal(2**53 + 1)) == _equiv_key(2**53 + 1)
     assert cypher_equivalent(Decimal(2**53 + 1), 2**53 + 1)
     assert _equiv_key(Decimal(10**400)) == _equiv_key(10**400)
+
+
+def test_equiv_key_decimal_infinity():
+    from decimal import Decimal
+
+    from tpu_cypher.api.values import _equiv_key
+
+    assert _equiv_key(Decimal("Infinity")) == _equiv_key(float("inf"))
+    assert _equiv_key(Decimal("-Infinity")) == _equiv_key(float("-inf"))
+    assert cypher_equivalent(Decimal("Infinity"), float("inf"))

@@ -363,6 +363,8 @@ def _equiv_key(v) -> Any:
         if isinstance(v, Decimal):
             if v.is_nan():
                 return ("nan",)
+            if v.is_infinite():
+                return ("num", math.inf if v > 0 else -math.inf)
             try:
                 f = float(v)
             except OverflowError:

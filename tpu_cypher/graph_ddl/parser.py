@@ -229,13 +229,23 @@ class _Parser:
 
     def property(self) -> A.Property:
         name = self.escaped_identifier()
-        # collect the type's raw token span up to ',' / ')' / KEY
+        # collect the type's raw token span up to ',' / ')' / KEY; parens may
+        # nest inside the type itself (LIST(STRING), MAP(...))
         parts: List[str] = []
+        depth = 0
         while True:
             t = self.peek()
-            if t is None or (t.kind == "sym" and t.text in ",)"):
+            if t is None:
                 break
-            if t.kind == "word" and t.text.upper() == "KEY":
+            if t.kind == "sym" and t.text == "(":
+                depth += 1
+            elif t.kind == "sym" and t.text == ")":
+                if depth == 0:
+                    break
+                depth -= 1
+            elif t.kind == "sym" and t.text == "," and depth == 0:
+                break
+            elif t.kind == "word" and t.text.upper() == "KEY" and depth == 0:
                 break
             self.next()
             parts.append(t.text)

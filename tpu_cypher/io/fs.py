@@ -41,9 +41,12 @@ METADATA_FILE = "metadata.json"
 
 
 def _escape_label(label: str) -> str:
-    # '_' is the combo separator, and quote() leaves it unescaped — escape it
-    # so {'A','B_C'} and {'A_B','C'} map to distinct directories
-    return urllib.parse.quote(label, safe="").replace("_", "%5F")
+    # '_' is the combo separator and '.' enables '..' path traversal; quote()
+    # leaves both unescaped, so escape them by hand — {'A','B_C'} vs
+    # {'A_B','C'} stay distinct and (:`..`) cannot climb out of the graph dir
+    return (
+        urllib.parse.quote(label, safe="").replace("_", "%5F").replace(".", "%2E")
+    )
 
 
 def _combo_dir(labels) -> str:
@@ -51,7 +54,7 @@ def _combo_dir(labels) -> str:
 
 
 def _rel_dir(rel_type: str) -> str:
-    return urllib.parse.quote(rel_type, safe="")
+    return _escape_label(rel_type)
 
 
 # ---------------------------------------------------------------------------
