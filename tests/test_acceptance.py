@@ -872,3 +872,138 @@ class TestZeroLengthVarExpand:
             "MATCH (x)-[:R1]->(y) RETURN id(x) <> id(y) AS diff",
             [{"diff": True}],
         )
+
+
+# ---------------------------------------------------------------------------
+# Named paths — a capability the REFERENCE does NOT have: it blacklists every
+# named-path TCK scenario (morpheus-tck/src/test/resources/failing_blacklist,
+# "Named path"/"named paths" entries). Path bindings are header metadata
+# (RecordHeader._paths) reassembled from member element columns.
+# ---------------------------------------------------------------------------
+
+
+class TestNamedPaths:
+    @pytest.fixture(scope="class")
+    def g(self, session):
+        return init_graph(
+            session,
+            "CREATE (a:A {n:1})-[:R {w:2}]->(b:B {n:2})-[:R {w:3}]->(c:C {n:3})",
+        )
+
+    def test_path_binding_and_length(self, g):
+        assert_results(
+            g,
+            "MATCH p = (:A)-[:R]->(b) RETURN length(p) AS l, b.n AS n",
+            [{"l": 1, "n": 2}],
+        )
+
+    def test_path_value_structure(self, g):
+        rows = results(g, "MATCH p = (a:A)-[r:R]->(b) RETURN p")
+        (row,) = list(rows.counter)
+        p = row["p"]
+        assert [type(e).__name__ for e in p.elements] == [
+            "Node",
+            "Relationship",
+            "Node",
+        ]
+        assert set(p.elements[0].labels) == {"A"}
+        assert p.elements[1].properties == {"w": 2}
+
+    def test_nodes_relationships_functions(self, g):
+        rows = results(
+            g,
+            "MATCH p = (:A)-[:R]->() RETURN nodes(p) AS ns, relationships(p) AS rs",
+        )
+        (row,) = list(rows.counter)
+        assert [n.properties["n"] for n in row["ns"]] == [1, 2]
+        assert [r.properties["w"] for r in row["rs"]] == [2]
+
+    def test_var_length_path(self, g):
+        assert_results(
+            g,
+            "MATCH p = (:A)-[:R*1..2]->(x) RETURN length(p) AS l, x.n AS n",
+            [{"l": 1, "n": 2}, {"l": 2, "n": 3}],
+        )
+
+    def test_where_on_path(self, g):
+        assert_results(
+            g,
+            "MATCH p = (a)-[:R*1..2]->(b) WHERE length(p) = 2 "
+            "RETURN a.n AS s, b.n AS t",
+            [{"s": 1, "t": 3}],
+        )
+
+    def test_zero_length_path_single_node(self, g):
+        rows = results(g, "MATCH p = (a:A)-[:R*0..1]->(x) RETURN p, length(p) AS l")
+        lens = sorted(r["l"] for r in rows.counter.elements())
+        assert lens == [0, 1]
+        zero = next(r["p"] for r in rows.counter if r["l"] == 0)
+        assert len(zero.elements) == 1
+
+    def test_optional_match_null_path(self, g):
+        assert_results(
+            g,
+            "MATCH (x:C) OPTIONAL MATCH p = (x)-[:R]->(y) RETURN p",
+            [{"p": None}],
+        )
+
+    def test_path_through_with_alias(self, g):
+        assert_results(
+            g,
+            "MATCH p = (:A)-[:R]->(b) WITH p AS q RETURN length(q) AS l",
+            [{"l": 1}],
+        )
+
+    def test_distinct_path(self, g):
+        assert_results(
+            g,
+            "MATCH p = (:A)-[:R]->(b) RETURN DISTINCT length(p) AS l",
+            [{"l": 1}],
+        )
+
+    def test_two_paths_in_one_match(self, g):
+        assert_results(
+            g,
+            "MATCH p = (a:A)-[:R]->(b), q = (b)-[:R]->(c) "
+            "RETURN length(p) + length(q) AS l",
+            [{"l": 2}],
+        )
+
+    def test_path_rebind_rejected(self, g):
+        import pytest as _pytest
+
+        with _pytest.raises(Exception, match="already bound"):
+            g.cypher("MATCH p = (a)-[:R]->(b), p = (x)-[:R]->(y) RETURN p").records
+
+    def test_member_vars_do_not_leak_past_with(self, g):
+        # regression: member columns must be hidden after WITH p, so a later
+        # MATCH can rebind the member name with fresh semantics
+        assert_results(
+            g,
+            "MATCH p = (a)-[:R]->(b) WITH p MATCH (a:C) "
+            "RETURN length(p) AS l, a.n AS n",
+            [{"l": 1, "n": 3}, {"l": 1, "n": 3}],
+        )
+
+    def test_group_by_path(self, g):
+        assert_results(
+            g,
+            "MATCH p = (a:A)-[:R]->(b) RETURN p, count(*) AS c",
+            [
+                {
+                    "p": next(
+                        iter(
+                            results(g, "MATCH p = (a:A)-[:R]->(b) RETURN p").counter
+                        )
+                    )["p"],
+                    "c": 1,
+                }
+            ],
+        )
+
+    def test_var_length_intermediate_nodes_full(self, g):
+        # regression: interior hop nodes carry labels/properties, not id stubs
+        rows = results(g, "MATCH p = (:A)-[:R*2]->(x) RETURN nodes(p) AS ns")
+        (row,) = list(rows.counter)
+        assert [n.properties.get("n") for n in row["ns"]] == [1, 2, 3]
+        assert set(row["ns"][1].labels) == {"B"}

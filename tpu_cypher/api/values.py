@@ -226,6 +226,24 @@ class Path:
     def __hash__(self) -> int:
         return hash(("Path", self.elements))
 
+    def __repr__(self) -> str:
+        # TCK-style: <(:A)-[:R]->(:B)>; arrow orientation from the stored
+        # relationship endpoints relative to the previous node in the walk
+        out = []
+        prev_node_id = None
+        for e in self.elements:
+            if isinstance(e, Relationship):
+                if prev_node_id is not None and e.start == prev_node_id:
+                    out.append(f"-{e!r}->")
+                    prev_node_id = None
+                else:
+                    out.append(f"<-{e!r}-")
+                    prev_node_id = None
+            else:
+                out.append(repr(e))
+                prev_node_id = e.id
+        return "<" + "".join(out) + ">"
+
 
 class CypherMap(dict):
     """A row of named Cypher values (reference ``CypherMap``, ``:301``).

@@ -62,6 +62,10 @@ class Evaluator:
             return lambda r, c=col: r[c]
 
         if isinstance(expr, E.Var):
+            if self.header is not None and self.header.has_path(expr.name):
+                from ...relational.materialize import path_materializer
+
+                return path_materializer(self.header, expr)
             mat = expr.cypher_type.material
             if isinstance(mat, T.CTNodeType):
                 return self._element_fn(expr, node=True)

@@ -157,6 +157,10 @@ class IRBuilder:
                 env[r] = T.CTListType(t)
             else:
                 env[r] = t
+        for pname in pattern.paths:
+            if pname in env or pname in pattern.node_types or pname in pattern.rel_types:
+                raise IRBuildError(f"Path variable {pname!r} already bound")
+            env[pname] = T.CTPath
         preds = list(predicates)
         if c.where is not None:
             w = self.convert_expr(c.where, env)
@@ -268,6 +272,10 @@ class IRBuilder:
                 path_fields.append(nxt)
                 prev_node = nxt
             if part.path_var:
+                if part.path_var in ir.paths:
+                    raise IRBuildError(
+                        f"Path variable {part.path_var!r} already bound"
+                    )
                 ir.paths[part.path_var] = tuple(path_fields)
         return ir, predicates
 

@@ -136,10 +136,22 @@ def _f_size(v):
 
 def _f_length(v):
     if isinstance(v, Path):
-        return max(0, (len(v.elements) - 1) // 2)
+        return sum(1 for e in v.elements if isinstance(e, Relationship))
     if isinstance(v, (list, tuple, str)):
         return len(v)
     raise CypherTypeError("length() expects a path, list or string")
+
+
+def _f_nodes(v):
+    if isinstance(v, Path):
+        return [e for e in v.elements if isinstance(e, Node)]
+    raise CypherTypeError("nodes() expects a path")
+
+
+def _f_relationships(v):
+    if isinstance(v, Path):
+        return [e for e in v.elements if isinstance(e, Relationship)]
+    raise CypherTypeError("relationships() expects a path")
 
 
 def _f_range(*args):
@@ -178,6 +190,8 @@ def _list_inner(args: List[CypherType]) -> CypherType:
 
 _register("size", _f_size, T.CTInteger)
 _register("length", _f_length, T.CTInteger)
+_register("nodes", _f_nodes, T.CTList(T.CTNode()))
+_register("relationships", _f_relationships, T.CTList(T.CTRelationship()))
 _register("range", _f_range, T.CTList(T.CTInteger), min_args=2, max_args=3)
 _register(
     "coalesce",

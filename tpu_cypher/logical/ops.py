@@ -141,6 +141,25 @@ class PatternScan(UnaryOp):
 
 
 @dataclass(frozen=True)
+class BindPath(UnaryOp):
+    """Bind a named path variable to its ordered member element fields
+    (``MATCH p = (...)``). No reference analog — the reference blacklists all
+    named-path TCK scenarios (``morpheus-tck/.../failing_blacklist``)."""
+
+    path_var: str = ""
+    entities: Tuple[str, ...] = ()
+
+    @property
+    def fields(self) -> FieldsT:
+        from ..api import types as _T
+
+        return self.in_op.fields + ((self.path_var, _T.CTPath),)
+
+    def _show_inner(self) -> str:
+        return f"{self.path_var} = ({', '.join(self.entities)})"
+
+
+@dataclass(frozen=True)
 class Filter(UnaryOp):
     predicate: Expr
 
@@ -367,6 +386,10 @@ class BoundedVarLengthExpand(BinaryOp):
     direction: str
     lower: int
     upper: int
+    # when a named path spans this rel, intermediate hop nodes are captured
+    # (per-hop node-scan joins + hidden companion list column) so the path
+    # value carries full node elements, not id-only placeholders
+    capture_path_nodes: bool = False
 
     @property
     def fields(self) -> FieldsT:

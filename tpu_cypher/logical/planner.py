@@ -147,12 +147,17 @@ class LogicalPlanner:
     # ------------------------------------------------------------------
 
     def plan_match(self, blk: B.MatchBlock, plan: L.LogicalOperator) -> L.LogicalOperator:
+        # paths bind before predicates so WHERE can reference the path var
         if blk.optional:
             rhs = self._plan_pattern(blk.pattern, plan)
+            for pname, fields in sorted(blk.pattern.paths.items()):
+                rhs = L.BindPath(rhs, pname, tuple(fields))
             for p in blk.predicates:
                 rhs = self._plan_predicate(p, rhs)
             return L.Optional(plan, rhs)
         plan = self._plan_pattern(blk.pattern, plan)
+        for pname, fields in sorted(blk.pattern.paths.items()):
+            plan = L.BindPath(plan, pname, tuple(fields))
         for p in blk.predicates:
             plan = self._plan_predicate(p, plan)
         return plan
@@ -250,13 +255,15 @@ class LogicalPlanner:
         upper = c.upper
         if upper is None:
             raise LogicalPlanningError("Unbounded var-length expand not supported")
+        capture = any(rel in fields for fields in pattern.paths.values())
         if src_solved and dst_solved:
             # expand to a fresh target, then align on id equality
             fresh_t = self.fresh(f"vt_{c.target}")
             t_type = pattern.node_types[c.target]
             scan = L.NodeScan(L.Start(graph, ()), fresh_t, t_type)
             expand = L.BoundedVarLengthExpand(
-                plan, scan, c.source, rel, rel_type, fresh_t, c.direction, c.lower, upper
+                plan, scan, c.source, rel, rel_type, fresh_t, c.direction,
+                c.lower, upper, capture,
             )
             eq = E.Equals(
                 E.Id(E.Var(fresh_t).with_type(t_type)).with_type(T.CTInteger),
@@ -266,7 +273,8 @@ class LogicalPlanner:
         new_node = c.target if src_solved else c.source
         scan = L.NodeScan(L.Start(graph, ()), new_node, pattern.node_types[new_node])
         return L.BoundedVarLengthExpand(
-            plan, scan, c.source, rel, rel_type, c.target, c.direction, c.lower, upper
+            plan, scan, c.source, rel, rel_type, c.target, c.direction,
+            c.lower, upper, capture,
         )
 
     # ------------------------------------------------------------------

@@ -50,6 +50,12 @@ def _owner_name(expr: E.Expr) -> str:
     return inner.pretty_expr()
 
 
+def path_nodes_companion(rel_field: str) -> str:
+    """Hidden column name carrying the full intermediate node elements of a
+    var-length path segment (see planner ``capture_path_nodes``)."""
+    return f"__pathnodes_{rel_field}"
+
+
 def owner_of(expr: E.Expr) -> Optional[E.Var]:
     """The element variable an expression column belongs to (if any)."""
     if isinstance(expr, E.Var):
@@ -62,12 +68,24 @@ def owner_of(expr: E.Expr) -> Optional[E.Var]:
 
 
 class RecordHeader:
-    """Immutable expr -> column mapping."""
+    """Immutable expr -> column mapping.
 
-    __slots__ = ("_map",)
+    Named paths (``MATCH p = (...)``) are tracked in a side table
+    ``_paths: path var name -> ordered member field names`` — a path binding
+    owns no physical column of its own; it is reassembled at materialization
+    time from the columns of its member element variables. (The reference
+    blacklists all named-path TCK scenarios — this is a capability the
+    reference does NOT have.)"""
 
-    def __init__(self, mapping: Optional[Dict[E.Expr, str]] = None):
+    __slots__ = ("_map", "_paths")
+
+    def __init__(
+        self,
+        mapping: Optional[Dict[E.Expr, str]] = None,
+        paths: Optional[Dict[str, Tuple[str, ...]]] = None,
+    ):
         self._map: Dict[E.Expr, str] = dict(mapping or {})
+        self._paths: Dict[str, Tuple[str, ...]] = dict(paths or {})
 
     # -- queries -----------------------------------------------------------
 
@@ -105,12 +123,15 @@ class RecordHeader:
 
     @property
     def vars(self) -> List[E.Var]:
-        """All element/value variables present."""
+        """All element/value variables present (incl. path bindings)."""
         seen: Dict[str, E.Var] = {}
         for e in self._map:
             v = owner_of(e)
             if v is not None and v.name not in seen:
                 seen[v.name] = v
+        for p in self._paths:
+            if p not in seen:
+                seen[p] = E.Var(p).with_type(T.CTPath)
         return list(seen.values())
 
     def var(self, name: str) -> E.Var:
@@ -120,8 +141,31 @@ class RecordHeader:
         raise KeyError(f"No variable {name!r} in header")
 
     def expressions_for(self, var: E.Var) -> List[E.Expr]:
-        """All expressions owned by ``var`` (incl. the var itself)."""
+        """All expressions owned by ``var`` (incl. the var itself). For a path
+        binding: all expressions of all member element variables."""
+        if var.name in self._paths:
+            out: List[E.Expr] = []
+            for f in self._paths[var.name]:
+                out.extend(e for e in self._map if _owned_by(e, f))
+                comp = path_nodes_companion(f)
+                out.extend(e for e in self._map if _owned_by(e, comp))
+            return out
         return [e for e in self._map if _owned_by(e, var.name)]
+
+    @property
+    def paths(self) -> Dict[str, Tuple[str, ...]]:
+        return dict(self._paths)
+
+    def has_path(self, name: str) -> bool:
+        return name in self._paths
+
+    def path_entities(self, name: str) -> Tuple[str, ...]:
+        return self._paths[name]
+
+    def with_path(self, name: str, entities: Tuple[str, ...]) -> "RecordHeader":
+        p = dict(self._paths)
+        p[name] = tuple(entities)
+        return RecordHeader(self._map, p)
 
     def id_expr(self, var: E.Var) -> E.Expr:
         for e in self._map:
@@ -158,7 +202,7 @@ class RecordHeader:
         col = column if column is not None else self._fresh_column(expr)
         m = dict(self._map)
         m[expr] = col
-        return RecordHeader(m)
+        return RecordHeader(m, self._paths)
 
     def with_exprs(self, *exprs: E.Expr) -> "RecordHeader":
         h = self
@@ -178,28 +222,81 @@ class RecordHeader:
 
     def with_alias(self, alias: E.Var, original: E.Var) -> "RecordHeader":
         """Bind ``alias`` to the same columns as ``original``
-        (reference ``withAlias``)."""
+        (reference ``withAlias``). Aliasing a path binding re-registers the
+        same member fields under the alias name."""
+        if original.name in self._paths:
+            p = dict(self._paths)
+            p[alias.name] = self._paths[original.name]
+            return RecordHeader(self._map, p)
         m = dict(self._map)
         for e in self.expressions_for(original):
             m[_replace_owner(e, alias)] = self._map[e]
-        return RecordHeader(m)
+        return RecordHeader(m, self._paths)
 
     def select(self, vars_or_exprs: Iterable[E.Expr]) -> "RecordHeader":
-        """Keep only the given vars (with their sub-expressions) / exprs."""
+        """Keep only the given vars (with their sub-expressions) / exprs.
+
+        Selecting a path binding keeps its member element columns, but
+        re-owned under reserved ``__path_…`` names unless the member variable
+        is itself selected — otherwise the member columns would leak the
+        original variable names past a WITH and shadow later rebinding."""
+        xs = list(vars_or_exprs)
+        explicit = {x.name for x in xs if isinstance(x, E.Var)}
         keep: Dict[E.Expr, str] = {}
-        for x in vars_or_exprs:
+        paths: Dict[str, Tuple[str, ...]] = {}
+        hidden: Dict[str, str] = {}  # original member field -> hidden name
+        for x in xs:
             if isinstance(x, E.Var):
+                if x.name in self._paths:
+                    fields = []
+                    for f in self._paths[x.name]:
+                        comp = path_nodes_companion(f)
+                        comp_exprs = [e for e in self._map if _owned_by(e, comp)]
+                        if f in explicit or f.startswith("__path_"):
+                            # explicitly selected, or already hidden by an
+                            # earlier select: keep under the current name
+                            fv = self.var(f)
+                            for e in self.expressions_for(fv):
+                                keep[e] = self._map[e]
+                            for e in comp_exprs:
+                                keep[e] = self._map[e]
+                            fields.append(f)
+                            continue
+                        hf = hidden.get(f)
+                        if hf is None:
+                            hf = f"__path_{f}"
+                            hidden[f] = hf
+                            fv = self.var(f)
+                            hv = E.Var(hf).with_type(fv.typ)
+                            for e in self.expressions_for(fv):
+                                keep[_replace_owner(e, hv)] = self._map[e]
+                            if comp_exprs:
+                                cv = E.Var(path_nodes_companion(hf)).with_type(
+                                    self.var(comp).typ
+                                )
+                                for e in comp_exprs:
+                                    keep[_replace_owner(e, cv)] = self._map[e]
+                        fields.append(hf)
+                    paths[x.name] = tuple(fields)
+                    # member exprs already kept (hidden or via their own
+                    # explicit selection) — do not re-keep under original names
+                    continue
                 for e in self.expressions_for(x):
                     keep[e] = self._map[e]
                 if x in self._map:
                     keep[x] = self._map[x]
             elif x in self._map:
                 keep[x] = self._map[x]
-        return RecordHeader(keep)
+        return RecordHeader(keep, paths)
 
     def without(self, var: E.Var) -> "RecordHeader":
+        if var.name in self._paths:
+            p = {n: f for n, f in self._paths.items() if n != var.name}
+            return RecordHeader(self._map, p)
         drop = set(self.expressions_for(var))
-        return RecordHeader({e: c for e, c in self._map.items() if e not in drop})
+        return RecordHeader(
+            {e: c for e, c in self._map.items() if e not in drop}, self._paths
+        )
 
     def union(self, other: "RecordHeader") -> "RecordHeader":
         """Disjoint union; other's conflicting column names are renamed."""
@@ -220,20 +317,26 @@ class RecordHeader:
                 renames[c] = col
                 used.add(col)
             m[e] = col
-        return RecordHeader(m)
+        paths = dict(self._paths)
+        paths.update(other._paths)
+        return RecordHeader(m, paths)
 
     def rename_columns(self, mapping: Dict[str, str]) -> "RecordHeader":
         return RecordHeader(
-            {e: mapping.get(c, c) for e, c in self._map.items()}
+            {e: mapping.get(c, c) for e, c in self._map.items()}, self._paths
         )
 
     # -- misc --------------------------------------------------------------
 
     def __eq__(self, other) -> bool:
-        return isinstance(other, RecordHeader) and self._map == other._map
+        return (
+            isinstance(other, RecordHeader)
+            and self._map == other._map
+            and self._paths == other._paths
+        )
 
     def __hash__(self) -> int:
-        return hash(frozenset(self._map.items()))
+        return hash((frozenset(self._map.items()), frozenset(self._paths.items())))
 
     def __repr__(self) -> str:
         inner = ", ".join(

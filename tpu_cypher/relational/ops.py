@@ -203,6 +203,25 @@ class AliasOp(RelationalOperator):
         return ", ".join(f"{o.name} AS {a.name}" for o, a in self.aliases)
 
 
+class PathBindOp(RelationalOperator):
+    """Register a named-path binding in the header — metadata only; the path
+    value is reassembled from member element columns at materialization."""
+
+    def __init__(self, in_op: RelationalOperator, path_var: str, entities: Sequence[str]):
+        super().__init__(in_op)
+        self.path_var = path_var
+        self.entities = tuple(entities)
+
+    def _compute_header(self) -> RecordHeader:
+        return self.children[0].header.with_path(self.path_var, self.entities)
+
+    def _compute_table(self) -> Table:
+        return self.children[0].table
+
+    def _show_inner(self) -> str:
+        return f"{self.path_var} = ({', '.join(self.entities)})"
+
+
 class AddOp(RelationalOperator):
     """Project an expression into a (new or replaced) field column
     (reference ``Add``/``AddInto``, ``RelationalOperator.scala:219-249``)."""
@@ -318,6 +337,8 @@ class AggregateOp(RelationalOperator):
             v = in_h.var(f)
             for e in in_h.expressions_for(v):
                 h = h.with_expr(e, in_h.column(e))
+            if in_h.has_path(f):
+                h = h.with_path(f, in_h.path_entities(f))
         for name, agg in self.aggregations:
             h = h.with_expr(E.Var(name).with_type(agg.cypher_type))
         return h
@@ -476,7 +497,10 @@ class JoinOp(RelationalOperator):
                 drop_cols.append(target)
         # all rhs columns that were renamed but only duplicate lhs data get dropped;
         # join key columns from rhs are also dropped post-join
-        header = RecordHeader({**{e: lh.column(e) for e in lh.expressions}, **new_map})
+        header = RecordHeader(
+            {**{e: lh.column(e) for e in lh.expressions}, **new_map},
+            {**lh.paths, **rh.paths},
+        )
         self._plan = (renames, new_map, drop_cols, header)
         return self._plan
 

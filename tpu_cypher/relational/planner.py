@@ -20,7 +20,12 @@ from typing import Dict, List, Optional as Opt, Sequence, Tuple
 from ..api import types as T
 from ..ir import expr as E
 from ..logical import ops as L
-from .header import RecordHeader, header_for_node, header_for_relationship
+from .header import (
+    RecordHeader,
+    header_for_node,
+    header_for_relationship,
+    path_nodes_companion,
+)
 from .ops import (
     AddOp,
     AliasOp,
@@ -33,6 +38,7 @@ from .ops import (
     JoinOp,
     LimitOp,
     OrderByOp,
+    PathBindOp,
     RelationalError,
     RelationalOperator,
     RelationalRuntimeContext,
@@ -113,6 +119,9 @@ class RelationalPlanner:
 
     def _plan_Filter(self, op: L.Filter) -> RelationalOperator:
         return FilterOp(self.process(op.in_op), op.predicate)
+
+    def _plan_BindPath(self, op: L.BindPath) -> RelationalOperator:
+        return PathBindOp(self.process(op.in_op), op.path_var, op.entities)
 
     def _plan_Project(self, op: L.Project) -> RelationalOperator:
         in_plan = self.process(op.in_op)
@@ -329,6 +338,18 @@ class RelationalPlanner:
         graph = rhs.graph
         out_fields = [v.name for v in lhs.header.vars] + [op.target, op.rel]
         rel_elem_type = op.rel_type.material
+        capture = getattr(op, "capture_path_nodes", False)
+        node_companion = path_nodes_companion(op.rel)
+        node_elem_type = T.CTNodeType(frozenset())
+        if capture:
+            out_fields.append(node_companion)
+
+        def with_companion(branch, node_vars):
+            if not capture:
+                return branch
+            items = tuple(E.Var(n).with_type(node_elem_type) for n in node_vars)
+            expr = E.ListLit(items).with_type(T.CTListType(node_elem_type))
+            return AddOp(branch, expr, node_companion)
 
         branches: List[RelationalOperator] = []
         if op.lower == 0:
@@ -339,9 +360,11 @@ class RelationalPlanner:
             )
             empty_list = E.ListLit(()).with_type(T.CTListType(rel_elem_type))
             zero = AddOp(zero, empty_list, op.rel)
+            zero = with_companion(zero, [])
             branches.append(SelectOp(zero, out_fields))
         current = lhs
         step_vars: List[str] = []
+        node_vars: List[str] = []  # intermediate hop nodes (named paths only)
         prev_end: E.Expr = self._id_of(lhs, op.source)
         for step in range(1, op.upper + 1):
             step_var = self.fresh(f"step_{op.rel}")
@@ -370,8 +393,18 @@ class RelationalPlanner:
                 )
                 list_expr = E.ListLit(items).with_type(T.CTListType(rel_elem_type))
                 branch = AddOp(branch, list_expr, op.rel)
+                branch = with_companion(branch, node_vars)
                 branch = SelectOp(branch, out_fields)
                 branches.append(branch)
+            if capture and step < op.upper:
+                # join the full node element at this hop boundary so named
+                # paths carry real intermediate nodes, not id-only stubs
+                nv = self.fresh(f"pn_{op.rel}")
+                nscan = graph.scan_operator(nv, node_elem_type, self.ctx)
+                current = JoinOp(
+                    current, nscan, [(prev_end, self._id_of(nscan, nv))]
+                )
+                node_vars.append(nv)
         out = branches[0]
         for b in branches[1:]:
             out = UnionAllOp(out, b)

@@ -29,14 +29,20 @@ class RelationalCypherRecords:
         return self.table.size
 
     def _materializers(self):
-        from .materialize import node_materializer, relationship_materializer
+        from .materialize import (
+            node_materializer,
+            path_materializer,
+            relationship_materializer,
+        )
 
         h = self.header
         out = []
         for name in self.columns:
             var = h.var(name)
             m = (var.cypher_type or T.CTAny.nullable).material
-            if isinstance(m, T.CTNodeType):
+            if h.has_path(name):
+                out.append((name, path_materializer(h, var)))
+            elif isinstance(m, T.CTNodeType):
                 out.append((name, node_materializer(h, var)))
             elif isinstance(m, T.CTRelationshipType):
                 out.append((name, relationship_materializer(h, var)))
