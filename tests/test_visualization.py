@@ -1,0 +1,86 @@
+"""Zeppelin/notebook rendering tests (reference ZeppelinSupport behavior:
+``okapi-api/.../util/ZeppelinSupport.scala``)."""
+
+import json
+
+import pytest
+
+from tpu_cypher import CypherSession
+
+
+@pytest.fixture(scope="module")
+def session():
+    return CypherSession.local()
+
+
+@pytest.fixture(scope="module")
+def g(session):
+    return session.create_graph_from_create_query(
+        "CREATE (a:Person {name:'Alice', age:23})-[:KNOWS {since:2019}]->"
+        "(b:Person:Admin {name:'Bob'}), (a)-[:READS]->(:Book {title:'G'})"
+    )
+
+
+def test_table_tsv(g):
+    out = g.cypher("MATCH (p:Person) RETURN p.name, p.age").records.to_table_tsv()
+    lines = out.split("\n")
+    assert lines[0] == "p.name\tp.age"
+    assert sorted(lines[1:]) == ["'Alice'\t23", "'Bob'\tnull"]
+
+
+def test_records_graph_json(g):
+    out = g.cypher("MATCH (a)-[r:KNOWS]->(b) RETURN a, r, b").records.to_graph_json()
+    data = json.loads(out)
+    assert data["directed"] is True
+    assert data["types"] == ["KNOWS"]
+    assert sorted(data["labels"]) == ["Admin", "Person"]
+    assert len(data["nodes"]) == 2
+    (edge,) = data["edges"]
+    assert edge["label"] == "KNOWS"
+    assert edge["data"] == {"since": 2019}
+    # ids are strings, endpoints resolve to node ids
+    node_ids = {n["id"] for n in data["nodes"]}
+    assert edge["source"] in node_ids and edge["target"] in node_ids
+
+
+def test_node_dedup_across_rows(g):
+    # Alice appears in two rows (KNOWS + READS) but once in the JSON
+    out = g.cypher("MATCH (a:Person {name:'Alice'})-[r]->(x) RETURN a, r, x").records
+    data = json.loads(out.to_graph_json())
+    alice = [n for n in data["nodes"] if n["data"].get("name") == "Alice"]
+    assert len(alice) == 1
+    assert len(data["edges"]) == 2
+
+
+def test_whole_graph_json(g):
+    data = json.loads(g.to_visualization_json())
+    assert len(data["nodes"]) == 3
+    assert len(data["edges"]) == 2
+    assert data["labels"] == ["Admin", "Book", "Person"]
+    assert data["types"] == ["KNOWS", "READS"]
+
+
+def test_node_json_shape(g):
+    data = json.loads(g.to_visualization_json())
+    bob = next(n for n in data["nodes"] if n["data"].get("name") == "Bob")
+    assert bob["label"] == "Admin"  # first label lexicographically
+    assert bob["labels"] == ["Admin", "Person"]
+    assert isinstance(bob["id"], str)
+
+
+def test_repr_html(g):
+    html = g.cypher("MATCH (b:Book) RETURN b.title").records._repr_html_()
+    assert "<table>" in html and "b.title" in html and "G" in html
+
+
+def test_visualize_dispatch(g, session):
+    from tpu_cypher.utils.visualization import visualize
+
+    tab = visualize(g.cypher("MATCH (b:Book) RETURN b.title"))
+    assert tab.startswith("b.title")
+    gres = g.cypher(
+        "MATCH (b:Book) CONSTRUCT CLONE b RETURN GRAPH"
+    )
+    out = visualize(gres)
+    data = json.loads(out)
+    assert len(data["nodes"]) == 1 and data["labels"] == ["Book"]

@@ -66,5 +66,22 @@ class RelationalCypherRecords:
         rows = [[m[c] for c in self.columns] for m in self.collect()[: max(n, 0)]]
         return format_rows(self.columns, rows)
 
+    # -- notebook / Zeppelin renderings (reference ZeppelinSupport) --------
+
+    def to_table_tsv(self) -> str:
+        from ..utils.visualization import records_to_table_tsv
+
+        return records_to_table_tsv(self)
+
+    def to_graph_json(self, indent: int = 2) -> str:
+        from ..utils.visualization import records_to_graph_json
+
+        return records_to_graph_json(self, indent)
+
+    def _repr_html_(self) -> str:
+        from ..utils.visualization import records_to_html
+
+        return records_to_html(self)
+
     def __repr__(self) -> str:
         return f"CypherRecords({self.size} rows: {', '.join(self.columns)})"

@@ -111,6 +111,13 @@ class PropertyGraph:
             self.session, UnionGraph([self._graph] + [o._graph for o in others])
         )
 
+    def to_visualization_json(self, indent: int = 2) -> str:
+        """Zeppelin ``%network``-style JSON of the whole graph
+        (reference ``ZeppelinSupport.ZeppelinGraph``)."""
+        from ..utils.visualization import graph_to_json
+
+        return graph_to_json(self, indent)
+
 
 class CypherSession:
     """Reference ``CypherSession``/``RelationalCypherSession``."""
