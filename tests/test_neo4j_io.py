@@ -1,0 +1,139 @@
+"""Neo4j IO tests — driver-free surface (reference ``okapi-neo4j-io`` +
+``Neo4jBulkCSVDataSink``): query/statement builders and the bulk CSV export.
+The live PGDS paths are gated on the optional driver and tested for the gate
+only."""
+
+import csv
+import os
+
+import pytest
+
+from tpu_cypher import CypherSession
+from tpu_cypher.io.datasource import DataSourceError
+from tpu_cypher.io.neo4j import (
+    Neo4jBulkCSVDataSink,
+    Neo4jConfig,
+    Neo4jPropertyGraphDataSource,
+    create_index_statement,
+    exact_label_match_query,
+    merge_node_statement,
+    merge_relationship_statement,
+    rel_type_query,
+)
+
+
+@pytest.fixture(scope="module")
+def session():
+    return CypherSession.local()
+
+
+@pytest.fixture(scope="module")
+def g(session):
+    return session.create_graph_from_create_query(
+        "CREATE (a:Person {name:'Alice', age:23})-[:KNOWS {since:2019}]->"
+        "(b:Person {name:'Bob', age:42}), (a)-[:READS]->(:Book {title:'G'})"
+    )
+
+
+class TestQueryBuilders:
+    def test_exact_label_query(self):
+        q = exact_label_match_query(["Person", "Admin"], ["name", "age"])
+        assert "MATCH (n:`Admin`:`Person`)" in q
+        assert "size(labels(n)) = 2" in q
+        assert q.index("n.`age`") < q.index("n.`name`")
+
+    def test_rel_type_query(self):
+        q = rel_type_query("KNOWS", ["since"])
+        assert "-[r:`KNOWS`]->" in q
+        assert "id(s) AS" in q and "id(t) AS" in q and "r.`since`" in q
+
+    def test_create_index(self):
+        assert (
+            create_index_statement("Person", ["name"])
+            == "CREATE INDEX ON :`Person`(`name`)"
+        )
+
+    def test_merge_node(self):
+        s = merge_node_statement(["Person"], ["id"], ["name", "age"])
+        assert s.startswith("UNWIND $batch AS row MERGE (n:`Person` {`id`: row.`id`})")
+        assert "SET n.`age` = row.`age`, n.`name` = row.`name`" in s
+
+    def test_merge_relationship(self):
+        s = merge_relationship_statement(
+            "KNOWS", ["Person"], ["Person"], ["id"], ["id"], [], ["since"]
+        )
+        assert "MATCH (s:`Person` {`id`: row.`source_id`})" in s
+        assert "MERGE (s)-[r:`KNOWS`]->(t)" in s
+        assert "SET r.`since` = row.`since`" in s
+
+
+class TestDriverGate:
+    def test_live_source_needs_driver(self, session):
+        src = Neo4jPropertyGraphDataSource(Neo4jConfig())
+        try:
+            import neo4j  # noqa: F401
+
+            pytest.skip("neo4j driver installed in this image")
+        except ImportError:
+            pass
+        with pytest.raises(DataSourceError, match="neo4j"):
+            src.graph("graph", session)
+
+    def test_gate_does_not_block_metadata(self):
+        src = Neo4jPropertyGraphDataSource(Neo4jConfig(), graph_name="g1")
+        assert src.has_graph("g1") and not src.has_graph("other")
+        assert src.graph_names() == ["g1"]
+
+
+class TestBulkCSVSink:
+    def test_export_layout_and_content(self, g, tmp_path):
+        sink = Neo4jBulkCSVDataSink(str(tmp_path))
+        sink.store("social", g._graph)
+
+        base = tmp_path / "social"
+        script = (base / "import.sh").read_text()
+        assert "neo4j-admin import" in script
+        assert "--database=social" in script
+        assert "--nodes:Person" in script and "--relationships:KNOWS" in script
+        assert os.access(base / "import.sh", os.X_OK)
+
+        person_dir = base / "nodes" / "Person"
+        head = (person_dir / "schema.csv").read_text().strip().split(",")
+        assert head[0] == "id:ID"
+        assert "age:int" in head and "name:string" in head
+        with open(person_dir / "part_0.csv") as f:
+            rows = list(csv.reader(f))
+        assert len(rows) == 2
+        names = {r[head.index("name:string")] for r in rows}
+        assert names == {"Alice", "Bob"}
+
+        knows_dir = base / "relationships" / "KNOWS"
+        khead = (knows_dir / "schema.csv").read_text().strip().split(",")
+        assert ":START_ID" in khead and ":END_ID" in khead and "since:int" in khead
+        with open(knows_dir / "part_0.csv") as f:
+            krows = list(csv.reader(f))
+        assert len(krows) == 1
+        assert krows[0][khead.index("since:int")] == "2019"
+
+    def test_optional_int_property_stays_int(self, session, tmp_path):
+        # regression: pandas upcasts optional ints to float64 with NaN;
+        # export must write '23' and '' — not '23.0' and 'nan'
+        g = session.create_graph_from_create_query(
+            "CREATE (:P {name:'Alice', age:23}), (:P {name:'Bob'})"
+        )
+        sink = Neo4jBulkCSVDataSink(str(tmp_path))
+        sink.store("opt", g._graph)
+        d = tmp_path / "opt" / "nodes" / "P"
+        head = (d / "schema.csv").read_text().strip().split(",")
+        with open(d / "part_0.csv") as f:
+            rows = list(csv.reader(f))
+        ages = sorted(r[head.index("age:int")] for r in rows)
+        assert ages == ["", "23"]
+
+    def test_unlabeled_nodes_plain_nodes_arg(self, session, tmp_path):
+        g = session.create_graph_from_create_query("CREATE ({x:1})")
+        sink = Neo4jBulkCSVDataSink(str(tmp_path))
+        sink.store("nolabel", g._graph)
+        script = (tmp_path / "nolabel" / "import.sh").read_text()
+        assert "--nodes:" not in script  # no empty label specifier
+        assert "--nodes " in script

@@ -12,12 +12,20 @@ from .datasource import (
 )
 from .edge_list import EdgeListDataSource, load_edge_list
 from .fs import FSGraphSource
+from .neo4j import (
+    Neo4jBulkCSVDataSink,
+    Neo4jConfig,
+    Neo4jPropertyGraphDataSource,
+)
 
 __all__ = [
     "CachedDataSource",
     "DataSourceError",
     "EdgeListDataSource",
     "FSGraphSource",
+    "Neo4jBulkCSVDataSink",
+    "Neo4jConfig",
+    "Neo4jPropertyGraphDataSource",
     "PropertyGraphDataSource",
     "SessionGraphDataSource",
     "load_edge_list",
