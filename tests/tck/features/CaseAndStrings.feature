@@ -1,0 +1,108 @@
+Feature: CaseAndStrings
+
+  Scenario: Simple CASE on property values
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:P {v: 1}), (:P {v: 2}), (:P {v: 3})
+      """
+    When executing query:
+      """
+      MATCH (p:P)
+      RETURN p.v AS v, CASE p.v WHEN 1 THEN 'one' WHEN 2 THEN 'two' ELSE 'many' END AS w
+      """
+    Then the result should be, in any order:
+      | v | w      |
+      | 1 | 'one'  |
+      | 2 | 'two'  |
+      | 3 | 'many' |
+    And no side effects
+
+  Scenario: Searched CASE without ELSE yields null
+    Given an empty graph
+    When executing query:
+      """
+      UNWIND [1, 10] AS x
+      RETURN CASE WHEN x > 5 THEN 'big' END AS c
+      """
+    Then the result should be, in any order:
+      | c     |
+      | null  |
+      | 'big' |
+    And no side effects
+
+  Scenario: String functions compose
+    Given an empty graph
+    When executing query:
+      """
+      RETURN toUpper(substring('cypher', 0, 3)) AS a,
+             reverse('abc') AS b,
+             trim('  x  ') AS c,
+             replace('a-b-c', '-', '+') AS d
+      """
+    Then the result should be, in any order:
+      | a     | b     | c   | d       |
+      | 'CYP' | 'cba' | 'x' | 'a+b+c' |
+    And no side effects
+
+  Scenario: split and join via reduce
+    Given an empty graph
+    When executing query:
+      """
+      WITH split('a,b,c', ',') AS parts
+      RETURN parts, reduce(acc = '', p IN parts | acc + p) AS joined
+      """
+    Then the result should be, in any order:
+      | parts           | joined |
+      | ['a', 'b', 'c'] | 'abc'  |
+    And no side effects
+
+  Scenario: left right and padding behavior
+    Given an empty graph
+    When executing query:
+      """
+      RETURN left('hello', 2) AS l, right('hello', 2) AS r
+      """
+    Then the result should be, in any order:
+      | l    | r    |
+      | 'he' | 'lo' |
+    And no side effects
+
+  Scenario: toString on scalars
+    Given an empty graph
+    When executing query:
+      """
+      RETURN toString(1) AS i, toString(1.5) AS f, toString(true) AS b
+      """
+    Then the result should be, in any order:
+      | i   | f     | b      |
+      | '1' | '1.5' | 'true' |
+    And no side effects
+
+  Scenario: String predicates with null propagate
+    Given an empty graph
+    When executing query:
+      """
+      WITH null AS s
+      RETURN s STARTS WITH 'a' AS sw, 'abc' CONTAINS s AS c
+      """
+    Then the result should be, in any order:
+      | sw   | c    |
+      | null | null |
+    And no side effects
+
+  Scenario: CASE inside aggregation
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:P {v: 1}), (:P {v: 5}), (:P {v: 9})
+      """
+    When executing query:
+      """
+      MATCH (p:P)
+      RETURN sum(CASE WHEN p.v > 4 THEN 1 ELSE 0 END) AS bigs
+      """
+    Then the result should be, in any order:
+      | bigs |
+      | 2    |
+    And no side effects

@@ -1,0 +1,108 @@
+Feature: Comparability
+
+  Scenario: Integer and float compare by numeric value
+    Given an empty graph
+    When executing query:
+      """
+      RETURN 1 = 1.0 AS eq, 2 < 2.5 AS lt, 3 >= 3.0 AS ge
+      """
+    Then the result should be, in any order:
+      | eq   | lt   | ge   |
+      | true | true | true |
+    And no side effects
+
+  Scenario: Strings compare lexicographically
+    Given an empty graph
+    When executing query:
+      """
+      RETURN 'abc' < 'abd' AS a, 'abc' < 'abcd' AS b, 'B' < 'a' AS c
+      """
+    Then the result should be, in any order:
+      | a    | b    | c    |
+      | true | true | true |
+    And no side effects
+
+  Scenario: Comparing incompatible types yields null
+    Given an empty graph
+    When executing query:
+      """
+      RETURN 1 < 'a' AS a, true < 1 AS b
+      """
+    Then the result should be, in any order:
+      | a    | b    |
+      | null | null |
+    And no side effects
+
+  Scenario: Lists compare elementwise
+    Given an empty graph
+    When executing query:
+      """
+      RETURN [1, 2] = [1, 2] AS eq, [1, 2] = [1, 3] AS neq, [1, 2] = [1.0, 2.0] AS cross
+      """
+    Then the result should be, in any order:
+      | eq   | neq   | cross |
+      | true | false | true  |
+    And no side effects
+
+  Scenario: Maps compare by entries
+    Given an empty graph
+    When executing query:
+      """
+      RETURN {a: 1, b: 'x'} = {b: 'x', a: 1} AS eq, {a: 1} = {a: 2} AS neq
+      """
+    Then the result should be, in any order:
+      | eq   | neq   |
+      | true | false |
+    And no side effects
+
+  Scenario: Equality with null inside structures
+    Given an empty graph
+    When executing query:
+      """
+      RETURN [1, null] = [1, null] AS l, {a: null} = {a: null} AS m
+      """
+    Then the result should be, in any order:
+      | l    | m    |
+      | null | null |
+    And no side effects
+
+  Scenario: ORDER BY over mixed numbers
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:N {v: 2.5}), (:N {v: 1}), (:N {v: 3})
+      """
+    When executing query:
+      """
+      MATCH (n:N) RETURN n.v AS v ORDER BY v
+      """
+    Then the result should be, in order:
+      | v   |
+      | 1   |
+      | 2.5 |
+      | 3   |
+    And no side effects
+
+  Scenario: DISTINCT conflates equivalent numbers
+    Given an empty graph
+    When executing query:
+      """
+      UNWIND [1, 1.0, 2] AS x RETURN DISTINCT x
+      """
+    Then the result should be, in any order:
+      | x |
+      | 1 |
+      | 2 |
+    And no side effects
+
+  Scenario: NaN is not equal to itself
+    Given an empty graph
+    When executing query:
+      """
+      WITH 0.0 / 0.0 AS nan
+      RETURN nan = nan AS eq, nan <> nan AS neq
+      """
+    Then the result should be, in any order:
+      | eq    | neq  |
+      | false | true |
+    And no side effects

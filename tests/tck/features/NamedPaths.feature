@@ -1,0 +1,130 @@
+Feature: NamedPaths
+
+  Scenario: Path binding with length
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:A {v: 1})-[:R {w: 1}]->(:B {v: 2})
+      """
+    When executing query:
+      """
+      MATCH p = (:A)-[:R]->(b) RETURN p, length(p) AS l
+      """
+    Then the result should be, in any order:
+      | p                               | l |
+      | <(:A {v: 1})-[:R {w: 1}]->(:B {v: 2})> | 1 |
+    And no side effects
+
+  Scenario: nodes() and relationships() of a path
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:A {v: 1})-[:R {w: 1}]->(:B {v: 2})
+      """
+    When executing query:
+      """
+      MATCH p = (:A)-[:R]->(:B) RETURN nodes(p) AS ns, relationships(p) AS rs
+      """
+    Then the result should be, in any order:
+      | ns                       | rs             |
+      | [(:A {v: 1}), (:B {v: 2})] | [[:R {w: 1}]] |
+    And no side effects
+
+  Scenario: Variable-length named path carries intermediate nodes
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:A {v: 1})-[:R]->(:M {v: 2})-[:R]->(:B {v: 3})
+      """
+    When executing query:
+      """
+      MATCH p = (:A)-[:R*2]->(:B) RETURN nodes(p) AS ns
+      """
+    Then the result should be, in any order:
+      | ns                                    |
+      | [(:A {v: 1}), (:M {v: 2}), (:B {v: 3})] |
+    And no side effects
+
+  Scenario: Zero-length named path is a single node
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:A {v: 1})
+      """
+    When executing query:
+      """
+      MATCH p = (a:A)-[:R*0..1]->() RETURN p
+      """
+    Then the result should be, in any order:
+      | p            |
+      | <(:A {v: 1})> |
+    And no side effects
+
+  Scenario: Named path in OPTIONAL MATCH is null when unmatched
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:A {v: 1})
+      """
+    When executing query:
+      """
+      MATCH (a:A) OPTIONAL MATCH p = (a)-[:R]->() RETURN p
+      """
+    Then the result should be, in any order:
+      | p    |
+      | null |
+    And no side effects
+
+  Scenario: Path variable through WITH
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:A)-[:R]->(:B)
+      """
+    When executing query:
+      """
+      MATCH p = (:A)-[:R]->(:B) WITH p AS q RETURN length(q) AS l
+      """
+    Then the result should be, in any order:
+      | l |
+      | 1 |
+    And no side effects
+
+  Scenario: Filtering on path length
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (a:A {v: 1})-[:R]->(b:B {v: 2})-[:R]->(c:C {v: 3})
+      """
+    When executing query:
+      """
+      MATCH p = (a)-[:R*1..2]->(b) WHERE length(p) = 2 RETURN a.v AS s, b.v AS t
+      """
+    Then the result should be, in any order:
+      | s | t |
+      | 1 | 3 |
+    And no side effects
+
+  Scenario: Two named paths in one MATCH
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (a:A)-[:R]->(b:B)-[:S]->(c:C)
+      """
+    When executing query:
+      """
+      MATCH p = (a:A)-[:R]->(b), q = (b)-[:S]->(c) RETURN length(p) + length(q) AS l
+      """
+    Then the result should be, in any order:
+      | l |
+      | 2 |
+    And no side effects
+
+  Scenario: Rebinding a path variable is rejected
+    Given an empty graph
+    When executing query:
+      """
+      MATCH p = (a)-[:R]->(b), p = (x)-[:S]->(y) RETURN p
+      """
+    Then a SyntaxError should be raised at compile time: VariableAlreadyBound
+    And no side effects

@@ -1,0 +1,116 @@
+Feature: Temporal
+
+  Scenario: Date accessors
+    Given an empty graph
+    When executing query:
+      """
+      WITH date('2019-03-09') AS d
+      RETURN d.year AS y, d.month AS m, d.day AS dd
+      """
+    Then the result should be, in any order:
+      | y    | m | dd |
+      | 2019 | 3 | 9  |
+    And no side effects
+
+  Scenario: Date toString round-trip
+    Given an empty graph
+    When executing query:
+      """
+      RETURN toString(date('2019-03-09')) AS s
+      """
+    Then the result should be, in any order:
+      | s            |
+      | '2019-03-09' |
+    And no side effects
+
+  Scenario: Local datetime accessors
+    Given an empty graph
+    When executing query:
+      """
+      WITH localdatetime('2019-03-09T11:45:22') AS t
+      RETURN t.hour AS h, t.minute AS m, t.second AS s
+      """
+    Then the result should be, in any order:
+      | h  | m  | s  |
+      | 11 | 45 | 22 |
+    And no side effects
+
+  Scenario: Duration between dates
+    Given an empty graph
+    When executing query:
+      """
+      WITH duration.between(date('2019-01-01'), date('2019-03-02')) AS d
+      RETURN d.months AS m, d.days AS dd
+      """
+    Then the result should be, in any order:
+      | m | dd |
+      | 2 | 1  |
+    And no side effects
+
+  Scenario: Date plus duration
+    Given an empty graph
+    When executing query:
+      """
+      RETURN toString(date('2019-01-31') + duration('P1M')) AS s
+      """
+    Then the result should be, in any order:
+      | s            |
+      | '2019-02-28' |
+    And no side effects
+
+  Scenario: Duration components from ISO string
+    Given an empty graph
+    When executing query:
+      """
+      WITH duration('P1Y2M3DT4H5M6S') AS d
+      RETURN d.years AS y, d.monthsOfYear AS m, d.days AS dd, d.hours AS h
+      """
+    Then the result should be, in any order:
+      | y | m | dd | h |
+      | 1 | 2 | 3  | 4 |
+    And no side effects
+
+  Scenario: Temporal property comparison
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:E {name: 'a', when: date('2019-01-01')}),
+             (:E {name: 'b', when: date('2020-06-15')})
+      """
+    When executing query:
+      """
+      MATCH (e:E) WHERE e.when > date('2019-12-31') RETURN e.name AS n
+      """
+    Then the result should be, in any order:
+      | n   |
+      | 'b' |
+    And no side effects
+
+  Scenario: Ordering by date
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:E {name: 'b', when: date('2020-06-15')}),
+             (:E {name: 'a', when: date('2019-01-01')})
+      """
+    When executing query:
+      """
+      MATCH (e:E) RETURN e.name AS n ORDER BY e.when
+      """
+    Then the result should be, in order:
+      | n   |
+      | 'a' |
+      | 'b' |
+    And no side effects
+
+  Scenario: Week-based accessors
+    Given an empty graph
+    When executing query:
+      """
+      WITH date('2019-03-09') AS d
+      RETURN d.week AS w, d.dayOfWeek AS dow, d.quarter AS q
+      """
+    Then the result should be, in any order:
+      | w  | dow | q |
+      | 10 | 6   | 1 |
+    And no side effects

@@ -23,8 +23,8 @@ from __future__ import annotations
 import csv
 import os
 import stat
-from dataclasses import dataclass, field
-from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+from dataclasses import dataclass
+from typing import Dict, Iterable, List, Optional, Sequence
 
 from ..api import types as T
 from ..api.schema import PropertyGraphSchema
@@ -399,13 +399,21 @@ class Neo4jPropertyGraphDataSource(PropertyGraphDataSource):
             labels = frozenset(row.get("nodeLabels") or [])
             prop = row.get("propertyName")
             types = row.get("propertyTypes") or []
-            keys = {prop: _cypher_type_for(types)} if prop else {}
+            # a non-mandatory property can be absent -> nullable type
+            # (the reference consumes 'mandatory' the same way)
+            mandatory = bool(row.get("mandatory"))
+            keys = (
+                {prop: _cypher_type_for(types, mandatory)} if prop else {}
+            )
             schema = schema.with_node_combination(labels, keys)
         for row in self._run(rel_schema_query()):
             rel_type = (row.get("relType") or "").strip(":`")
             prop = row.get("propertyName")
             types = row.get("propertyTypes") or []
-            keys = {prop: _cypher_type_for(types)} if prop else {}
+            mandatory = bool(row.get("mandatory"))
+            keys = (
+                {prop: _cypher_type_for(types, mandatory)} if prop else {}
+            )
             schema = schema.with_relationship_type(rel_type, keys)
         return schema
 
@@ -459,6 +467,13 @@ class Neo4jPropertyGraphDataSource(PropertyGraphDataSource):
         schema = graph.schema
         ctx = _plain_ctx(graph)
         with self._session() as s:
+            # index the merge key per label first, as the reference does —
+            # without it every MERGE row is a full store scan
+            for label in sorted({l for combo in schema.label_combinations for l in combo}):
+                try:
+                    s.run(create_index_statement(label, ["id"]))
+                except Exception:  # noqa: BLE001 - index may already exist
+                    pass
             for combo in schema.label_combinations:
                 df, types = canonical_node_columns(graph, combo, ctx)
                 props = [c for c in df.columns if c != "id"]
@@ -467,25 +482,28 @@ class Neo4jPropertyGraphDataSource(PropertyGraphDataSource):
             for rt in schema.relationship_types:
                 df, types = canonical_rel_columns(graph, rt, ctx)
                 props = [c for c in df.columns if c not in ("id", "source", "target")]
-                stmt = (
-                    "UNWIND $batch AS row "
-                    "MATCH (s {`id`: row.`source`}) MATCH (t {`id`: row.`target`}) "
-                    f"MERGE (s)-[r:`{rt}` {{`id`: row.`id`}}]->(t)"
-                    + (
-                        " SET "
-                        + ", ".join(f"r.`{k}` = row.`{k}`" for k in sorted(props))
-                        if props
-                        else ""
-                    )
+                stmt = merge_relationship_statement(
+                    rt, [], [], ["id"], ["id"], ["id"], props
                 )
-                s.run(stmt, batch=_clean_records(df, types))
+                batch = [
+                    {
+                        **{k: v for k, v in rec.items() if k not in ("source", "target")},
+                        "source_id": rec["source"],
+                        "target_id": rec["target"],
+                    }
+                    for rec in _clean_records(df, types)
+                ]
+                s.run(stmt, batch=batch)
 
     def delete(self, name: str) -> None:
         raise DataSourceError("Deleting a live Neo4j database is not supported")
 
 
-def _cypher_type_for(neo4j_types: Sequence[str]) -> T.CypherType:
-    """Neo4j procedure type names -> CypherType (nullable union on conflict)."""
+def _cypher_type_for(
+    neo4j_types: Sequence[str], mandatory: bool = True
+) -> T.CypherType:
+    """Neo4j procedure type names -> CypherType; non-mandatory properties are
+    nullable (reference ``SchemaFromProcedure``)."""
     mapping = {
         "String": T.CTString,
         "Long": T.CTInteger,
@@ -499,4 +517,5 @@ def _cypher_type_for(neo4j_types: Sequence[str]) -> T.CypherType:
     ts = [mapping.get(t, T.CTAny) for t in neo4j_types]
     if not ts:
         return T.CTAny.nullable
-    return T.join_types(ts)
+    out = T.join_types(ts)
+    return out if mandatory else out.nullable

@@ -431,13 +431,39 @@ def _parse_iso_duration(s: str) -> Duration:
     return d
 
 
+def _add_months(d: _dt.datetime, months: int) -> _dt.datetime:
+    y, m = divmod(d.year * 12 + (d.month - 1) + months, 12)
+    import calendar
+
+    day = min(d.day, calendar.monthrange(y, m + 1)[1])
+    return d.replace(year=y, month=m + 1, day=day)
+
+
 def _f_duration_between(a, b):
+    """Calendar-aware decomposition (Neo4j ``duration.between``): whole
+    months truncated toward zero, then whole days, then the time remainder —
+    NOT a flat day count, and NOT swap-and-negate (month-end clamping makes
+    the two differ: between(Mar 31, Feb 28) is P-1M-1D, not -(P1M3D))."""
     if isinstance(a, _dt.date) and not isinstance(a, _dt.datetime):
         a = _dt.datetime(a.year, a.month, a.day)
     if isinstance(b, _dt.date) and not isinstance(b, _dt.datetime):
         b = _dt.datetime(b.year, b.month, b.day)
-    delta = b - a
-    return Duration(days=delta.days, seconds=delta.seconds, microseconds=delta.microseconds)
+    months = (b.year - a.year) * 12 + (b.month - a.month)
+    # pull the month anchor back toward a if it overshot b
+    if months > 0 and _add_months(a, months) > b:
+        months -= 1
+    elif months < 0 and _add_months(a, months) < b:
+        months += 1
+    anchor = _add_months(a, months)
+    delta = b - anchor
+    total_us = (delta.days * 86400 + delta.seconds) * 1_000_000 + delta.microseconds
+    sign_t = 1 if total_us >= 0 else -1
+    day_us = 86400 * 1_000_000
+    days = sign_t * (abs(total_us) // day_us)
+    rem = total_us - days * day_us
+    secs = sign_t * (abs(rem) // 1_000_000)
+    us = rem - secs * 1_000_000
+    return Duration(months=months, days=days, seconds=secs, microseconds=us)
 
 
 _register("date", _f_date, T.CTDate, min_args=0, max_args=1)

@@ -220,7 +220,12 @@ class TckRunner:
         if in_order:
             assert got == expected, f"\nexpected (in order): {expected}\ngot: {got}"
         else:
-            assert sorted(map(repr, got)) == sorted(map(repr, expected)), (
+            # true multiset equality — repr-based keys are NOT canonical
+            # (equal frozensets may iterate, and so repr, in different orders
+            # depending on insertion history)
+            from collections import Counter
+
+            assert Counter(got) == Counter(expected), (
                 f"\nexpected (any order): {expected}\ngot: {got}"
             )
 
