@@ -1,0 +1,136 @@
+"""Native C++ host-tier tests: the ctypes paths must agree exactly with the
+NumPy fallbacks (CSR build) and the Python parser (edge lists)."""
+
+import numpy as np
+import pytest
+
+from tpu_cypher.native import (
+    build_csr_native,
+    get_lib,
+    parse_edge_list_native,
+)
+
+pytestmark = pytest.mark.skipif(
+    get_lib() is None, reason="no C++ toolchain available"
+)
+
+
+def _numpy_csr(node_ids, src, dst):
+    node_ids = np.unique(np.asarray(node_ids, dtype=np.int64))
+    s = np.searchsorted(node_ids, src).astype(np.int32)
+    d = np.searchsorted(node_ids, dst).astype(np.int32)
+    order = np.lexsort((d, s))
+    s, d = s[order], d[order]
+    n = len(node_ids)
+    row_ptr = np.searchsorted(s, np.arange(n + 1)).astype(np.int32)
+    return node_ids, row_ptr, d, s
+
+
+class TestBuildCsr:
+    def test_matches_numpy_random(self):
+        rng = np.random.default_rng(0)
+        ids = rng.choice(10_000, 500, replace=False).astype(np.int64) * 13 + 7
+        src = rng.choice(ids, 4000)
+        dst = rng.choice(ids, 4000)
+        got = build_csr_native(ids, src, dst)
+        exp = _numpy_csr(ids, src, dst)
+        for g, e in zip(got, exp):
+            np.testing.assert_array_equal(g, e)
+
+    def test_duplicate_node_ids_deduped(self):
+        ids = np.array([5, 5, 3, 3, 9], dtype=np.int64)
+        got = build_csr_native(ids, np.array([3, 9]), np.array([9, 5]))
+        np.testing.assert_array_equal(got[0], [3, 5, 9])
+
+    def test_empty_graph(self):
+        got = build_csr_native(
+            np.array([1, 2], dtype=np.int64),
+            np.zeros(0, np.int64),
+            np.zeros(0, np.int64),
+        )
+        np.testing.assert_array_equal(got[1], [0, 0, 0])
+        assert len(got[2]) == 0
+
+    def test_unknown_endpoint_rejected(self):
+        with pytest.raises(ValueError, match="not present"):
+            build_csr_native(
+                np.array([1, 2], dtype=np.int64),
+                np.array([1], dtype=np.int64),
+                np.array([99], dtype=np.int64),
+            )
+
+    def test_parallel_edges_kept(self):
+        ids = np.array([1, 2], dtype=np.int64)
+        got = build_csr_native(ids, np.array([1, 1]), np.array([2, 2]))
+        np.testing.assert_array_equal(got[2], [1, 1])  # both kept
+
+
+class TestParseEdgeList:
+    def test_basic(self):
+        src, dst = parse_edge_list_native(b"# comment\n1 2\n3 4\n")
+        np.testing.assert_array_equal(src, [1, 3])
+        np.testing.assert_array_equal(dst, [2, 4])
+
+    def test_commas_tabs_trailing_columns(self):
+        src, dst = parse_edge_list_native(b"1,2\n3\t4\t0.5\n\n5 6")
+        np.testing.assert_array_equal(src, [1, 3, 5])
+        np.testing.assert_array_equal(dst, [2, 4, 6])
+
+    def test_malformed_reports_offset(self):
+        with pytest.raises(ValueError, match="byte offset"):
+            parse_edge_list_native(b"1 x\n")
+
+    def test_crlf_and_negative(self):
+        src, dst = parse_edge_list_native(b"1 2\r\n-3 4\r\n")
+        np.testing.assert_array_equal(src, [1, -3])
+
+
+class TestEndToEnd:
+    def test_edge_list_loader_uses_native(self, tmp_path):
+        from tpu_cypher import CypherSession
+
+        p = tmp_path / "g.txt"
+        p.write_text("# snap\n1 2\n2 3\n1 3\n")
+        s = CypherSession.local()
+        from tpu_cypher.io.edge_list import load_edge_list
+
+        g = load_edge_list(str(p), s)
+        from tpu_cypher.relational.session import PropertyGraph
+
+        pg = PropertyGraph(s, g)
+        rows = pg.cypher(
+            "MATCH (a)-[:E]->(b) RETURN count(*) AS n"
+        ).records.collect()
+        assert rows[0]["n"] == 3
+        rows = pg.cypher(
+            "MATCH (a)-[:E]->(b)-[:E]->(c) RETURN a.id IS NULL AS x, count(*) AS n"
+        ).records.collect()
+        assert rows[0]["n"] == 1  # only 1->2->3
+
+    def test_native_rejects_what_python_rejects(self):
+        # regression: "1 2.5" and "1 2x" must error, not silently truncate;
+        # trailing extra columns after valid ints stay accepted
+        with pytest.raises(ValueError):
+            parse_edge_list_native(b"1 2.5\n")
+        with pytest.raises(ValueError):
+            parse_edge_list_native(b"1 2x\n")
+        with pytest.raises(ValueError):
+            parse_edge_list_native(b"1x 2\n")
+        src, dst = parse_edge_list_native(b"1 2 0.5\n")
+        np.testing.assert_array_equal(src, [1])
+
+    def test_numpy_fallback_rejects_unknown_endpoints(self):
+        from tpu_cypher.backend.tpu import kernels as K
+        import tpu_cypher.native as N
+
+        saved = N.build_csr_native
+        N.build_csr_native = lambda *a: None  # force numpy path
+        try:
+            with pytest.raises(ValueError, match="not present"):
+                K.CsrGraph.build(
+                    np.array([1, 2], dtype=np.int64),
+                    np.array([1], dtype=np.int64),
+                    np.array([99], dtype=np.int64),
+                )
+        finally:
+            N.build_csr_native = saved

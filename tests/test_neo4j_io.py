@@ -48,8 +48,13 @@ class TestQueryBuilders:
         assert "id(s) AS" in q and "id(t) AS" in q and "r.`since`" in q
 
     def test_create_index(self):
+        modern = create_index_statement("Person", ["name"])
+        assert "IF NOT EXISTS" in modern
+        assert "FOR (n:`Person`) ON (n.`name`)" in modern
+        from tpu_cypher.io.neo4j import create_index_statement_legacy
+
         assert (
-            create_index_statement("Person", ["name"])
+            create_index_statement_legacy("Person", ["name"])
             == "CREATE INDEX ON :`Person`(`name`)"
         )
 

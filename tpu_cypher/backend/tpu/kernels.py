@@ -59,12 +59,32 @@ class CsrGraph:
 
     @staticmethod
     def build(node_ids: np.ndarray, src: np.ndarray, dst: np.ndarray) -> "CsrGraph":
+        # native C++ path (two stable counting sorts, O(E+N)) when available
+        from ...native import build_csr_native
+
+        native = build_csr_native(node_ids, src, dst)
+        if native is not None:
+            ids, row_ptr, col_idx, src_idx = native
+            return CsrGraph(
+                jnp.asarray(ids),
+                jnp.asarray(row_ptr),
+                jnp.asarray(col_idx),
+                jnp.asarray(src_idx),
+            )
         node_ids = np.unique(np.asarray(node_ids, dtype=np.int64))
         s = np.searchsorted(node_ids, src).astype(np.int32)
         d = np.searchsorted(node_ids, dst).astype(np.int32)
+        n = len(node_ids)
+        # same contract as the native path: every endpoint must be a node
+        if len(s) and (
+            s.max(initial=0) >= n
+            or d.max(initial=0) >= n
+            or not (node_ids[s] == np.asarray(src, dtype=np.int64)).all()
+            or not (node_ids[d] == np.asarray(dst, dtype=np.int64)).all()
+        ):
+            raise ValueError("Edge endpoint id not present in node_ids")
         order = np.lexsort((d, s))
         s, d = s[order], d[order]
-        n = len(node_ids)
         row_ptr = np.searchsorted(s, np.arange(n + 1)).astype(np.int32)
         return CsrGraph(
             jnp.asarray(node_ids),

@@ -29,23 +29,36 @@ EDGE_ID_OFFSET = 1 << 53
 
 
 def load_edge_list(path: str, session, delimiter: Optional[str] = None) -> ScanGraph:
-    src: List[int] = []
-    dst: List[int] = []
-    with open(path) as f:
-        for lineno, line in enumerate(f, start=1):
-            line = line.strip()
-            if not line or line.startswith("#"):
-                continue
-            parts = line.replace(",", " ").split() if delimiter is None else line.split(delimiter)
-            try:
-                src.append(int(parts[0]))
-                dst.append(int(parts[1]))
-            except (IndexError, ValueError) as e:
-                raise DataSourceError(
-                    f"Malformed edge-list line {lineno} in {path!r}: {line!r} ({e})"
-                )
-    src_a = np.asarray(src, dtype=np.int64)
-    dst_a = np.asarray(dst, dtype=np.int64)
+    src_a: Optional[np.ndarray] = None
+    if delimiter is None:  # native fast path handles the default format
+        from ..native import parse_edge_list_native
+
+        with open(path, "rb") as fb:
+            data = fb.read()
+        try:
+            parsed = parse_edge_list_native(data)
+        except ValueError as e:
+            raise DataSourceError(f"Malformed edge list {path!r}: {e}")
+        if parsed is not None:
+            src_a, dst_a = parsed
+    if src_a is None:
+        src: List[int] = []
+        dst: List[int] = []
+        with open(path) as f:
+            for lineno, line in enumerate(f, start=1):
+                line = line.strip()
+                if not line or line.startswith("#"):
+                    continue
+                parts = line.replace(",", " ").split() if delimiter is None else line.split(delimiter)
+                try:
+                    src.append(int(parts[0]))
+                    dst.append(int(parts[1]))
+                except (IndexError, ValueError) as e:
+                    raise DataSourceError(
+                        f"Malformed edge-list line {lineno} in {path!r}: {line!r} ({e})"
+                    )
+        src_a = np.asarray(src, dtype=np.int64)
+        dst_a = np.asarray(dst, dtype=np.int64)
     node_ids = np.unique(np.concatenate([src_a, dst_a])) if len(src_a) else np.zeros(0, np.int64)
     if len(src_a) and int(node_ids.max(initial=0)) >= EDGE_ID_OFFSET:
         raise DataSourceError("Edge-list node ids exceed the supported id range")

@@ -22,6 +22,7 @@ from __future__ import annotations
 
 import csv
 import os
+import re
 import stat
 from dataclasses import dataclass
 from typing import Dict, Iterable, List, Optional, Sequence
@@ -33,6 +34,8 @@ from .datasource import DataSourceError, PropertyGraphDataSource
 ID_KEY = "___id"
 START_KEY = "___source"
 END_KEY = "___target"
+
+_SAFE_NAME = re.compile(r"[^A-Za-z0-9_]")
 
 
 # ---------------------------------------------------------------------------
@@ -111,7 +114,22 @@ def rel_schema_query() -> str:
 
 
 def create_index_statement(label: str, keys: Sequence[str]) -> str:
-    """Reference ``Neo4jGraphMerge`` index creation (``:97-111``)."""
+    """Neo4j 4+/5 index syntax (``CREATE INDEX ... FOR (n:L) ON (n.k)``).
+    The reference targets Neo4j 3.x (``CREATE INDEX ON :L(k)``,
+    ``Neo4jGraphMerge.scala:97-111``) — see
+    ``create_index_statement_legacy`` for that form."""
+    props = ", ".join(f"n.`{k}`" for k in keys)
+    safe = _SAFE_NAME.sub("_", label) + "_" + "_".join(
+        _SAFE_NAME.sub("_", k) for k in keys
+    )
+    return (
+        f"CREATE INDEX `idx_{safe}` IF NOT EXISTS "
+        f"FOR (n:`{label}`) ON ({props})"
+    )
+
+
+def create_index_statement_legacy(label: str, keys: Sequence[str]) -> str:
+    """Neo4j 3.x syntax used by the reference."""
     cols = ", ".join(f"`{k}`" for k in keys)
     return f"CREATE INDEX ON :`{label}`({cols})"
 
@@ -468,12 +486,20 @@ class Neo4jPropertyGraphDataSource(PropertyGraphDataSource):
         ctx = _plain_ctx(graph)
         with self._session() as s:
             # index the merge key per label first, as the reference does —
-            # without it every MERGE row is a full store scan
+            # without it every MERGE row is a full store scan. Try the
+            # modern (4+/5) syntax first, then the 3.x form the reference
+            # uses; only an already-existing index is silently accepted.
             for label in sorted({l for combo in schema.label_combinations for l in combo}):
-                try:
-                    s.run(create_index_statement(label, ["id"]))
-                except Exception:  # noqa: BLE001 - index may already exist
-                    pass
+                for stmt in (
+                    create_index_statement(label, ["id"]),
+                    create_index_statement_legacy(label, ["id"]),
+                ):
+                    try:
+                        s.run(stmt)
+                        break
+                    except Exception as e:  # noqa: BLE001 - syntax/exists probe
+                        if "already exists" in str(e).lower() or "equivalent" in str(e).lower():
+                            break
             for combo in schema.label_combinations:
                 df, types = canonical_node_columns(graph, combo, ctx)
                 props = [c for c in df.columns if c != "id"]
@@ -482,8 +508,17 @@ class Neo4jPropertyGraphDataSource(PropertyGraphDataSource):
             for rt in schema.relationship_types:
                 df, types = canonical_rel_columns(graph, rt, ctx)
                 props = [c for c in df.columns if c not in ("id", "source", "target")]
+                # endpoint labels (when the schema knows the pattern) let the
+                # MATCHes use the per-label id index instead of a full scan
+                pats = [p for p in schema.schema_patterns if p.rel_type == rt]
+                shapes = {(p.source_labels, p.target_labels) for p in pats}
+                if len(shapes) == 1:
+                    (sl, tl) = next(iter(shapes))
+                    start_labels, end_labels = sorted(sl), sorted(tl)
+                else:
+                    start_labels, end_labels = [], []
                 stmt = merge_relationship_statement(
-                    rt, [], [], ["id"], ["id"], ["id"], props
+                    rt, start_labels, end_labels, ["id"], ["id"], ["id"], props
                 )
                 batch = [
                     {

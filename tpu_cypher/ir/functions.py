@@ -442,8 +442,10 @@ def _add_months(d: _dt.datetime, months: int) -> _dt.datetime:
 def _f_duration_between(a, b):
     """Calendar-aware decomposition (Neo4j ``duration.between``): whole
     months truncated toward zero, then whole days, then the time remainder —
-    NOT a flat day count, and NOT swap-and-negate (month-end clamping makes
-    the two differ: between(Mar 31, Feb 28) is P-1M-1D, not -(P1M3D))."""
+    NOT a flat day count, and NOT swap-and-negate. Month-end clamping makes
+    those differ: between(2020-03-31, 2020-02-28) anchors at 2020-02-29
+    (leap year) giving P-1M-1D, where swap-and-negate would give -(P1M3D);
+    in a non-leap year the anchor clamps to Feb 28 exactly, giving P-1M."""
     if isinstance(a, _dt.date) and not isinstance(a, _dt.datetime):
         a = _dt.datetime(a.year, a.month, a.day)
     if isinstance(b, _dt.date) and not isinstance(b, _dt.datetime):
