@@ -49,6 +49,17 @@ QUERIES = [
     "MATCH (p:Person) WHERE p.age IN [23, 55] RETURN p.name",
     "MATCH (p:Person) WHERE p.name STARTS WITH 'A' RETURN p",
     "MATCH (p) RETURN labels(p) AS l, count(*) AS c",
+    # device aggregation path (segment ops): grouped + global, every agg kind
+    "MATCH (a:Person)-[k:KNOWS]->(b) RETURN b.name, count(*) AS c, min(k.since) AS lo, max(k.since) AS hi",
+    "MATCH (a:Person) RETURN min(a.name) AS first, max(a.name) AS last",
+    "MATCH (a:Person) RETURN min(a.score) AS lo, max(a.score) AS hi, sum(a.score) AS s, avg(a.score) AS m",
+    "MATCH (a:Person) OPTIONAL MATCH (a)-[:READS]->(x) RETURN a.name, count(x) AS reads",
+    "MATCH (b:Book) WHERE b.title = 'nope' RETURN count(*) AS c, sum(1) AS s, min(1) AS lo",
+    "MATCH (a:Person) RETURN a.age > 30 AS old, count(*) AS c, avg(a.age) AS m",
+    "MATCH (a:Person) RETURN a.score AS key, count(*) AS c",
+    "MATCH (a:Person)-[k:KNOWS]->() RETURN a.name, sum(k.since) AS total, max(k.since) AS last",
+    "MATCH (a:Person) RETURN count(a.score) AS with_score, count(*) AS all_rows",
+    "MATCH (p:Person) RETURN min(p.age > 30) AS b",
 ]
 
 
@@ -124,3 +135,53 @@ def test_distinct_and_order():
     import math
 
     assert math.isnan(vals[3]) and vals[4] is None
+
+
+def test_group_runs_on_device_not_fallback(monkeypatch):
+    # count/sum/avg/min/max without DISTINCT must use segment ops, never
+    # the local-oracle fallback
+    tpu = CypherSession.tpu()
+    g = tpu.create_graph_from_create_query(CREATE)
+    from tpu_cypher.backend.tpu.table import TpuTable
+
+    def boom(self):
+        raise AssertionError("device aggregation fell back to the local oracle")
+
+    monkeypatch.setattr(TpuTable, "_to_local", boom)
+    try:
+        r = g.cypher(
+            "MATCH (a:Person)-[k:KNOWS]->(b) "
+            "RETURN b.name, count(*) AS c, sum(k.since) AS s, avg(k.since) AS m, "
+            "min(k.since) AS lo, max(k.since) AS hi"
+        ).records
+        rows = {m["b.name"]: m for m in r.collect()}
+    finally:
+        monkeypatch.undo()
+    assert rows["Carol"]["c"] == 2
+    assert rows["Carol"]["s"] == 2020 + 2021
+    assert rows["Carol"]["m"] == (2020 + 2021) / 2
+    assert rows["Bob"]["lo"] == rows["Bob"]["hi"] == 2019
+
+
+def test_group_collect_falls_back_cleanly():
+    tpu = CypherSession.tpu()
+    g = tpu.create_graph_from_create_query(CREATE)
+    r = g.cypher(
+        "MATCH (a:Person) RETURN collect(a.age) AS ages, count(DISTINCT a.age) AS d"
+    ).records.collect()
+    assert sorted(r[0]["ages"]) == [23, 42, 55]
+    assert r[0]["d"] == 3
+
+
+def test_float_sum_empty_group_is_integer_zero():
+    # oracle: Cypher sum over no values = integer 0 even for float inputs
+    tpu = CypherSession.tpu()
+    local = CypherSession.local()
+    q = "MATCH (a:Person) OPTIONAL MATCH (a)-[:NOPE]->(x) RETURN a.name, sum(x.score) AS s"
+    gt = tpu.create_graph_from_create_query(CREATE)
+    gl = local.create_graph_from_create_query(CREATE)
+    t = gt.cypher(q).records.to_bag()
+    l = gl.cypher(q).records.to_bag()
+    assert t == l
+    row = next(iter(gt.cypher(q).records.collect()))
+    assert row["s"] == 0 and not isinstance(row["s"], float)

@@ -169,6 +169,15 @@ class Column:
     def concat(self, other: "Column") -> "Column":
         a, b = self, other
         if a.kind != b.kind:
+            # an all-null side carries no payload: adopt the other's kind
+            # (scan alignment fills absent properties with null constants,
+            # which default to I64 — without this, unioning them with a
+            # STR/BOOL column would degrade the whole column to OBJ)
+            if a.kind != OBJ and b.is_all_null():
+                b = a.null_like(len(b))
+            elif b.kind != OBJ and a.is_all_null():
+                a = b.null_like(len(a))
+        if a.kind != b.kind:
             # unify: promote numerics, else objects
             if {a.kind, b.kind} == {I64, F64}:
                 a = a.cast_f64()
@@ -188,6 +197,21 @@ class Column:
             bv = b.valid if b.valid is not None else jnp.ones(len(b), bool)
             valid = jnp.concatenate([av, bv])
         return Column(a.kind, data, valid, a.vocab)
+
+    def is_all_null(self) -> bool:
+        if self.kind == OBJ:
+            return all(v is None for v in self.data)
+        return self.valid is not None and not bool(jnp.any(self.valid))
+
+    def null_like(self, n: int) -> "Column":
+        """n all-null rows with this column's kind/vocab."""
+        if self.kind == OBJ:
+            return Column(OBJ, np.array([None] * n, dtype=object), None)
+        if self.kind == STR:
+            data = jnp.full(n, _NULL_CODE, jnp.int32)
+        else:
+            data = jnp.zeros(n, self.data.dtype)
+        return Column(self.kind, data, jnp.zeros(n, bool), self.vocab)
 
     def cast_f64(self) -> "Column":
         if self.kind == F64:

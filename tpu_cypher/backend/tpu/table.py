@@ -14,12 +14,15 @@ on device:
 * distinct      = first-occurrence selection over packed keys
 * with_columns  = compiled expressions
 
+* group         = host group-index factorization (same key equivalence
+                  classes as distinct) + ``jax.ops.segment_*`` aggregation
+                  on device for count/sum/avg/min/max
+
 Operations the Expr->jnp compiler can't express (list values, regex, string
-concat, exotic functions) transparently fall back to the local oracle
+concat, exotic functions) and the remaining aggregators (collect, stdev,
+percentiles, DISTINCT variants) transparently fall back to the local oracle
 backend, keeping full Cypher semantics while the id/predicate/aggregate
-machinery stays on device. Aggregations currently route through the fallback
-(device segment-sum aggregates live in ``kernels.py`` and back the benchmark
-path; migrating ``group`` onto them is scheduled work)."""
+machinery stays on device."""
 
 from __future__ import annotations
 
@@ -267,19 +270,16 @@ class TpuTable(Table):
 
     # -- distinct ----------------------------------------------------------
 
-    def distinct(self, cols: Optional[Sequence[str]] = None) -> "TpuTable":
-        on = list(cols) if cols is not None else self.physical_columns
-        if any(self._cols[c].kind == OBJ for c in on):
-            return self._from_local(self._to_local().distinct(on))
-        if self._nrows == 0:
-            return self
+    def _pack_keys(self, on: Sequence[str]):
+        """Host-side equivalence-class key packing shared by ``distinct`` and
+        ``group``: null payloads canonicalized (outer joins leave arbitrary
+        data under valid=False), NaN gets its own equivalence class, and
+        -0.0 == 0.0."""
         arrays = []
         for c in on:
             col = self._cols[c]
             a = np.asarray(col.data).copy()
             valid = np.asarray(col.valid_mask())
-            # canonicalize null payloads (outer joins leave arbitrary data
-            # under valid=False) so all nulls share one key
             a[~valid] = 0
             if col.kind == F64:
                 nan = np.isnan(a) & valid
@@ -288,16 +288,155 @@ class TpuTable(Table):
                 arrays.append(nan)
             arrays.append(a)
             arrays.append(~valid)
-        packed = np.rec.fromarrays(arrays) if arrays else None
+        return np.rec.fromarrays(arrays) if arrays else None
+
+    def distinct(self, cols: Optional[Sequence[str]] = None) -> "TpuTable":
+        on = list(cols) if cols is not None else self.physical_columns
+        if any(self._cols[c].kind == OBJ for c in on):
+            return self._from_local(self._to_local().distinct(on))
+        if self._nrows == 0:
+            return self
+        packed = self._pack_keys(on)
         _, first = np.unique(packed, return_index=True)
         first.sort()
         return self._take(jnp.asarray(first.astype(np.int64)))
 
     # -- aggregation / projection / explode --------------------------------
 
+    # aggregators the device path handles; the rest (collect, stdev,
+    # percentiles, DISTINCT variants, durations) use the local oracle
+    _DEVICE_AGGS = frozenset({"count", "sum", "avg", "min", "max"})
+
     def group(self, by, aggregations, header, parameters) -> "TpuTable":
-        lt = self._to_local().group(by, aggregations, header, parameters)
-        return self._from_local(lt)
+        try:
+            return self._group_device(by, aggregations, header, parameters)
+        except (TpuUnsupportedExpr, TpuBackendError):
+            lt = self._to_local().group(by, aggregations, header, parameters)
+            return self._from_local(lt)
+
+    def _group_device(self, by, aggregations, header, parameters) -> "TpuTable":
+        """Grouped aggregation as device segment ops: group assignment reuses
+        ``distinct``'s host key canonicalization (null/NaN equivalence
+        classes), then count/sum/avg/min/max run as ``jax.ops.segment_*``
+        over the group index — the TPU replacement for the engines' shuffle
+        aggregate (reference ``Table.group``)."""
+        import jax
+
+        from ...ir import expr as E
+
+        for _, agg in aggregations:
+            if (
+                not isinstance(agg, E.Agg)
+                or agg.name.lower() not in self._DEVICE_AGGS
+                or agg.distinct
+            ):
+                raise TpuUnsupportedExpr(f"device agg {getattr(agg, 'name', agg)}")
+        if any(self._cols[c].kind == OBJ for c in by):
+            raise TpuUnsupportedExpr("object-valued group keys")
+
+        n = self._nrows
+        out_cols: Dict[str, Column] = {}
+        if by and n > 0:
+            packed = self._pack_keys(by)
+            _, first, inverse = np.unique(
+                packed, return_index=True, return_inverse=True
+            )
+            # renumber groups in first-occurrence order (= the local oracle)
+            order = np.argsort(first, kind="stable")
+            rank = np.empty_like(order)
+            rank[order] = np.arange(len(order))
+            seg = rank[inverse.reshape(-1)]
+            first_rows = jnp.asarray(first[order].astype(np.int64))
+            k = len(first)
+            for c in by:
+                out_cols[c] = self._cols[c].take(first_rows)
+        elif by:  # zero rows with keys: no groups at all
+            return self._from_local(
+                self._to_local().group(by, aggregations, header, parameters)
+            )
+        else:  # global aggregation: one group, even over zero rows
+            seg = np.zeros(n, dtype=np.int64)
+            k = 1
+        seg_j = jnp.asarray(seg)
+
+        ev = TpuEvaluator(self, header, parameters)
+        for out_col, agg in aggregations:
+            name = agg.name.lower()
+            if agg.expr is None:  # count(*): every row counts
+                out_cols[out_col] = Column(
+                    I64,
+                    jax.ops.segment_sum(
+                        jnp.ones(n, jnp.int64), seg_j, num_segments=k
+                    ),
+                    None,
+                )
+                continue
+            col = ev.eval(agg.expr)
+            if col.kind == OBJ:
+                raise TpuUnsupportedExpr("object-valued aggregation input")
+            data, kind, vocab = col.data, col.kind, col.vocab
+            valid = col.valid_mask()
+            cnt = jax.ops.segment_sum(
+                valid.astype(jnp.int64), seg_j, num_segments=k
+            )
+            if name == "count":
+                out_cols[out_col] = Column(I64, cnt, None)
+                continue
+            if name in ("sum", "avg"):
+                if kind not in (I64, F64):
+                    raise TpuUnsupportedExpr(f"{name} over {kind}")
+                if kind == F64 and name == "sum" and bool(jnp.any(cnt == 0)):
+                    # Cypher sum over no values is the INTEGER 0; a float
+                    # column cannot hold it — let the oracle type that group
+                    raise TpuUnsupportedExpr("float sum over an empty group")
+                zero = jnp.zeros((), data.dtype)
+                ssum = jax.ops.segment_sum(
+                    jnp.where(valid, data, zero), seg_j, num_segments=k
+                )
+                if name == "sum":
+                    out_cols[out_col] = Column(kind, ssum, None)
+                else:
+                    avg = ssum.astype(jnp.float64) / jnp.maximum(cnt, 1)
+                    out_cols[out_col] = Column(F64, avg, cnt > 0)
+                continue
+            # min / max with Cypher orderability: numbers < NaN; nulls skipped
+            d = data.astype(jnp.int8) if kind == BOOL else data
+            if kind == F64:
+                isnan = jnp.isnan(d) & valid
+                nn_valid = valid & ~isnan
+                nan_cnt = jax.ops.segment_sum(
+                    isnan.astype(jnp.int64), seg_j, num_segments=k
+                )
+            else:
+                nn_valid = valid
+                nan_cnt = None
+            big = jnp.asarray(
+                np.inf if kind == F64 else np.iinfo(np.dtype(d.dtype)).max,
+                d.dtype,
+            )
+            if name == "min":
+                agged = jax.ops.segment_min(
+                    jnp.where(nn_valid, d, big), seg_j, num_segments=k
+                )
+                if nan_cnt is not None:
+                    # all-NaN group: min is NaN (NaN sorts above numbers)
+                    nn_cnt = cnt - nan_cnt
+                    agged = jnp.where(
+                        (nn_cnt == 0) & (nan_cnt > 0), jnp.nan, agged
+                    )
+            else:
+                agged = jax.ops.segment_max(
+                    jnp.where(nn_valid, d, -big if kind != STR else -jnp.ones((), d.dtype)),
+                    seg_j,
+                    num_segments=k,
+                )
+                if nan_cnt is not None:
+                    # any NaN: NaN is the maximum under Cypher orderability
+                    agged = jnp.where(nan_cnt > 0, jnp.nan, agged)
+            if kind == BOOL:
+                agged = agged.astype(bool)
+            out_cols[out_col] = Column(kind, agged, cnt > 0, vocab)
+        return TpuTable(out_cols, k)
 
     def with_columns(self, items, header, parameters) -> "TpuTable":
         out = dict(self._cols)
