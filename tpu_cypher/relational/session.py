@@ -56,8 +56,12 @@ class CypherResult:
     def records(self) -> Optional[RelationalCypherRecords]:
         if self.relational_plan is None:
             return None
+        from ..utils.profiling import profile_trace
+
+        with profile_trace():  # no-op unless TPU_CYPHER_PROFILE_DIR is set
+            table = self.relational_plan.table  # pulls the whole physical plan
         return RelationalCypherRecords(
-            self.relational_plan.header, self.relational_plan.table, self._returns
+            self.relational_plan.header, table, self._returns
         )
 
     @property

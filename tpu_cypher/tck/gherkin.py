@@ -47,6 +47,7 @@ class Scenario:
 class Feature:
     name: str
     scenarios: List[Scenario] = field(default_factory=list)
+    source: str = ""  # raw feature text (test generator re-embeds it)
 
 
 def _split_table_row(line: str) -> List[str]:
@@ -129,7 +130,7 @@ def parse_feature(text: str, path: str = "<string>") -> Feature:
             pending_tags.extend(t for t in line.split() if t.startswith("@"))
             continue
         if line.startswith("Feature:"):
-            feature = Feature(line[len("Feature:"):].strip())
+            feature = Feature(line[len("Feature:"):].strip(), source=text)
             pending_tags = []
             continue
         if feature is None:

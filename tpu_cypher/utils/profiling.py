@@ -1,0 +1,67 @@
+"""Device-level observability: jax.profiler traces and compiled-HLO dumps.
+
+The reference delegates engine-level profiling to Spark UI /
+``tableEnv.explain`` (used in ``flink-cypher/.../Demo.scala:84``); the TPU
+equivalents are the XLA profiler (TensorBoard-compatible traces) and the
+compiled HLO of the jitted kernels. Gated by ``TPU_CYPHER_PROFILE_DIR``:
+when set, ``CypherSession.cypher`` executions are wrapped in a profiler
+trace automatically.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+from typing import Any, Callable, Optional
+
+from .config import ConfigOption
+
+PROFILE_DIR = ConfigOption("TPU_CYPHER_PROFILE_DIR", "", str)
+
+
+@contextlib.contextmanager
+def profile_trace(log_dir: Optional[str] = None):
+    """Wrap a block in a ``jax.profiler`` trace (viewable in TensorBoard /
+    Perfetto). No-op when no directory is configured or the profiler is
+    unavailable."""
+    d = log_dir or PROFILE_DIR.get()
+    if not d:
+        yield
+        return
+    try:
+        import jax
+
+        jax.profiler.start_trace(d)
+    except Exception:  # pragma: no cover - no jax, double-start, unsupported
+        yield
+        return
+    try:
+        yield
+    finally:
+        try:
+            jax.profiler.stop_trace()
+        except Exception:  # pragma: no cover
+            pass
+
+
+def lowered_hlo(fn: Callable, *args: Any, **kw: Any) -> str:
+    """StableHLO text for a jittable function on example args — the per-node
+    plan introspection analog of the reference's ``tableEnv.explain``."""
+    import jax
+
+    return jax.jit(fn).lower(*args, **kw).as_text()
+
+
+def compiled_hlo(fn: Callable, *args: Any, **kw: Any) -> str:
+    """Post-XLA-optimization HLO (what actually runs on the device)."""
+    import jax
+
+    compiled = jax.jit(fn).lower(*args, **kw).compile()
+    return "\n".join(m.to_string() for m in compiled.runtime_executable().hlo_modules())
+
+
+def annotate(name: str):
+    """Named profiler span for region attribution inside traces."""
+    import jax
+
+    return jax.profiler.TraceAnnotation(name)
