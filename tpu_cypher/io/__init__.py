@@ -12,6 +12,7 @@ from .datasource import (
 )
 from .edge_list import EdgeListDataSource, load_edge_list
 from .fs import FSGraphSource
+from .ldbc import generate_snb, load_snb_csv
 from .neo4j import (
     Neo4jBulkCSVDataSink,
     Neo4jConfig,
@@ -23,6 +24,8 @@ __all__ = [
     "DataSourceError",
     "EdgeListDataSource",
     "FSGraphSource",
+    "generate_snb",
+    "load_snb_csv",
     "Neo4jBulkCSVDataSink",
     "Neo4jConfig",
     "Neo4jPropertyGraphDataSource",

@@ -56,10 +56,13 @@ class CypherResult:
     def records(self) -> Optional[RelationalCypherRecords]:
         if self.relational_plan is None:
             return None
-        from ..utils.profiling import profile_trace
+        from ..utils.profiling import PROFILE_DIR, profile_trace
 
         with profile_trace():  # no-op unless TPU_CYPHER_PROFILE_DIR is set
             table = self.relational_plan.table  # pulls the whole physical plan
+            if PROFILE_DIR.get():
+                # async dispatch would escape the trace: block on device work
+                table = table.cache()
         return RelationalCypherRecords(
             self.relational_plan.header, table, self._returns
         )

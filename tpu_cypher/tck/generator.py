@@ -118,11 +118,20 @@ def generate_all(
     ScenariosFor(features, black)
     os.makedirs(out_dir, exist_ok=True)
     written: List[str] = []
+    used_names: set = set()
     for f in features:
         src = generate_feature_module(f, black, session_factory, keywords)
         if src is None:
             continue
-        path = os.path.join(out_dir, f"test_tck_{_safe(f.name)}.py")
+        # dedup module filenames: distinct features may sanitize identically
+        base = f"test_tck_{_safe(f.name)}"
+        name = base
+        i = 1
+        while name in used_names:
+            i += 1
+            name = f"{base}_{i}"
+        used_names.add(name)
+        path = os.path.join(out_dir, f"{name}.py")
         with open(path, "w") as fh:
             fh.write(src)
         written.append(path)
