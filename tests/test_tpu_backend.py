@@ -173,6 +173,84 @@ def test_group_collect_falls_back_cleanly():
     assert r[0]["d"] == 3
 
 
+def test_right_and_full_outer_on_device(monkeypatch):
+    # right/full outer joins must run on device — no oracle fallback
+    def boom(self):
+        raise AssertionError("outer join fell back to the local oracle")
+
+    monkeypatch.setattr(TpuTable, "_to_local", boom)
+    try:
+        a = TpuTable.from_columns({"k": [1, 2, 2], "x": [10, 20, 21]})
+        b = TpuTable.from_columns({"j": [2, 3], "y": ["b", "c"]})
+        r = a.join(b, "right_outer", [("k", "j")])
+        rows = sorted(((r_["x"], r_["y"]) for r_ in r.rows()), key=str)
+        assert rows == [(20, "b"), (21, "b"), (None, "c")]
+        f = a.join(b, "full_outer", [("k", "j")])
+        rows = sorted(((r_["k"], r_["j"]) for r_ in f.rows()), key=str)
+        assert rows == [(1, None), (2, 2), (2, 2), (None, 3)]
+    finally:
+        monkeypatch.undo()
+
+
+def test_string_key_join_on_device(monkeypatch):
+    # dictionary-coded string keys join via unified vocab — no fallback
+    def boom(self):
+        raise AssertionError("string-key join fell back to the local oracle")
+
+    monkeypatch.setattr(TpuTable, "_to_local", boom)
+    try:
+        a = TpuTable.from_columns({"k": ["x", "y", None, "z"]})
+        b = TpuTable.from_columns({"j": ["y", "z", "w", None], "v": [1, 2, 3, 4]})
+        out = a.join(b, "inner", [("k", "j")])
+        rows = sorted((r["k"], r["v"]) for r in out.rows())
+        assert rows == [("y", 1), ("z", 2)]
+    finally:
+        monkeypatch.undo()
+
+
+def test_nan_keys_never_join_either_backend():
+    # joins implement `=` predicates (replaceCartesianWithValueJoin):
+    # Cypher NaN = NaN is false, so NaN keys must not match — on both backends
+    from tpu_cypher.backend.local.table import LocalTable
+
+    nan = float("nan")
+    for cls in (TpuTable, LocalTable):
+        a = cls.from_columns({"k": [nan, 1.0]})
+        b = cls.from_columns({"j": [nan, 1.0]})
+        out = a.join(b, "inner", [("k", "j")])
+        assert out.size == 1, cls.__name__
+
+
+def test_mixed_int_float_join_keys_exact():
+    # ints above 2**53 must not collapse when joined against floats
+    # (graph-tagged ids live at 2**54+); equality is exact, not via-f64
+    from tpu_cypher.backend.local.table import LocalTable
+
+    big = 2**53 + 1
+    for cls in (TpuTable, LocalTable):
+        a = cls.from_columns({"k": [big, 7, 10]})
+        b = cls.from_columns({"j": [float(2**53), 7.0, 7.5, 10.0]})
+        out = a.join(b, "inner", [("k", "j")])
+        rows = sorted((r["k"], r["j"]) for r in out.rows())
+        assert rows == [(7, 7.0), (10, 10.0)], cls.__name__
+
+
+def test_skip_limit_slice_not_gather():
+    t = TpuTable.from_columns({"x": list(range(10))})
+    s = t.skip(3).limit(4)
+    assert [r["x"] for r in s.rows()] == [3, 4, 5, 6]
+    assert t.skip(99).size == 0
+    assert t.limit(0).size == 0
+
+
+def test_column_type_obj_cached():
+    t = TpuTable.from_columns({"x": [[1, 2], [3]]})
+    t1 = t.column_type("x")
+    col = t._cols["x"]
+    assert col._obj_type is not None
+    assert t.column_type("x") is t1 or t.column_type("x") == t1
+
+
 def test_float_sum_empty_group_is_integer_zero():
     # oracle: Cypher sum over no values = integer 0 even for float inputs
     tpu = CypherSession.tpu()

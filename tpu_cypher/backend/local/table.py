@@ -111,11 +111,20 @@ class LocalTable(Table):
             return self._cross(other)
         lcols = [l for l, _ in join_cols]
         rcols = [r for _, r in join_cols]
-        # hash join on equivalence keys; null join keys never match
+        # hash join on equivalence keys; null join keys never match, and
+        # neither do NaN keys: joins are planner rewrites of `=` predicates
+        # (replaceCartesianWithValueJoin), and Cypher `NaN = NaN` is false —
+        # matching them here would make the optimized plan differ from the
+        # unoptimized Filter(Equals) it replaces
+        def _no_match(key) -> bool:
+            return any(
+                k is None or (isinstance(k, float) and k != k) for k in key
+            )
+
         build: Dict[Tuple, List[int]] = {}
         for j in range(other._nrows):
             key = tuple(other._cols[c][j] for c in rcols)
-            if any(k is None for k in key):
+            if _no_match(key):
                 key = None
             else:
                 key = tuple(_equiv_key(k) for k in key)
@@ -125,7 +134,7 @@ class LocalTable(Table):
         matched_right: set = set()
         for i in range(self._nrows):
             key = tuple(self._cols[c][i] for c in lcols)
-            if any(k is None for k in key):
+            if _no_match(key):
                 matches = []
             else:
                 matches = build.get(tuple(_equiv_key(k) for k in key), [])

@@ -46,6 +46,7 @@ class Column:
     data: Any  # jnp array (device) or np object array for OBJ
     valid: Optional[Any]  # jnp bool array or None (= all valid)
     vocab: Optional[List[str]] = None  # sorted, for STR
+    _obj_type: Optional[CypherType] = None  # cached OBJ value type (metadata)
 
     def __len__(self) -> int:
         return int(self.data.shape[0]) if self.kind != OBJ else len(self.data)
@@ -232,12 +233,45 @@ class Column:
 
     def sort_key(self, descending: bool = False):
         """A numeric array whose ascending order == Cypher orderability
-        (nulls last ascending). Returns (primary, is_null) pair arrays."""
+        (nulls last ascending). Returns (primary, is_null) pair arrays —
+        both device-resident."""
         if self.kind == OBJ:
             raise TpuBackendError("Cannot sort object columns on device")
-        null = ~np.asarray(self.valid_mask())
-        data = np.asarray(self.data, dtype=np.float64 if self.kind == F64 else None)
+        null = ~self.valid_mask()
+        data = self.data.astype(jnp.float64) if self.kind == F64 else self.data
         return data, null
+
+    def slice(self, lo: int, hi: int) -> "Column":
+        """Contiguous row slice (device slice — no gather)."""
+        if self.kind == OBJ:
+            return Column(OBJ, self.data[lo:hi], None)
+        data = self.data[lo:hi]
+        valid = self.valid[lo:hi] if self.valid is not None else None
+        return Column(self.kind, data, valid, self.vocab)
+
+    def equivalence_keys(self) -> List[Any]:
+        """Device key arrays whose row-wise equality == Cypher equivalence
+        for this column: null payloads canonicalized to 0 (outer joins leave
+        arbitrary data under valid=False), NaN gets its own equivalence class
+        (keyed by a separate flag), and -0.0 == 0.0. Shared by ``distinct``
+        and ``group`` ONLY — join keys deliberately implement ``=`` semantics
+        instead (NaN never matches), so they must not use these keys."""
+        if self.kind == OBJ:
+            raise TpuBackendError("object columns have no device keys")
+        valid = self.valid_mask()
+        data = self.data
+        keys: List[Any] = []
+        if self.kind == F64:
+            nan = jnp.isnan(data) & valid
+            data = jnp.where(valid & ~nan, data, 0.0)
+            data = data + 0.0  # -0.0 == 0.0
+            keys.append(nan)
+        elif self.kind == BOOL:
+            data = data.astype(jnp.int8)
+        data = jnp.where(valid, data, jnp.zeros((), data.dtype))
+        keys.append(data)
+        keys.append(~valid)
+        return keys
 
     def cypher_type(self) -> CypherType:
         base = {

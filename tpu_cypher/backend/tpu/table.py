@@ -2,27 +2,34 @@
 
 The TPU-native analog of the reference's ``DataFrameTable``/``FlinkTable``
 (``SparkTable.scala:55`` / ``FlinkTable.scala:49``): columns are device
-arrays (``column.Column``) with validity masks; the relational hot path runs
-on device:
+arrays (``column.Column``) with validity masks, and every relational hot-path
+operator executes on device. Output sizes are data-dependent, so each
+size-producing STEP performs one scalar device->host sync (the count — e.g.
+a join syncs the build-side valid count, the match total, and outer-pad
+counts) and then uses fixed-size device primitives (``jnp.nonzero(size=..)``,
+``jnp.repeat(total_repeat_length=..)``); bulk row data never crosses to the
+host — the eager-mode analog of the count-then-materialize discipline the
+fused kernels use under jit:
 
-* filter        = compiled predicate -> boolean mask -> compacted gather
-* join          = sort + searchsorted probe (build side sorted once), the
-                  dense analog of the engines' shuffled hash join; extra key
-                  pairs become post-join equality masks
+* filter        = compiled predicate -> device mask -> count sync ->
+                  fixed-size nonzero + gather
+* join          = device sort + searchsorted probe (build side lexsorted
+                  valid-first); inner/left/right/full outer all on device;
+                  extra key pairs become device post-filters; string keys
+                  join on unified dictionary codes
 * union_all     = columnwise concat (string vocabs unified)
-* order_by      = host key computation + stable lexsort, device gather
-* distinct      = first-occurrence selection over packed keys
+* order_by      = device lexsort over Cypher-orderability keys
+* distinct      = stable device lexsort + neighbour-difference flags ->
+                  first-occurrence gather
+* group         = device lexsort factorization (same equivalence classes as
+                  distinct) + ``jax.ops.segment_*`` aggregation
+* skip/limit    = contiguous device slices (no gather)
 * with_columns  = compiled expressions
 
-* group         = host group-index factorization (same key equivalence
-                  classes as distinct) + ``jax.ops.segment_*`` aggregation
-                  on device for count/sum/avg/min/max
-
-Operations the Expr->jnp compiler can't express (list values, regex, string
-concat, exotic functions) and the remaining aggregators (collect, stdev,
-percentiles, DISTINCT variants) transparently fall back to the local oracle
-backend, keeping full Cypher semantics while the id/predicate/aggregate
-machinery stays on device."""
+Operations with no device representation (list values, regex, string concat,
+exotic functions, object columns) and the remaining aggregators (collect,
+stdev, percentiles, DISTINCT variants) transparently fall back to the local
+oracle backend, keeping full Cypher semantics."""
 
 from __future__ import annotations
 
@@ -93,7 +100,11 @@ class TpuTable(Table):
             return T.CTVoid
         c = self._cols[col]
         if c.kind == OBJ:
-            return T.join_types(T.type_of_value(v) for v in c.to_values())
+            # O(n) decode — computed once and cached on the (immutable)
+            # column so planner metadata probes stay O(1)
+            if c._obj_type is None:
+                c._obj_type = T.join_types(T.type_of_value(v) for v in c.to_values())
+            return c._obj_type
         return c.cypher_type()
 
     @property
@@ -127,11 +138,14 @@ class TpuTable(Table):
 
     def skip(self, n: int) -> "TpuTable":
         n = min(n, self._nrows)
-        return TpuTable({c: col.take(jnp.arange(n, self._nrows)) for c, col in self._cols.items()}, self._nrows - n)
+        return TpuTable(
+            {c: col.slice(n, self._nrows) for c, col in self._cols.items()},
+            self._nrows - n,
+        )
 
     def limit(self, n: int) -> "TpuTable":
         n = min(n, self._nrows)
-        return TpuTable({c: col.take(jnp.arange(n)) for c, col in self._cols.items()}, n)
+        return TpuTable({c: col.slice(0, n) for c, col in self._cols.items()}, n)
 
     def cache(self) -> "TpuTable":
         for col in self._cols.values():
@@ -139,15 +153,22 @@ class TpuTable(Table):
                 col.data.block_until_ready()
         return self
 
+    # -- device compaction helper -----------------------------------------
+
+    @staticmethod
+    def _mask_to_idx(mask) -> Tuple[Any, int]:
+        """Boolean device mask -> (index array, count) with ONE scalar sync."""
+        count = int(mask.sum())
+        return jnp.nonzero(mask, size=count)[0], count
+
     # -- filter ------------------------------------------------------------
 
     def filter(self, expr, header, parameters) -> "TpuTable":
         try:
             c = TpuEvaluator(self, header, parameters).eval(expr)
-            mask = np.asarray(c.data & c.valid_mask())
         except TpuUnsupportedExpr:
             return self._from_local(self._to_local().filter(expr, header, parameters))
-        idx = jnp.asarray(np.nonzero(mask)[0])
+        idx, _ = self._mask_to_idx(c.data & c.valid_mask())
         return self._take(idx)
 
     # -- join --------------------------------------------------------------
@@ -157,67 +178,183 @@ class TpuTable(Table):
             n, m = self._nrows, other._nrows
             li = jnp.repeat(jnp.arange(n), m)
             ri = jnp.tile(jnp.arange(m), n)
-            return self._combine(other, li, ri, None)
-        if kind in ("right_outer", "full_outer"):
-            lt = self._to_local().join(other._to_local(), kind, join_cols)
-            return self._from_local(lt)
+            return self._combine(other, li, ri)
+        if kind == "right_outer":
+            # mirror of left_outer; the flipped _combine emits right-table
+            # columns first, so restore canonical (left-first) column order
+            flipped = [(r, l) for l, r in join_cols]
+            res = other._join_device_or_local(
+                self, "left_outer", flipped, swap_sides=True
+            )
+            ordered = {c: res._cols[c] for c in (*self._cols, *other._cols)}
+            return TpuTable(ordered, res._nrows)
+        return self._join_device_or_local(other, kind, join_cols, swap_sides=False)
+
+    def _join_device_or_local(self, other, kind, join_cols, swap_sides) -> "TpuTable":
         lcols = [self._cols[l] for l, _ in join_cols]
         rcols = [other._cols[r] for _, r in join_cols]
-        if any(c.kind not in (I64,) for c in lcols + rcols):
+        if any(c.kind == OBJ for c in lcols + rcols):
+            if swap_sides:
+                lt = other._to_local().join(self._to_local(), "right_outer",
+                                            [(r, l) for l, r in join_cols])
+                return self._from_local(lt)
             lt = self._to_local().join(other._to_local(), kind, join_cols)
             return self._from_local(lt)
-        # device sort-probe join on the first key; further keys post-filtered
-        lk, rk = lcols[0], rcols[0]
-        lvalid = np.asarray(lk.valid_mask())
-        rvalid = np.asarray(rk.valid_mask())
-        for c in lcols[1:]:
-            lvalid = lvalid & np.asarray(c.valid_mask())
-        for c in rcols[1:]:
-            rvalid = rvalid & np.asarray(c.valid_mask())
-        ld = np.asarray(lk.data)
-        rd = np.asarray(rk.data)
-        order = np.argsort(rd[rvalid], kind="stable")
-        r_idx_valid = np.nonzero(rvalid)[0][order]
-        r_sorted = rd[r_idx_valid]
-        lo = np.searchsorted(r_sorted, ld, side="left")
-        hi = np.searchsorted(r_sorted, ld, side="right")
-        counts = np.where(lvalid, hi - lo, 0).astype(np.int64)
-        total = int(counts.sum())
-        left_rows = np.repeat(np.arange(self._nrows, dtype=np.int64), counts)
-        starts = np.repeat(lo.astype(np.int64), counts)
-        excl = np.concatenate([np.zeros(1, np.int64), np.cumsum(counts)])[:-1]
-        offsets = np.arange(total, dtype=np.int64) - np.repeat(excl, counts)
-        right_rows = r_idx_valid[starts + offsets] if total else np.zeros(0, np.int64)
-        matched_mask = None
-        if len(join_cols) > 1 and total:
-            keep = np.ones(total, bool)
-            for (lcn, rcn) in join_cols[1:]:
-                lc = self._cols[lcn]
-                rc = other._cols[rcn]
-                lv = np.asarray(lc.data)[left_rows]
-                rv = np.asarray(rc.data)[right_rows]
-                keep &= lv == rv
-            left_rows = left_rows[keep]
-            right_rows = right_rows[keep]
-            total = int(keep.sum())
-        if kind == "left_outer":
-            have = np.zeros(self._nrows, bool)
-            have[left_rows] = True
-            missing = np.nonzero(~have)[0]
-            left_rows = np.concatenate([left_rows, missing])
-            right_rows = np.concatenate([right_rows, np.zeros(len(missing), np.int64)])
-            matched_mask = np.concatenate(
-                [np.ones(total, bool), np.zeros(len(missing), bool)]
-            )
-        li = jnp.asarray(left_rows.astype(np.int64))
-        ri = jnp.asarray(right_rows.astype(np.int64))
-        mm = jnp.asarray(matched_mask) if matched_mask is not None else None
-        return self._combine(other, li, ri, mm)
+        return self._join_device(other, kind, join_cols, swap_sides)
 
-    def _combine(self, other: "TpuTable", li, ri, right_in_bounds) -> "TpuTable":
+    def _join_device(self, other, kind, join_cols, swap_sides=False) -> "TpuTable":
+        """Device sort-probe equi-join (the TPU analog of the engines'
+        shuffled hash join, ``SparkTable.scala:178``): the build (right) side
+        is lexsorted valid-first-by-key once, the probe side binary-searches
+        it; matches materialize via fixed-size repeat+gather. Multi-key joins
+        probe on the first key and post-filter the rest on device."""
+        lk, rk = self._cols[join_cols[0][0]], other._cols[join_cols[0][1]]
+        if lk.kind == STR or rk.kind == STR:
+            if lk.kind != STR or rk.kind != STR:
+                return self._join_empty_result(other, kind)
+            from .column import _unify_vocab
+
+            lk, rk = _unify_vocab(lk, rk)
+        elif lk.kind != rk.kind:
+            if {lk.kind, rk.kind} == {I64, F64}:
+                # exact mixed numeric equality: casting the int side to f64
+                # would collapse ints above 2**53 (graph-tagged ids live at
+                # 2**54+) — instead the float side joins as exact int64
+                # where it is integral & in range, and never matches elsewhere
+                if lk.kind == F64:
+                    lk = _float_as_exact_int(lk)
+                else:
+                    rk = _float_as_exact_int(rk)
+            else:  # cross-kind keys never match
+                return self._join_empty_result(other, kind)
+        lvalid = lk.valid_mask()
+        rvalid = rk.valid_mask()
+        for c in [self._cols[l] for l, _ in join_cols[1:]]:
+            lvalid = lvalid & c.valid_mask()
+        for c in [other._cols[r] for _, r in join_cols[1:]]:
+            rvalid = rvalid & c.valid_mask()
+        ld, rd = lk.data, rk.data
+        if lk.kind == F64:  # NaN = NaN is false in Cypher: NaN keys never join
+            lvalid = lvalid & ~jnp.isnan(ld)
+            rvalid = rvalid & ~jnp.isnan(rd)
+        if lk.kind == BOOL:
+            ld, rd = ld.astype(jnp.int8), rd.astype(jnp.int8)
+        n = self._nrows
+        # build side: valid rows first, sorted by key (stable lexsort,
+        # primary key LAST in the tuple)
+        r_order = jnp.lexsort((rd, ~rvalid))
+        nvalid = int(rvalid.sum())
+        r_idx_valid = r_order[:nvalid]
+        r_sorted = rd[r_idx_valid]
+        lo = jnp.searchsorted(r_sorted, ld, side="left")
+        hi = jnp.searchsorted(r_sorted, ld, side="right")
+        counts = jnp.where(lvalid, hi - lo, 0).astype(jnp.int64)
+        total = int(counts.sum())
+        left_rows = jnp.repeat(
+            jnp.arange(n, dtype=jnp.int64), counts, total_repeat_length=total
+        )
+        starts = jnp.repeat(lo.astype(jnp.int64), counts, total_repeat_length=total)
+        excl = jnp.concatenate([jnp.zeros(1, jnp.int64), jnp.cumsum(counts)])[:-1]
+        offsets = jnp.arange(total, dtype=jnp.int64) - jnp.repeat(
+            excl, counts, total_repeat_length=total
+        )
+        right_rows = (
+            r_idx_valid[starts + offsets]
+            if total
+            else jnp.zeros(0, jnp.int64)
+        )
+        if len(join_cols) > 1 and total:
+            keep = jnp.ones(total, bool)
+            for (lcn, rcn) in join_cols[1:]:
+                lc, rc = self._cols[lcn], other._cols[rcn]
+                if lc.kind == STR or rc.kind == STR:
+                    if lc.kind != STR or rc.kind != STR:
+                        keep = jnp.zeros(total, bool)
+                        continue
+                    from .column import _unify_vocab
+
+                    lc, rc = _unify_vocab(lc, rc)
+                elif {lc.kind, rc.kind} == {I64, F64}:
+                    # same exact mixed numeric equality as the probe key
+                    if lc.kind == F64:
+                        lc = _float_as_exact_int(lc)
+                    else:
+                        rc = _float_as_exact_int(rc)
+                elif lc.kind != rc.kind:
+                    keep = jnp.zeros(total, bool)
+                    continue
+                lv = jnp.take(lc.data, left_rows)
+                rv = jnp.take(rc.data, right_rows)
+                eq = lv == rv
+                if lc.kind == F64:
+                    eq = eq & ~jnp.isnan(lv)
+                keep = keep & eq
+            idx, total = self._mask_to_idx(keep)
+            left_rows = left_rows[idx]
+            right_rows = right_rows[idx]
+        left_matched = None
+        right_matched = None
+        if kind in ("left_outer", "full_outer"):
+            have = jnp.zeros(n, bool).at[left_rows].set(True)
+            miss_idx, nmiss = self._mask_to_idx(~have)
+            left_rows = jnp.concatenate([left_rows, miss_idx])
+            right_rows = jnp.concatenate(
+                [right_rows, jnp.zeros(nmiss, jnp.int64)]
+            )
+            right_matched = jnp.concatenate(
+                [jnp.ones(total, bool), jnp.zeros(nmiss, bool)]
+            )
+        if kind == "full_outer":
+            rhave = jnp.zeros(other._nrows, bool).at[
+                right_rows[: total]
+            ].set(True)
+            rmiss_idx, rnmiss = self._mask_to_idx(~rhave)
+            cur = int(left_rows.shape[0])
+            left_rows = jnp.concatenate([left_rows, jnp.zeros(rnmiss, jnp.int64)])
+            right_rows = jnp.concatenate([right_rows, rmiss_idx])
+            left_matched = jnp.concatenate(
+                [jnp.ones(cur, bool), jnp.zeros(rnmiss, bool)]
+            )
+            right_matched = jnp.concatenate(
+                [right_matched, jnp.ones(rnmiss, bool)]
+            )
+        return self._combine(
+            other, left_rows, right_rows, right_matched, left_matched
+        )
+
+    def _join_empty_result(self, other: "TpuTable", kind) -> "TpuTable":
+        """Key kinds can never be equal: inner = empty, outer = all-null."""
+        z = jnp.zeros(0, jnp.int64)
+        if kind == "inner":
+            return self._combine(other, z, z)
+        if kind == "left_outer":
+            li = jnp.arange(self._nrows, dtype=jnp.int64)
+            return self._combine(
+                other, li, jnp.zeros(self._nrows, jnp.int64),
+                jnp.zeros(self._nrows, bool), None,
+            )
+        # full_outer: left rows with null right, then right rows with null left
+        nl, nr = self._nrows, other._nrows
+        li = jnp.concatenate([jnp.arange(nl, dtype=jnp.int64), jnp.zeros(nr, jnp.int64)])
+        ri = jnp.concatenate([jnp.zeros(nl, jnp.int64), jnp.arange(nr, dtype=jnp.int64)])
+        rm = jnp.concatenate([jnp.zeros(nl, bool), jnp.ones(nr, bool)])
+        lm = jnp.concatenate([jnp.ones(nl, bool), jnp.zeros(nr, bool)])
+        return self._combine(other, li, ri, rm, lm)
+
+    def _combine(
+        self,
+        other: "TpuTable",
+        li,
+        ri,
+        right_in_bounds=None,
+        left_in_bounds=None,
+    ) -> "TpuTable":
         out: Dict[str, Column] = {}
         for c, col in self._cols.items():
-            out[c] = col.take(li)
+            if left_in_bounds is None:
+                out[c] = col.take(li)
+            else:
+                out[c] = col.take_or_null(li, left_in_bounds)
         for c, col in other._cols.items():
             if c in out:
                 raise TpuBackendError(f"Join column collision: {c}")
@@ -248,58 +385,71 @@ class TpuTable(Table):
             col = self._cols[colname]
             data, null = col.sort_key()
             if col.kind == BOOL:
-                data = data.astype(np.int8)
-            nan = np.isnan(data) if col.kind == F64 else None
+                data = data.astype(jnp.int8)
+            if col.kind == F64:
+                nan = jnp.isnan(data)
+                data = jnp.where(nan, 0.0, data)  # NaN rank lives in the flag
+            else:
+                nan = None
             # ascending Cypher order: numbers < NaN < null; DESC is the exact
             # reverse, so every subkey is negated
             if asc:
                 keys.append(data)
                 if nan is not None:
-                    keys.append(nan.astype(np.int8))
-                keys.append(null.astype(np.int8))
+                    keys.append(nan.astype(jnp.int8))
+                keys.append(null.astype(jnp.int8))
             else:
                 keys.append(-data)
                 if nan is not None:
-                    keys.append(-nan.astype(np.int8))
-                keys.append(-null.astype(np.int8))
-        # np.lexsort: last key is primary — pairs were appended in reverse
-        # item order, null flag after data, so priority is item0 null, item0
-        # nan, item0 data, item1 null, ...
-        idx = np.lexsort(tuple(keys)) if keys else np.arange(self._nrows)
-        return self._take(jnp.asarray(idx.astype(np.int64)))
+                    keys.append(-nan.astype(jnp.int8))
+                keys.append(-null.astype(jnp.int8))
+        # device lexsort (stable): last key is primary — pairs were appended
+        # in reverse item order, null flag after data, so priority is item0
+        # null, item0 nan, item0 data, item1 null, ...
+        if not keys:
+            return self
+        idx = jnp.lexsort(tuple(keys))
+        return self._take(idx.astype(jnp.int64))
 
-    # -- distinct ----------------------------------------------------------
+    # -- distinct / group factorization ------------------------------------
 
-    def _pack_keys(self, on: Sequence[str]):
-        """Host-side equivalence-class key packing shared by ``distinct`` and
-        ``group``: null payloads canonicalized (outer joins leave arbitrary
-        data under valid=False), NaN gets its own equivalence class, and
-        -0.0 == 0.0."""
-        arrays = []
+    def _equivalence_keys(self, on: Sequence[str]) -> List[Any]:
+        """Device key arrays over ``on`` whose row equality == Cypher
+        equivalence (see ``Column.equivalence_keys``)."""
+        keys: List[Any] = []
         for c in on:
-            col = self._cols[c]
-            a = np.asarray(col.data).copy()
-            valid = np.asarray(col.valid_mask())
-            a[~valid] = 0
-            if col.kind == F64:
-                nan = np.isnan(a) & valid
-                a[nan] = 0.0  # NaN equivalence class, keyed by the nan flag
-                a[a == 0.0] = 0.0  # -0.0 == 0.0
-                arrays.append(nan)
-            arrays.append(a)
-            arrays.append(~valid)
-        return np.rec.fromarrays(arrays) if arrays else None
+            keys.extend(self._cols[c].equivalence_keys())
+        return keys
+
+    def _first_occurrence_index(self, on: Sequence[str]) -> Tuple[Any, Any]:
+        """Stable device lexsort over equivalence keys -> (sorted row order,
+        first-of-group flags over the sorted order). The stable sort makes
+        the first row of each equal-key run the earliest original row of
+        that group."""
+        keys = self._equivalence_keys(on)
+        order = jnp.lexsort(tuple(reversed(keys)))
+        diff = jnp.zeros(self._nrows - 1, bool) if self._nrows > 1 else None
+        if diff is not None:
+            for k in keys:
+                ks = jnp.take(k, order)
+                diff = diff | (ks[1:] != ks[:-1])
+            flags = jnp.concatenate([jnp.ones(1, bool), diff])
+        else:
+            flags = jnp.ones(self._nrows, bool)
+        return order, flags
 
     def distinct(self, cols: Optional[Sequence[str]] = None) -> "TpuTable":
         on = list(cols) if cols is not None else self.physical_columns
         if any(self._cols[c].kind == OBJ for c in on):
             return self._from_local(self._to_local().distinct(on))
+        if not on:
+            return self.limit(1) if self._nrows > 1 else self
         if self._nrows == 0:
             return self
-        packed = self._pack_keys(on)
-        _, first = np.unique(packed, return_index=True)
-        first.sort()
-        return self._take(jnp.asarray(first.astype(np.int64)))
+        order, flags = self._first_occurrence_index(on)
+        idx, _ = self._mask_to_idx(flags)
+        first = jnp.sort(jnp.take(order, idx))  # keep original row order
+        return self._take(first)
 
     # -- aggregation / projection / explode --------------------------------
 
@@ -316,7 +466,7 @@ class TpuTable(Table):
 
     def _group_device(self, by, aggregations, header, parameters) -> "TpuTable":
         """Grouped aggregation as device segment ops: group assignment reuses
-        ``distinct``'s host key canonicalization (null/NaN equivalence
+        ``distinct``'s device lexsort factorization (null/NaN equivalence
         classes), then count/sum/avg/min/max run as ``jax.ops.segment_*``
         over the group index — the TPU replacement for the engines' shuffle
         aggregate (reference ``Table.group``)."""
@@ -337,17 +487,19 @@ class TpuTable(Table):
         n = self._nrows
         out_cols: Dict[str, Column] = {}
         if by and n > 0:
-            packed = self._pack_keys(by)
-            _, first, inverse = np.unique(
-                packed, return_index=True, return_inverse=True
-            )
+            order, flags = self._first_occurrence_index(by)
+            flag_idx, k = self._mask_to_idx(flags)
+            # group id per sorted position, scattered back to row order
+            seg_sorted = jnp.cumsum(flags.astype(jnp.int64)) - 1
+            seg_rows = jnp.zeros(n, jnp.int64).at[order].set(seg_sorted)
             # renumber groups in first-occurrence order (= the local oracle)
-            order = np.argsort(first, kind="stable")
-            rank = np.empty_like(order)
-            rank[order] = np.arange(len(order))
-            seg = rank[inverse.reshape(-1)]
-            first_rows = jnp.asarray(first[order].astype(np.int64))
-            k = len(first)
+            first_rows_keyorder = jnp.take(order, flag_idx)
+            rank_order = jnp.argsort(first_rows_keyorder)
+            rank = jnp.zeros(k, jnp.int64).at[rank_order].set(
+                jnp.arange(k, dtype=jnp.int64)
+            )
+            seg_j = jnp.take(rank, seg_rows)
+            first_rows = jnp.sort(first_rows_keyorder)
             for c in by:
                 out_cols[c] = self._cols[c].take(first_rows)
         elif by:  # zero rows with keys: no groups at all
@@ -355,9 +507,8 @@ class TpuTable(Table):
                 self._to_local().group(by, aggregations, header, parameters)
             )
         else:  # global aggregation: one group, even over zero rows
-            seg = np.zeros(n, dtype=np.int64)
+            seg_j = jnp.zeros(n, dtype=jnp.int64)
             k = 1
-        seg_j = jnp.asarray(seg)
 
         ev = TpuEvaluator(self, header, parameters)
         for out_col, agg in aggregations:
@@ -463,3 +614,17 @@ class TpuTable(Table):
 
     def __repr__(self) -> str:
         return f"TpuTable({self._nrows} rows, cols={self.physical_columns})"
+
+
+def _float_as_exact_int(c: Column) -> Column:
+    """An F64 key column recast for EXACT equality against int64 keys:
+    rows where the float is integral and inside the int64 range become that
+    integer; all other rows (fractional, NaN, inf, out of range) become
+    invalid and so never match."""
+    f = c.data
+    integral = (
+        (f == jnp.floor(f)) & (f >= -(2.0**63)) & (f < 2.0**63) & ~jnp.isnan(f)
+    )
+    data = jnp.where(integral, f, 0.0).astype(jnp.int64)
+    valid = c.valid_mask() & integral
+    return Column(I64, data, valid)
