@@ -302,6 +302,13 @@ def _remap(c: Column, merged: List[str]) -> Column:
     return Column(STR, jnp.asarray(new_codes.astype(np.int32)), c.valid, merged)
 
 
+def mask_to_idx(mask) -> Tuple[Any, int]:
+    """Boolean device mask -> (index array, count) with ONE scalar sync —
+    the shared compaction idiom of the table ops and the fused expand path."""
+    count = int(mask.sum())
+    return jnp.nonzero(mask, size=count)[0], count
+
+
 def constant_column(value: Any, n: int) -> Column:
     if value is None:
         return Column(I64, jnp.zeros(n, jnp.int64), jnp.zeros(n, bool))

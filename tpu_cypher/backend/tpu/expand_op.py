@@ -1,0 +1,372 @@
+"""Fused CSR expand operators: the TPU-native physical Expand/ExpandInto.
+
+The reference plans every ``Expand`` as relationship-scan + 2 hash joins and
+``ExpandInto`` as a 2-key join (``RelationalPlanner.scala:130-189``); on
+Spark/Flink those joins ride the engines' shuffle. Here the physical planner
+swaps in these operators when the backend is CSR-capable: one fused
+repeat+gather over the HBM-resident CSR per hop (``GraphIndex``), with the
+classic join cascade kept as a same-header shadow plan for graphs that
+cannot be indexed (dangling endpoints, duplicate ids).
+
+Semantics are bag-identical to the classic cascade by construction:
+
+* multiplicity: one output row per (input row, matching edge) — exactly the
+  rel-scan join; the far-end node-scan join becomes a compact-id row-map
+  gather (``row_map`` = -1 filters nodes lacking the target labels);
+* undirected expands mirror the classic scan ∪ swapped-scan union: a
+  primary CSR half (loops included) plus the opposite-orientation half with
+  self-loops excluded and Start/End reported swapped;
+* headers: the operator REUSES the classic plan's RecordHeader, so every
+  downstream operator sees identical columns either way.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax.numpy as jnp
+
+from ...ir import expr as E
+from ...relational.header import RecordHeader
+from ...relational.ops import RelationalOperator
+from .column import Column, TpuBackendError, mask_to_idx as _mask_to_idx
+from .graph_index import CANON_NODE, CANON_REL, GraphIndex, GraphIndexError, rekey_element_expr
+
+
+def _owner_name(e: E.Expr) -> Optional[str]:
+    if isinstance(e, E.Var):
+        return e.name
+    inner = getattr(e, "expr", None)
+    if isinstance(inner, E.Var):
+        return inner.name
+    return None
+
+
+def _exclusive_cumsum(x):
+    return jnp.concatenate([jnp.zeros(1, x.dtype), jnp.cumsum(x)])[:-1]
+
+
+class _FusedExpandBase(RelationalOperator):
+    """Shared machinery: header delegation + fallback + column assembly."""
+
+    def __init__(
+        self, in_plan: RelationalOperator, classic: RelationalOperator, graph_obj
+    ):
+        super().__init__(in_plan, classic)
+        self._graph_obj = graph_obj
+
+    def _compute_header(self) -> RecordHeader:
+        return self.children[1].header
+
+    @property
+    def graph(self):
+        return self._graph_obj
+
+    def _compute_table(self):
+        try:
+            return self._fused_table()
+        except (GraphIndexError, TpuBackendError):
+            # shadow plan: identical header, so identical columns
+            return self.children[1].table
+
+    # -- column assembly ---------------------------------------------------
+
+    def _assemble(
+        self,
+        gi: GraphIndex,
+        row,
+        orig,
+        swapped,
+        far_rows,
+        far_labels: Tuple[str, ...],
+        rel_var: str,
+        far_var: Optional[str],
+        n_out: int,
+    ):
+        """Gather every output column for the fused result.
+
+        ``row``: input-row index per output row; ``orig``: canonical
+        rel-scan row per output row; ``swapped``: bool array (or None) —
+        report Start/End swapped for those rows; ``far_rows``: row in the
+        far-end canonical node scan (only when ``far_var`` is set)."""
+        from .table import TpuTable
+
+        ctx = self.context
+        in_op = self.children[0]
+        in_t = in_op.table
+        rel_cols, rel_header = gi.rel_scan(self.types_key, ctx)
+        if far_var is not None:
+            node_cols, node_header, _ = gi.node_scan(far_labels, ctx)
+        header = self.header
+        canon_rel = E.Var(CANON_REL)
+        canon_node = E.Var(CANON_NODE)
+        out: Dict[str, Column] = {}
+        for e in header.expressions:
+            col = header.column(e)
+            if col in out:
+                continue
+            if e in in_op.header:
+                out[col] = in_t._cols[in_op.header.column(e)].take(row)
+                continue
+            owner = _owner_name(e)
+            if owner == rel_var:
+                key = rekey_element_expr(e, canon_rel)
+                if swapped is not None and isinstance(e, (E.StartNode, E.EndNode)):
+                    flipped = (
+                        E.EndNode(canon_rel)
+                        if isinstance(e, E.StartNode)
+                        else E.StartNode(canon_rel)
+                    )
+                    a = rel_cols[rel_header.column(key)].take(orig)
+                    b = rel_cols[rel_header.column(flipped)].take(orig)
+                    data = jnp.where(swapped, b.data, a.data)
+                    valid = None
+                    if a.valid is not None or b.valid is not None:
+                        valid = jnp.where(swapped, b.valid_mask(), a.valid_mask())
+                    out[col] = Column(a.kind, data, valid, a.vocab)
+                    continue
+                if key is None or key not in rel_header:
+                    raise GraphIndexError(f"unmapped rel expr {e!r}")
+                out[col] = rel_cols[rel_header.column(key)].take(orig)
+                continue
+            if far_var is not None and owner == far_var:
+                key = rekey_element_expr(e, canon_node)
+                if key is None or key not in node_header:
+                    raise GraphIndexError(f"unmapped node expr {e!r}")
+                out[col] = node_cols[node_header.column(key)].take(far_rows)
+                continue
+            raise GraphIndexError(f"unmapped expr {e!r}")
+        return TpuTable(out, n_out)
+
+
+class CsrExpandOp(_FusedExpandBase):
+    """Fused (frontier)-[rel]->(far) expansion over the graph CSR.
+
+    Replaces the scan+2-joins cascade: frontier element ids map to compact
+    ids (one searchsorted), per-row degrees come from ``row_ptr``, and the
+    output is materialized with fixed-size repeat+gather — O(output) work,
+    no per-hop sorting (the CSR was sorted once at index build)."""
+
+    def __init__(
+        self,
+        in_plan: RelationalOperator,
+        classic: RelationalOperator,
+        graph_obj,
+        *,
+        frontier_fld: str,
+        rel_fld: str,
+        far_fld: str,
+        types_key: Tuple[str, ...],
+        undirected: bool,
+        backwards: bool,
+        far_labels: Tuple[str, ...],
+    ):
+        super().__init__(in_plan, classic, graph_obj)
+        self.frontier_fld = frontier_fld
+        self.rel_fld = rel_fld
+        self.far_fld = far_fld
+        self.types_key = types_key
+        self.undirected = undirected
+        self.backwards = backwards
+        self.far_labels = far_labels
+
+    def _show_inner(self) -> str:
+        arrow = "-" if self.undirected else ("<-" if self.backwards else "->")
+        t = "|".join(self.types_key) or "*"
+        return f"({self.frontier_fld}){arrow}[{self.rel_fld}:{t}]({self.far_fld})"
+
+    def _expand_half(self, gi: GraphIndex, pos, present, reverse: bool, drop_loops: bool):
+        ctx = self.context
+        rp, ci, eo = gi.csr(self.types_key, reverse, ctx)
+        deg = (jnp.take(rp, pos + 1) - jnp.take(rp, pos)).astype(jnp.int64)
+        deg = jnp.where(present, deg, 0)
+        total = int(deg.sum())
+        nrows = int(pos.shape[0])
+        row = jnp.repeat(
+            jnp.arange(nrows, dtype=jnp.int64), deg, total_repeat_length=total
+        )
+        base = jnp.take(rp, pos).astype(jnp.int64) - _exclusive_cumsum(deg)
+        edge = jnp.repeat(base, deg, total_repeat_length=total) + jnp.arange(
+            total, dtype=jnp.int64
+        )
+        nbr = jnp.take(ci, edge).astype(jnp.int64)
+        orig = jnp.take(eo, edge)
+        if drop_loops and total:
+            keep = nbr != jnp.take(pos, row)
+            idx, _ = _mask_to_idx(keep)
+            row, nbr, orig = row[idx], nbr[idx], orig[idx]
+        return row, nbr, orig
+
+    def _fused_table(self):
+        in_op = self.children[0]
+        in_t = in_op.table
+        gi = GraphIndex.of(self.graph)
+        ctx = self.context
+        frontier_var = in_op.header.var(self.frontier_fld)
+        id_col = in_t._cols[in_op.header.column(in_op.header.id_expr(frontier_var))]
+        pos, present = gi.compact_of(id_col, ctx)
+        primary_reverse = self.backwards
+        row, nbr, orig = self._expand_half(
+            gi, pos, present, reverse=primary_reverse, drop_loops=False
+        )
+        swapped = None
+        if self.undirected:
+            row2, nbr2, orig2 = self._expand_half(
+                gi, pos, present, reverse=not primary_reverse, drop_loops=True
+            )
+            swapped = jnp.concatenate(
+                [jnp.zeros(row.shape[0], bool), jnp.ones(row2.shape[0], bool)]
+            )
+            row = jnp.concatenate([row, row2])
+            nbr = jnp.concatenate([nbr, nbr2])
+            orig = jnp.concatenate([orig, orig2])
+        # far-end label filter + node-table row lookup in one gather
+        _, _, row_map = gi.node_scan(self.far_labels, ctx)
+        far_rows = jnp.take(row_map, nbr) if gi.num_nodes else jnp.zeros(0, jnp.int64)
+        keep = far_rows >= 0
+        idx, n_out = _mask_to_idx(keep)
+        row, orig, far_rows = row[idx], orig[idx], far_rows[idx]
+        if swapped is not None:
+            swapped = swapped[idx]
+        return self._assemble(
+            gi, row, orig, swapped, far_rows, self.far_labels,
+            self.rel_fld, self.far_fld, n_out,
+        )
+
+
+class CsrExpandIntoOp(_FusedExpandBase):
+    """Fused ExpandInto: both endpoints bound; the closing relationships are
+    found by binary search over the sorted (src*N + dst) edge keys — the
+    engine-integrated version of the ``triangle_count`` kernel probe."""
+
+    def __init__(
+        self,
+        in_plan: RelationalOperator,
+        classic: RelationalOperator,
+        graph_obj,
+        *,
+        source_fld: str,
+        rel_fld: str,
+        target_fld: str,
+        types_key: Tuple[str, ...],
+        undirected: bool,
+    ):
+        super().__init__(in_plan, classic, graph_obj)
+        self.source_fld = source_fld
+        self.rel_fld = rel_fld
+        self.target_fld = target_fld
+        self.types_key = types_key
+        self.undirected = undirected
+
+    def _show_inner(self) -> str:
+        arrow = "-" if self.undirected else "->"
+        t = "|".join(self.types_key) or "*"
+        return f"({self.source_fld})-[{self.rel_fld}:{t}]{arrow}({self.target_fld}) into"
+
+    def _probe(self, gi: GraphIndex, keys, s_pos, t_pos, ok, drop_loops: bool):
+        ctx = self.context
+        _, _, eo = gi.csr(self.types_key, False, ctx)
+        n = gi.num_nodes
+        probe = s_pos * n + t_pos
+        if drop_loops:
+            ok = ok & (s_pos != t_pos)
+        lo = jnp.searchsorted(keys, probe, side="left")
+        hi = jnp.searchsorted(keys, probe, side="right")
+        counts = jnp.where(ok, hi - lo, 0).astype(jnp.int64)
+        total = int(counts.sum())
+        nrows = int(s_pos.shape[0])
+        row = jnp.repeat(
+            jnp.arange(nrows, dtype=jnp.int64), counts, total_repeat_length=total
+        )
+        base = lo.astype(jnp.int64) - _exclusive_cumsum(counts)
+        edge = jnp.repeat(base, counts, total_repeat_length=total) + jnp.arange(
+            total, dtype=jnp.int64
+        )
+        orig = jnp.take(eo, edge)
+        return row, orig
+
+    def _fused_table(self):
+        in_op = self.children[0]
+        in_t = in_op.table
+        gi = GraphIndex.of(self.graph)
+        ctx = self.context
+        h = in_op.header
+        s_col = in_t._cols[h.column(h.id_expr(h.var(self.source_fld)))]
+        t_col = in_t._cols[h.column(h.id_expr(h.var(self.target_fld)))]
+        s_pos, s_ok = gi.compact_of(s_col, ctx)
+        t_pos, t_ok = gi.compact_of(t_col, ctx)
+        ok = s_ok & t_ok
+        keys = gi.edge_keys(self.types_key, ctx)
+        row, orig = self._probe(gi, keys, s_pos, t_pos, ok, drop_loops=False)
+        swapped = None
+        if self.undirected:
+            row2, orig2 = self._probe(gi, keys, t_pos, s_pos, ok, drop_loops=True)
+            swapped = jnp.concatenate(
+                [jnp.zeros(row.shape[0], bool), jnp.ones(row2.shape[0], bool)]
+            )
+            row = jnp.concatenate([row, row2])
+            orig = jnp.concatenate([orig, orig2])
+        return self._assemble(
+            gi, row, orig, swapped, None, (), self.rel_fld, None,
+            int(row.shape[0]),
+        )
+
+
+# ---------------------------------------------------------------------------
+# Planner hooks (installed via TpuTable.plan_expand_fastpath/_into)
+# ---------------------------------------------------------------------------
+
+
+def plan_expand_fastpath(planner, op, lhs, rhs, classic) -> Optional[RelationalOperator]:
+    """Swap the classic Expand cascade for ``CsrExpandOp`` when statically
+    safe; return None to keep the classic plan."""
+    from ...logical import ops as L
+
+    if op.direction not in (">", "-"):
+        return None
+    lhs_vars = {v.name for v in lhs.header.vars}
+    if op.rel in lhs_vars:
+        return None  # re-bound rel var: keep the generic join semantics
+    backwards = op.source not in lhs_vars
+    frontier = op.target if backwards else op.source
+    far = op.source if backwards else op.target
+    if frontier not in lhs_vars or far in lhs_vars:
+        return None
+    if {v.name for v in rhs.header.vars} != {far}:
+        return None
+    if not isinstance(op.rhs, L.NodeScan):
+        return None  # far side must be a plain node scan (label filter only)
+    m = op.rhs.node_type.material
+    far_labels = tuple(sorted(getattr(m, "labels", ()) or ()))
+    types = getattr(op.rel_type.material, "types", frozenset()) or frozenset()
+    return CsrExpandOp(
+        lhs,
+        classic,
+        rhs.graph,
+        frontier_fld=frontier,
+        rel_fld=op.rel,
+        far_fld=far,
+        types_key=GraphIndex.types_key(types),
+        undirected=op.direction == "-",
+        backwards=backwards,
+        far_labels=far_labels,
+    )
+
+
+def plan_expand_into_fastpath(planner, op, in_plan, classic) -> Optional[RelationalOperator]:
+    if op.direction not in (">", "-"):
+        return None
+    in_vars = {v.name for v in in_plan.header.vars}
+    if op.rel in in_vars or op.source not in in_vars or op.target not in in_vars:
+        return None
+    types = getattr(op.rel_type.material, "types", frozenset()) or frozenset()
+    return CsrExpandIntoOp(
+        in_plan,
+        classic,
+        in_plan.graph,
+        source_fld=op.source,
+        rel_fld=op.rel,
+        target_fld=op.target,
+        types_key=GraphIndex.types_key(types),
+        undirected=op.direction == "-",
+    )

@@ -1,0 +1,220 @@
+"""Per-graph device-resident CSR index for the fused expand path.
+
+The reference executes every ``Expand`` as relationship-scan + 2 hash joins
+on the engine's shuffle machinery (``RelationalPlanner.scala:130-165``).
+The TPU-native replacement keeps a compacted CSR of each relationship-type
+set resident in HBM, built ONCE per graph and reused by every query
+(``GraphIndex.of(graph)`` hangs the cache off the graph object, the analog
+of the engines' cached/partitioned relationship tables):
+
+* ``node_ids``  — sorted unique int64 element ids; position = compact id
+* per (types, orientation): ``row_ptr``/``col_idx`` int32 CSR plus
+  ``edge_orig`` mapping CSR edge position -> row of the canonical
+  relationship scan (so any rel property is one gather away)
+* per label set: the canonical node scan plus ``row_map`` taking a compact
+  id to its row in that scan (-1 = node lacks the labels — the fused label
+  filter)
+* per types: sorted ``edge_keys`` (src*N + dst) for ExpandInto probes
+
+Scans are cached under canonical variable names; operators re-key their
+header expressions onto the canonical var (structural equality ignores
+types), so one cache serves every query variable name.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from ...api import types as T
+from ...ir import expr as E
+from .column import Column, TpuBackendError
+
+# canonical scan variable names (reserved: queries cannot produce '$' vars)
+CANON_NODE = "$gi_n"
+CANON_REL = "$gi_r"
+
+
+class GraphIndexError(TpuBackendError):
+    """The graph cannot be CSR-indexed (e.g. dangling endpoints)."""
+
+
+def rekey_element_expr(e: E.Expr, canon: E.Var) -> Optional[E.Expr]:
+    """Rebuild an element sub-expression onto the canonical scan variable.
+
+    Header expressions for a var v are Var/Id/StartNode/EndNode/HasLabel/
+    HasType/Property over v; structural equality ignores the attached type,
+    so the rebuilt expr indexes the canonical scan's header directly."""
+    if isinstance(e, E.Var):
+        return canon
+    if isinstance(e, E.Id):
+        return E.Id(canon)
+    if isinstance(e, E.StartNode):
+        return E.StartNode(canon)
+    if isinstance(e, E.EndNode):
+        return E.EndNode(canon)
+    if isinstance(e, E.HasLabel):
+        return E.HasLabel(canon, e.label)
+    if isinstance(e, E.HasType):
+        return E.HasType(canon, e.rel_type)
+    if isinstance(e, E.Property):
+        return E.Property(canon, e.key)
+    return None
+
+
+class GraphIndex:
+    """CSR + canonical-scan cache for one RelationalCypherGraph."""
+
+    @staticmethod
+    def of(graph) -> "GraphIndex":
+        gi = getattr(graph, "_tpu_graph_index", None)
+        if gi is None:
+            gi = GraphIndex(graph)
+            try:
+                graph._tpu_graph_index = gi
+            except AttributeError:  # exotic graph impl without __dict__
+                pass
+        return gi
+
+    def __init__(self, graph):
+        self.graph = graph
+        self._node_ids: Optional[Tuple[Any, np.ndarray]] = None
+        # labels_key -> (cols, header, row_map)
+        self._node_scans: Dict[Tuple[str, ...], Tuple[Dict, Any, Any]] = {}
+        # types_key -> (cols, header)
+        self._rel_scans: Dict[Tuple[str, ...], Tuple[Dict, Any]] = {}
+        # (types_key, reverse) -> (row_ptr, col_idx, edge_orig) device arrays
+        self._csr: Dict[Tuple[Tuple[str, ...], bool], Tuple[Any, Any, Any]] = {}
+        # types_key -> sorted edge keys (src*N + dst), device int64
+        self._edge_keys: Dict[Tuple[str, ...], Any] = {}
+
+    # -- nodes -------------------------------------------------------------
+
+    def node_ids(self, ctx) -> Tuple[Any, np.ndarray]:
+        """(device sorted unique int64 ids, host copy)."""
+        if self._node_ids is None:
+            self.node_scan((), ctx)
+        return self._node_ids
+
+    @property
+    def num_nodes(self) -> int:
+        if self._node_ids is None:
+            raise GraphIndexError("node ids not built yet")
+        return int(self._node_ids[1].shape[0])
+
+    def node_scan(self, labels: Tuple[str, ...], ctx):
+        """Canonical node scan for a label set: (columns, header, row_map).
+
+        ``row_map[compact_id]`` = row index into the scan's columns, or -1
+        when the node does not carry the labels (fused label filtering)."""
+        key = tuple(sorted(labels))
+        got = self._node_scans.get(key)
+        if got is not None:
+            return got
+        op = self.graph.scan_operator(
+            CANON_NODE, T.CTNodeType(frozenset(labels)), ctx
+        )
+        table = op.table
+        header = op.header
+        id_col = table._cols[header.column(E.Id(E.Var(CANON_NODE)))]
+        ids_np = np.asarray(id_col.data, dtype=np.int64)
+        if self._node_ids is None:
+            if key != ():
+                # the unrestricted scan defines the compact id space
+                self.node_scan((), ctx)
+            else:
+                sorted_ids = np.sort(ids_np)
+                if len(sorted_ids) and (sorted_ids[1:] == sorted_ids[:-1]).any():
+                    raise GraphIndexError("duplicate node ids")
+                self._node_ids = (jnp.asarray(sorted_ids), sorted_ids)
+        _, all_ids = self._node_ids
+        n = len(all_ids)
+        pos = np.searchsorted(all_ids, ids_np)
+        pos = np.clip(pos, 0, max(n - 1, 0))
+        if len(ids_np) and not (all_ids[pos] == ids_np).all():
+            raise GraphIndexError("node scan id outside the graph id space")
+        row_map = np.full(n, -1, dtype=np.int64)
+        row_map[pos] = np.arange(len(ids_np), dtype=np.int64)
+        out = (table._cols, header, jnp.asarray(row_map))
+        self._node_scans[key] = out
+        return out
+
+    # -- relationships -----------------------------------------------------
+
+    @staticmethod
+    def types_key(types) -> Tuple[str, ...]:
+        return tuple(sorted(types)) if types else ()
+
+    def rel_scan(self, types_key: Tuple[str, ...], ctx):
+        """Canonical relationship scan: (columns, header)."""
+        got = self._rel_scans.get(types_key)
+        if got is not None:
+            return got
+        op = self.graph.scan_operator(
+            CANON_REL, T.CTRelationshipType(frozenset(types_key)), ctx
+        )
+        out = (op.table._cols, op.header)
+        self._rel_scans[types_key] = out
+        return out
+
+    def csr(self, types_key: Tuple[str, ...], reverse: bool, ctx):
+        """(row_ptr, col_idx, edge_orig) int32/int32/int64 device arrays for
+        one orientation of one relationship-type set."""
+        got = self._csr.get((types_key, reverse))
+        if got is not None:
+            return got
+        cols, header = self.rel_scan(types_key, ctx)
+        rel = E.Var(CANON_REL)
+        start = cols[header.column(E.StartNode(rel))]
+        end = cols[header.column(E.EndNode(rel))]
+        _, all_ids = self.node_ids(ctx)
+        n = len(all_ids)
+        s_ids = np.asarray(start.data, dtype=np.int64)
+        d_ids = np.asarray(end.data, dtype=np.int64)
+        s = np.searchsorted(all_ids, s_ids).astype(np.int64)
+        d = np.searchsorted(all_ids, d_ids).astype(np.int64)
+        s = np.clip(s, 0, max(n - 1, 0))
+        d = np.clip(d, 0, max(n - 1, 0))
+        if len(s_ids) and (
+            not (all_ids[s] == s_ids).all() or not (all_ids[d] == d_ids).all()
+        ):
+            raise GraphIndexError("relationship endpoint not a graph node")
+        a, b = (d, s) if reverse else (s, d)
+        order = np.lexsort((b, a))
+        a_sorted = a[order]
+        row_ptr = np.searchsorted(a_sorted, np.arange(n + 1)).astype(np.int32)
+        out = (
+            jnp.asarray(row_ptr),
+            jnp.asarray(b[order].astype(np.int32)),
+            jnp.asarray(order.astype(np.int64)),
+        )
+        self._csr[(types_key, reverse)] = out
+        if not reverse and types_key not in self._edge_keys:
+            # forward CSR order is lexsorted by (src, dst) => keys sorted
+            keys = a_sorted.astype(np.int64) * n + b[order].astype(np.int64)
+            self._edge_keys[types_key] = jnp.asarray(keys)
+        return out
+
+    def edge_keys(self, types_key: Tuple[str, ...], ctx):
+        """Sorted (src*N + dst) int64 device keys for ExpandInto probes."""
+        if types_key not in self._edge_keys:
+            self.csr(types_key, False, ctx)
+        return self._edge_keys[types_key]
+
+    # -- id -> compact mapping --------------------------------------------
+
+    def compact_of(self, id_col: Column, ctx) -> Tuple[Any, Any]:
+        """Map an int64 element-id column to (compact ids, present mask)."""
+        dev_ids, _ = self.node_ids(ctx)
+        n = self.num_nodes
+        ids = id_col.data
+        valid = id_col.valid_mask()
+        if n == 0:
+            z = jnp.zeros(ids.shape[0], jnp.int64)
+            return z, jnp.zeros(ids.shape[0], bool)
+        pos = jnp.clip(jnp.searchsorted(dev_ids, ids), 0, n - 1)
+        present = valid & (jnp.take(dev_ids, pos) == ids)
+        return pos.astype(jnp.int64), present

@@ -42,7 +42,17 @@ import jax.numpy as jnp
 from ...api import types as T
 from ...api.table import Table
 from ...api.types import CypherType
-from .column import BOOL, F64, I64, OBJ, STR, Column, TpuBackendError, constant_column
+from .column import (
+    BOOL,
+    F64,
+    I64,
+    OBJ,
+    STR,
+    Column,
+    TpuBackendError,
+    constant_column,
+    mask_to_idx,
+)
 from .compiler import TpuEvaluator, TpuUnsupportedExpr
 
 
@@ -155,11 +165,7 @@ class TpuTable(Table):
 
     # -- device compaction helper -----------------------------------------
 
-    @staticmethod
-    def _mask_to_idx(mask) -> Tuple[Any, int]:
-        """Boolean device mask -> (index array, count) with ONE scalar sync."""
-        count = int(mask.sum())
-        return jnp.nonzero(mask, size=count)[0], count
+    _mask_to_idx = staticmethod(mask_to_idx)
 
     # -- filter ------------------------------------------------------------
 
@@ -200,9 +206,9 @@ class TpuTable(Table):
                 return self._from_local(lt)
             lt = self._to_local().join(other._to_local(), kind, join_cols)
             return self._from_local(lt)
-        return self._join_device(other, kind, join_cols, swap_sides)
+        return self._join_device(other, kind, join_cols)
 
-    def _join_device(self, other, kind, join_cols, swap_sides=False) -> "TpuTable":
+    def _join_device(self, other, kind, join_cols) -> "TpuTable":
         """Device sort-probe equi-join (the TPU analog of the engines'
         shuffled hash join, ``SparkTable.scala:178``): the build (right) side
         is lexsorted valid-first-by-key once, the probe side binary-searches
@@ -288,6 +294,11 @@ class TpuTable(Table):
                 eq = lv == rv
                 if lc.kind == F64:
                     eq = eq & ~jnp.isnan(lv)
+                # recast mixed-kind keys carry match-eligibility in their
+                # validity mask (fractional/NaN floats -> invalid, data 0);
+                # without this AND they would spuriously equal integer 0
+                eq = eq & jnp.take(lc.valid_mask(), left_rows)
+                eq = eq & jnp.take(rc.valid_mask(), right_rows)
                 keep = keep & eq
             idx, total = self._mask_to_idx(keep)
             left_rows = left_rows[idx]
@@ -614,6 +625,20 @@ class TpuTable(Table):
 
     def __repr__(self) -> str:
         return f"TpuTable({self._nrows} rows, cols={self.physical_columns})"
+
+    # -- planner capability hooks (fused CSR expand path) -------------------
+
+    @staticmethod
+    def plan_expand_fastpath(planner, op, lhs, rhs, classic):
+        from .expand_op import plan_expand_fastpath
+
+        return plan_expand_fastpath(planner, op, lhs, rhs, classic)
+
+    @staticmethod
+    def plan_expand_into_fastpath(planner, op, in_plan, classic):
+        from .expand_op import plan_expand_into_fastpath
+
+        return plan_expand_into_fastpath(planner, op, in_plan, classic)
 
 
 def _float_as_exact_int(c: Column) -> Column:

@@ -283,7 +283,18 @@ class RelationalPlanner:
         )
 
     def _plan_Expand(self, op: L.Expand) -> RelationalOperator:
-        """Reference ``RelationalPlanner.scala:130-165``: rel scan + 2 joins."""
+        """Reference ``RelationalPlanner.scala:130-165``: rel scan + 2 joins —
+        swapped for a fused CSR expand when the backend offers one (the
+        classic cascade stays attached as the same-header shadow plan)."""
+        classic = self._plan_expand_classic(op)
+        fast = getattr(self.ctx.table_cls, "plan_expand_fastpath", None)
+        if fast is not None:
+            out = fast(self, op, self.process(op.lhs), self.process(op.rhs), classic)
+            if out is not None:
+                return out
+        return classic
+
+    def _plan_expand_classic(self, op: L.Expand) -> RelationalOperator:
         lhs = self.process(op.lhs)
         rhs = self.process(op.rhs)
         graph = rhs.graph
@@ -314,7 +325,16 @@ class RelationalPlanner:
 
     def _plan_ExpandInto(self, op: L.ExpandInto) -> RelationalOperator:
         """Reference ``RelationalPlanner.scala:167-189``: single join on both
-        endpoints."""
+        endpoints — or the fused CSR edge-key probe when available."""
+        classic = self._plan_expand_into_classic(op)
+        fast = getattr(self.ctx.table_cls, "plan_expand_into_fastpath", None)
+        if fast is not None:
+            out = fast(self, op, self.process(op.in_op), classic)
+            if out is not None:
+                return out
+        return classic
+
+    def _plan_expand_into_classic(self, op: L.ExpandInto) -> RelationalOperator:
         in_plan = self.process(op.in_op)
         graph = in_plan.graph
         rel_scan = self._rel_scan(graph, op.rel, op.rel_type, op.direction)
