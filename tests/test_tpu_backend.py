@@ -68,6 +68,11 @@ QUERIES = [
     "MATCH (a)-[x]->(b)-[y]->(c) WHERE a.name = 'Alice' RETURN b.name, c.name",
     "MATCH (a:Person)-[k1:KNOWS]-(b)-[k2:KNOWS]-(c) RETURN count(*) AS z",
     "MATCH (a)-[:KNOWS]->(b), (b)-[:KNOWS]->(c), (a)-[:KNOWS]->(c) RETURN a.name, b.name, c.name",
+    # keyless outer join (uncorrelated OPTIONAL MATCH) + distinct-on-element
+    "MATCH (b:Book) OPTIONAL MATCH (p:Person {name:'Nobody'}) RETURN b.title, p.name",
+    "MATCH (b:Book) OPTIONAL MATCH (p:Person) RETURN b.title, count(p) AS n",
+    "MATCH (a:Person)-[:KNOWS]->(b)-[:KNOWS]->(c) WITH DISTINCT a, c RETURN count(*) AS pairs",
+    "MATCH (a:Person) OPTIONAL MATCH (x:Nope) WITH DISTINCT a RETURN count(a) AS n",
 ]
 
 

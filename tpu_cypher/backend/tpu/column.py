@@ -92,6 +92,21 @@ class Column:
             arr[i] = v
         return Column(OBJ, arr, None)
 
+    @staticmethod
+    def from_numpy(arr: np.ndarray, valid: Optional[np.ndarray] = None) -> "Column":
+        """Zero-copy-ish bulk construction from a numpy array (the IO/bench
+        fast path — ``from_values`` walks Python objects, O(n) interpreter
+        work; this is one H2D transfer)."""
+        arr = np.asarray(arr)
+        v = jnp.asarray(valid) if valid is not None else None
+        if arr.dtype == np.bool_:
+            return Column(BOOL, jnp.asarray(arr), v)
+        if np.issubdtype(arr.dtype, np.integer):
+            return Column(I64, jnp.asarray(arr.astype(np.int64)), v)
+        if np.issubdtype(arr.dtype, np.floating):
+            return Column(F64, jnp.asarray(arr.astype(np.float64)), v)
+        raise TpuBackendError(f"from_numpy: unsupported dtype {arr.dtype}")
+
     def to_values(self, row_mask: Optional[np.ndarray] = None) -> List[Any]:
         """Decode to Python values (respecting validity)."""
         if self.kind == OBJ:

@@ -56,7 +56,13 @@ class _FusedExpandBase(RelationalOperator):
         self._graph_obj = graph_obj
 
     def _compute_header(self) -> RecordHeader:
-        return self.children[1].header
+        full = self.children[1].header
+        req = getattr(self, "required_exprs", None)
+        if req is None:
+            return full
+        # column pruning (relational/prune.py): emit only mentioned exprs
+        m = {e: full.column(e) for e in full.expressions if e in req}
+        return RecordHeader(m, full.paths)
 
     @property
     def graph(self):
@@ -225,9 +231,10 @@ class CsrExpandOp(_FusedExpandBase):
         far_rows = jnp.take(row_map, nbr) if gi.num_nodes else jnp.zeros(0, jnp.int64)
         keep = far_rows >= 0
         idx, n_out = _mask_to_idx(keep)
-        row, orig, far_rows = row[idx], orig[idx], far_rows[idx]
-        if swapped is not None:
-            swapped = swapped[idx]
+        if n_out != int(row.shape[0]):  # skip the no-op gather when all match
+            row, orig, far_rows = row[idx], orig[idx], far_rows[idx]
+            if swapped is not None:
+                swapped = swapped[idx]
         return self._assemble(
             gi, row, orig, swapped, far_rows, self.far_labels,
             self.rel_fld, self.far_fld, n_out,

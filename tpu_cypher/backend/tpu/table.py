@@ -75,6 +75,11 @@ class TpuTable(Table):
         return TpuTable.from_columns(cols)
 
     @staticmethod
+    def from_numpy(cols: Dict[str, Any]) -> "TpuTable":
+        """Bulk construction from numpy arrays (one H2D copy per column)."""
+        return TpuTable({c: Column.from_numpy(v) for c, v in cols.items()})
+
+    @staticmethod
     def empty(columns: Sequence[str] = ()) -> "TpuTable":
         return TpuTable(
             {c: Column(I64, jnp.zeros(0, jnp.int64), None) for c in columns}, 0
@@ -185,6 +190,16 @@ class TpuTable(Table):
             li = jnp.repeat(jnp.arange(n), m)
             ri = jnp.tile(jnp.arange(m), n)
             return self._combine(other, li, ri)
+        if not join_cols:
+            # keyless equi-join (uncorrelated OPTIONAL MATCH and friends):
+            # every row matches every row; outer kinds pad when a side is empty
+            if kind == "inner" or (self._nrows and other._nrows):
+                return self.join(other, "cross", [])
+            if kind == "left_outer":
+                return self._join_empty_result(other, "left_outer")
+            if kind == "right_outer" and other._nrows == 0:
+                return self.join(other, "cross", [])
+            return self._join_empty_result(other, "full_outer")
         if kind == "right_outer":
             # mirror of left_outer; the flipped _combine emits right-table
             # columns first, so restore canonical (left-first) column order

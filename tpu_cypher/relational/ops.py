@@ -308,6 +308,15 @@ class DistinctOp(RelationalOperator):
         cols: List[str] = []
         for f in self.fields:
             v = h.var(f)
+            m = v.cypher_type.material if v.cypher_type is not None else None
+            if isinstance(m, (T.CTNodeType, T.CTRelationshipType)) and not h.has_path(f):
+                # an element's id determines its labels/type/properties —
+                # distinct on the id column alone (the reference relies on
+                # the engines' optimizers for the same reduction)
+                c = h.column(h.id_expr(v))
+                if c not in cols:
+                    cols.append(c)
+                continue
             for e in h.expressions_for(v):
                 c = h.column(e)
                 if c not in cols:

@@ -326,5 +326,9 @@ class CypherSession:
         relational = time_stage(
             "relational", plan_relational, logical, rctx, driving_table, driving_header
         )
+        if getattr(self.table_cls, "plan_expand_fastpath", None) is not None:
+            from .prune import prune_fused_columns
+
+            relational = time_stage("prune", prune_fused_columns, relational)
         returns = getattr(ir, "returns", None)
         return CypherResult(self, logical, relational, returns)
