@@ -15,9 +15,11 @@ from tpu_cypher import CypherSession
 from tpu_cypher.testing.bag import Bag
 
 
-@pytest.fixture(scope="module")
-def session():
-    return CypherSession.local()
+@pytest.fixture(scope="module", params=["local", "tpu"])
+def session(request):
+    """Both backends run the identical behavioral spec, like the reference's
+    per-backend suites (morpheus-testing/ and flink-cypher-testing/)."""
+    return getattr(CypherSession, request.param)()
 
 
 def init_graph(session, create_query):

@@ -15,22 +15,32 @@ FEATURES = os.path.join(HERE, "tck", "features")
 BLACKLIST = os.path.join(HERE, "tck", "blacklist")
 
 _scenarios = ScenariosFor(load_features(FEATURES), load_blacklist(BLACKLIST))
-_runner = TckRunner(CypherSession.local)
+_runners = {
+    "local": TckRunner(CypherSession.local),
+    "tpu": TckRunner(CypherSession.tpu),
+}
+
+
+@pytest.fixture(params=["local", "tpu"])
+def runner(request):
+    """TCK conformance holds per backend, like the reference's per-backend
+    TCK modules (morpheus-tck/ and flink-cypher-tck/)."""
+    return _runners[request.param]
 
 
 @pytest.mark.parametrize(
     "scenario", _scenarios.white_list, ids=lambda s: str(s)
 )
-def test_whitelist(scenario):
-    r = _runner.run(scenario)
+def test_whitelist(scenario, runner):
+    r = runner.run(scenario)
     assert r.passed, r.message
 
 
 @pytest.mark.parametrize(
     "scenario", _scenarios.black_list, ids=lambda s: str(s)
 )
-def test_blacklist_still_fails(scenario):
-    r = _runner.run(scenario)
+def test_blacklist_still_fails(scenario, runner):
+    r = runner.run(scenario)
     assert not r.passed, (
         f"Blacklisted scenario passed (false positive) — remove it from the "
         f"blacklist: {scenario}"

@@ -45,6 +45,11 @@ class Table(ABC):
         """Iterate rows as {column: python value} (null = None)."""
         ...
 
+    def column_values(self, col: str) -> List[Any]:
+        """One column as host Python values (null = None). Backends override
+        with a columnar read; the default goes through ``rows``."""
+        return [r[col] for r in self.rows()]
+
     # -- algebra ----------------------------------------------------------
 
     @abstractmethod

@@ -72,6 +72,9 @@ class LocalTable(Table):
         for i in range(self._nrows):
             yield {c: v[i] for c, v in cols.items()}
 
+    def column_values(self, col: str) -> List[Any]:
+        return list(self._cols[col])
+
     def row_dicts(self) -> List[Dict[str, Any]]:
         # cached: tables are immutable and the evaluator asks once per expr
         cache = getattr(self, "_row_cache", None)

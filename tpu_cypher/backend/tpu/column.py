@@ -36,6 +36,15 @@ OBJ = "obj"  # host-side Python objects (lists, elements) — not device residen
 _NULL_CODE = np.int32(-1)
 
 
+def _obj_array(vals) -> np.ndarray:
+    """ALWAYS-1-D object array (np.array() on equal-length list values
+    silently builds 2-D, breaking concat and row gathers)."""
+    arr = np.empty(len(vals), dtype=object)
+    for i, v in enumerate(vals):
+        arr[i] = v
+    return arr
+
+
 class TpuBackendError(Exception):
     pass
 
@@ -47,6 +56,11 @@ class Column:
     valid: Optional[Any]  # jnp bool array or None (= all valid)
     vocab: Optional[List[str]] = None  # sorted, for STR
     _obj_type: Optional[CypherType] = None  # cached OBJ value type (metadata)
+    # F64 only: bool device array marking rows whose Cypher value is an
+    # INTEGER (mixed int/float columns are stored as f64 payloads; Cypher
+    # distinguishes 1 from 1.0 as *values* even though 1 = 1.0 compares
+    # true, so decode must restore intness). None = no integer rows.
+    int_flag: Optional[Any] = None
 
     def __len__(self) -> int:
         return int(self.data.shape[0]) if self.kind != OBJ else len(self.data)
@@ -72,7 +86,16 @@ class Column:
             data = np.array(
                 [float(v) if v is not None else 0.0 for v in values], dtype=np.float64
             )
-            return Column(F64, jnp.asarray(data), jnp.asarray(valid_np) if has_null else None)
+            iflag = np.array(
+                [isinstance(v, int) and not isinstance(v, bool) for v in values],
+                dtype=bool,
+            )
+            return Column(
+                F64,
+                jnp.asarray(data),
+                jnp.asarray(valid_np) if has_null else None,
+                int_flag=jnp.asarray(iflag) if iflag.any() else None,
+            )
         if all(isinstance(v, str) for v in non_null):
             vocab = sorted(set(non_null))
             index = {s: i for i, s in enumerate(vocab)}
@@ -87,10 +110,7 @@ class Column:
                 vocab,
             )
         # fallback: host objects
-        arr = np.empty(n, dtype=object)
-        for i, v in enumerate(values):
-            arr[i] = v
-        return Column(OBJ, arr, None)
+        return Column(OBJ, _obj_array(values), None)
 
     @staticmethod
     def from_numpy(arr: np.ndarray, valid: Optional[np.ndarray] = None) -> "Column":
@@ -120,8 +140,15 @@ class Column:
                     for i, v in enumerate(data)
                 ]
             elif self.kind == F64:
+                iflag = (
+                    np.asarray(self.int_flag) if self.int_flag is not None else None
+                )
                 vals = [
-                    float(v) if (valid is None or valid[i]) else None
+                    (
+                        (int(v) if (iflag is not None and iflag[i]) else float(v))
+                        if (valid is None or valid[i])
+                        else None
+                    )
                     for i, v in enumerate(data)
                 ]
             elif self.kind == BOOL:
@@ -151,7 +178,10 @@ class Column:
             return Column(OBJ, self.data[np.asarray(idx)], None)
         data = jnp.take(self.data, idx, axis=0)
         valid = jnp.take(self.valid, idx, axis=0) if self.valid is not None else None
-        return Column(self.kind, data, valid, self.vocab)
+        iflag = (
+            jnp.take(self.int_flag, idx, axis=0) if self.int_flag is not None else None
+        )
+        return Column(self.kind, data, valid, self.vocab, int_flag=iflag)
 
     def take_or_null(self, idx, in_bounds) -> "Column":
         """Gather; rows where ``in_bounds`` is False become null (outer joins)."""
@@ -180,7 +210,12 @@ class Column:
         valid = (
             jnp.take(self.valid, safe, axis=0) if self.valid is not None else jnp.ones(len(idx), bool)
         )
-        return Column(self.kind, data, valid & in_bounds, self.vocab)
+        iflag = (
+            jnp.take(self.int_flag, safe, axis=0) & in_bounds
+            if self.int_flag is not None
+            else None
+        )
+        return Column(self.kind, data, valid & in_bounds, self.vocab, int_flag=iflag)
 
     def concat(self, other: "Column") -> "Column":
         a, b = self, other
@@ -194,10 +229,10 @@ class Column:
             elif b.kind != OBJ and a.is_all_null():
                 a = b.null_like(len(a))
         if a.kind != b.kind:
-            # unify: promote numerics, else objects
+            # unify: promote numerics (keeping Cypher intness), else objects
             if {a.kind, b.kind} == {I64, F64}:
-                a = a.cast_f64()
-                b = b.cast_f64()
+                a = a.as_f64_keeping_intness()
+                b = b.as_f64_keeping_intness()
             else:
                 a = a.to_obj()
                 b = b.to_obj()
@@ -212,7 +247,13 @@ class Column:
             av = a.valid if a.valid is not None else jnp.ones(len(a), bool)
             bv = b.valid if b.valid is not None else jnp.ones(len(b), bool)
             valid = jnp.concatenate([av, bv])
-        return Column(a.kind, data, valid, a.vocab)
+        if a.int_flag is None and b.int_flag is None:
+            iflag = None
+        else:
+            ai = a.int_flag if a.int_flag is not None else jnp.zeros(len(a), bool)
+            bi = b.int_flag if b.int_flag is not None else jnp.zeros(len(b), bool)
+            iflag = jnp.concatenate([ai, bi])
+        return Column(a.kind, data, valid, a.vocab, int_flag=iflag)
 
     def is_all_null(self) -> bool:
         if self.kind == OBJ:
@@ -230,14 +271,34 @@ class Column:
         return Column(self.kind, data, jnp.zeros(n, bool), self.vocab)
 
     def cast_f64(self) -> "Column":
+        """Pure float cast (arithmetic contexts — intness deliberately
+        dropped: the result of float arithmetic IS a float)."""
         if self.kind == F64:
+            if self.int_flag is not None:
+                return Column(F64, self.data, self.valid)
             return self
         if self.kind == I64:
             return Column(F64, self.data.astype(jnp.float64), self.valid)
         raise TpuBackendError(f"Cannot cast {self.kind} to f64")
 
+    def as_f64_keeping_intness(self) -> "Column":
+        """Value-union contexts (UNION ALL, scan alignment): an I64 column
+        becomes f64 payloads with every valid row flagged as a Cypher
+        INTEGER, so decode restores 1 (not 1.0). Precision caveat: mixed
+        columns join/compare on f64 payloads, exact only below 2**53."""
+        if self.kind == F64:
+            return self
+        if self.kind == I64:
+            return Column(
+                F64,
+                self.data.astype(jnp.float64),
+                self.valid,
+                int_flag=self.valid_mask(),
+            )
+        raise TpuBackendError(f"Cannot cast {self.kind} to f64")
+
     def to_obj(self) -> "Column":
-        return Column(OBJ, np.array(self.to_values(), dtype=object), None)
+        return Column(OBJ, _obj_array(self.to_values()), None)
 
     def valid_mask(self) -> Any:
         if self.kind == OBJ:
@@ -262,7 +323,8 @@ class Column:
             return Column(OBJ, self.data[lo:hi], None)
         data = self.data[lo:hi]
         valid = self.valid[lo:hi] if self.valid is not None else None
-        return Column(self.kind, data, valid, self.vocab)
+        iflag = self.int_flag[lo:hi] if self.int_flag is not None else None
+        return Column(self.kind, data, valid, self.vocab, int_flag=iflag)
 
     def equivalence_keys(self) -> List[Any]:
         """Device key arrays whose row-wise equality == Cypher equivalence
@@ -296,6 +358,8 @@ class Column:
             STR: T.CTString,
             OBJ: T.CTAny,
         }[self.kind]
+        if self.kind == F64 and self.int_flag is not None:
+            base = T.join_types([T.CTInteger, T.CTFloat])
         has_null = self.valid is not None or self.kind == OBJ
         return base.nullable if has_null else base
 
@@ -335,7 +399,4 @@ def constant_column(value: Any, n: int) -> Column:
         return Column(F64, jnp.full(n, value, dtype=jnp.float64), None)
     if isinstance(value, str):
         return Column(STR, jnp.zeros(n, jnp.int32), None, [value])
-    arr = np.empty(n, dtype=object)
-    for i in range(n):
-        arr[i] = value
-    return Column(OBJ, arr, None)
+    return Column(OBJ, _obj_array([value] * n), None)

@@ -3,13 +3,22 @@
 The TPU analog of the reference's SQL expression mappers
 (``FlinkSQLExprMapper.scala:48`` / ``SparkSQLExprMapper.scala``): each Expr
 becomes vectorized jnp ops over ``Column``s with (data, valid) null masks and
-Kleene three-valued logic on booleans. Expressions this compiler does not
-support raise ``TpuUnsupportedExpr`` and the table falls back to the
-reference (local) evaluator — the hot relational path (ids, labels, numeric
-predicates, arithmetic) is fully device-resident."""
+Kleene three-valued logic on booleans.
+
+String functions run in VOCAB SPACE: columns are dictionary-encoded with an
+order-preserving vocabulary, so an elementwise string function is O(|vocab|)
+host work producing a lookup table, then one device gather remaps the codes
+— row count never touches the host.
+
+Expressions with no device representation (list values, paths, exotic
+functions) evaluate as narrow HOST ISLANDS: only the columns the expression
+actually references are decoded, the local-oracle evaluator computes the one
+output column, and everything else stays on device. ``TpuUnsupportedExpr``
+escapes only when even the island cannot run."""
 
 from __future__ import annotations
 
+import re
 from typing import Any, Dict, List, Optional
 
 import numpy as np
@@ -18,11 +27,25 @@ import jax.numpy as jnp
 
 from ...api import types as T
 from ...ir import expr as E
-from .column import BOOL, F64, I64, OBJ, STR, Column, TpuBackendError, constant_column
+from .column import (
+    BOOL,
+    F64,
+    I64,
+    OBJ,
+    STR,
+    Column,
+    TpuBackendError,
+    _NULL_CODE,
+    constant_column,
+)
 
 
 class TpuUnsupportedExpr(TpuBackendError):
     pass
+
+
+# functions that must evaluate per row (never const-fold / vocab-map)
+_NONDETERMINISTIC = frozenset({"rand", "randomuuid"})
 
 
 class TpuEvaluator:
@@ -35,6 +58,56 @@ class TpuEvaluator:
     # ------------------------------------------------------------------
 
     def eval(self, expr: E.Expr) -> Column:
+        try:
+            return self._eval_device(expr)
+        except TpuUnsupportedExpr:
+            return self._host_island(expr)
+
+    def _host_island(self, expr: E.Expr) -> Column:
+        """Evaluate ONE expression via the local oracle over only its
+        dependency columns; the rest of the table stays device-resident
+        (vs the old wholesale table fallback)."""
+        from ..local.eval import Evaluator as LocalEvaluator
+        from ..local.table import LocalTable
+        from .table import FALLBACK_COUNTER
+
+        FALLBACK_COUNTER.record(f"island:{type(expr).__name__}")
+        deps = self._dependency_columns(expr)
+        cols = {c: self.table._cols[c].to_values() for c in deps}
+        lt = LocalTable(cols, self.n)
+        vals = LocalEvaluator(lt, self.header, self.params).evaluate(expr)
+        return Column.from_values(vals)
+
+    def _dependency_columns(self, expr: E.Expr) -> List[str]:
+        """Physical columns a host island must decode: header-mapped
+        subexpressions, plus every column owned by any entity/path variable
+        mentioned (element materialization reads them all)."""
+        out: Dict[str, None] = {}
+        tcols = self.table._cols
+
+        def visit(e):
+            col = self.header.get(e) if self.header is not None else None
+            if col is not None and col in tcols:
+                out[col] = None
+                if not isinstance(e, E.Var):
+                    return  # mapped non-var: children irrelevant
+            if isinstance(e, E.Var) and self.header is not None:
+                if self.header.has_path(e.name):
+                    # path materialization walks entity columns; decode all
+                    for c in tcols:
+                        out[c] = None
+                    return
+                for sub in self.header.expressions_for(e):
+                    c = self.header.get(sub)
+                    if c is not None and c in tcols:
+                        out[c] = None
+            for child in getattr(e, "children", ()) or ():
+                visit(child)
+
+        visit(expr)
+        return list(out)
+
+    def _eval_device(self, expr: E.Expr) -> Column:
         col = self.header.get(expr) if self.header is not None else None
         if col is not None and col in self.table._cols:
             return self.table._cols[col]
@@ -85,7 +158,70 @@ class TpuEvaluator:
             return self._case(expr)
         if isinstance(expr, E.FunctionCall):
             return self._function(expr)
+        if isinstance(expr, (E.StartsWith, E.EndsWith, E.Contains, E.RegexMatch)):
+            return self._string_predicate(expr)
         raise TpuUnsupportedExpr(type(expr).__name__)
+
+    # -- vocab-space string ops -----------------------------------------
+    #
+    # STR columns are dictionary codes over an order-preserving vocab, so an
+    # elementwise string function = transform the (small) vocab on host,
+    # then ONE device gather remaps codes. O(|vocab|) host, O(n) device.
+
+    def _vocab_outs_str(self, col: Column, outs: List[Optional[str]]) -> Column:
+        vocab = col.vocab or []
+        new_vocab = sorted({o for o in outs if o is not None})
+        index = {s: i for i, s in enumerate(new_vocab)}
+        lut = np.array(
+            [index[o] if o is not None else _NULL_CODE for o in outs]
+            + [_NULL_CODE],
+            dtype=np.int32,
+        )
+        safe = jnp.where(col.data >= 0, col.data, len(vocab))
+        codes = jnp.take(jnp.asarray(lut), safe)
+        valid = col.valid_mask() & (codes != _NULL_CODE)
+        if col.valid is None and _NULL_CODE not in lut[:-1]:
+            valid = None
+        return Column(STR, codes, valid, new_vocab)
+
+    def _vocab_map_scalar(self, col: Column, fn, kind: str) -> Column:
+        return self._vocab_outs_scalar(col, [fn(s) for s in (col.vocab or [])], kind)
+
+    def _vocab_outs_scalar(self, col: Column, outs: List[Any], kind: str) -> Column:
+        """outs: one int/float/bool/None per vocab entry (None = null)."""
+        vocab = col.vocab or []
+        dtype = {I64: np.int64, F64: np.float64, BOOL: np.bool_}[kind]
+        ok = np.array([o is not None for o in outs] + [False], dtype=bool)
+        vals = np.array(
+            [o if o is not None else 0 for o in outs] + [0], dtype=dtype
+        )
+        safe = jnp.where(col.data >= 0, col.data, len(vocab))
+        data = jnp.take(jnp.asarray(vals), safe)
+        valid = col.valid_mask() & jnp.take(jnp.asarray(ok), safe)
+        return Column(kind, data, valid)
+
+    def _string_predicate(self, expr) -> Column:
+        pat = self._const_value(expr.rhs)
+        l = self.eval(expr.lhs)
+        if pat is None:
+            # null pattern: null everywhere
+            return Column(BOOL, jnp.zeros(self.n, bool), jnp.zeros(self.n, bool))
+        if pat is self._NOT_CONST or not isinstance(pat, str):
+            raise TpuUnsupportedExpr("non-constant string pattern")
+        if l.kind != STR:
+            if l.is_all_null():
+                return Column(BOOL, jnp.zeros(self.n, bool), jnp.zeros(self.n, bool))
+            raise TpuUnsupportedExpr(f"string predicate over {l.kind}")
+        if isinstance(expr, E.StartsWith):
+            fn = lambda s: s.startswith(pat)
+        elif isinstance(expr, E.EndsWith):
+            fn = lambda s: s.endswith(pat)
+        elif isinstance(expr, E.Contains):
+            fn = lambda s: pat in s
+        else:
+            rx = re.compile(pat)
+            fn = lambda s: rx.fullmatch(s) is not None
+        return self._vocab_map_scalar(l, fn, BOOL)
 
     # ------------------------------------------------------------------
 
@@ -141,9 +277,17 @@ class TpuEvaluator:
 
     def _comparison(self, expr) -> Column:
         l, r = self.eval(expr.lhs), self.eval(expr.rhs)
-        if OBJ in (l.kind, r.kind) or BOOL in (l.kind, r.kind):
-            raise TpuUnsupportedExpr("comparison on object/bool columns")
-        l, r = self._coerce_pair(l, r)
+        if OBJ in (l.kind, r.kind):
+            raise TpuUnsupportedExpr("comparison on object columns")
+        if l.kind == BOOL and r.kind == BOOL:
+            # false < true
+            l = Column(I64, l.data.astype(jnp.int64), l.valid)
+            r = Column(I64, r.data.astype(jnp.int64), r.valid)
+        try:
+            l, r = self._coerce_pair(l, r)
+        except TpuUnsupportedExpr:
+            # cross-kind ordering (1 < 'a') is NULL in openCypher
+            return Column(BOOL, jnp.zeros(self.n, bool), jnp.zeros(self.n, bool))
         if isinstance(expr, E.LessThan):
             v = l.data < r.data
         elif isinstance(expr, E.LessThanOrEqual):
@@ -315,7 +459,101 @@ class TpuEvaluator:
                     a.vocab,
                 )
             return out
+        return self._generic_function(expr, args)
+
+    _NOT_CONST = object()
+
+    def _const_value(self, e: E.Expr):
+        if isinstance(e, E.Lit):
+            return e.value
+        if isinstance(e, E.Param):
+            return self.params.get(e.name)
+        return self._NOT_CONST
+
+    def _generic_function(self, expr: E.FunctionCall, args: List[Column]) -> Column:
+        """Registry-driven device evaluation with EXACT oracle parity: the
+        same scalar ``fn`` the local evaluator uses (``ir/functions.py``)
+        runs once per constant set or once per vocab entry — never per row.
+
+        * all args constant -> compute once, broadcast
+        * one STR column + constants -> vocab map (string library: toUpper,
+          trim, replace, substring, size, toInteger, ... for free)
+        * BOOL column tostring -> two-entry vocab
+        """
+        from ...ir.functions import lookup as lookup_function
+
+        name = expr.name
+        if name in _NONDETERMINISTIC:
+            # must run per row — const-folding would broadcast one sample
+            raise TpuUnsupportedExpr(f"nondeterministic function {name}")
+        f = lookup_function(name)
+        consts = [self._const_value(a) for a in expr.args]
+        if all(c is not self._NOT_CONST for c in consts):
+            if f.null_prop and any(c is None for c in consts):
+                return constant_column(None, self.n)
+            return constant_column(f.fn(*consts), self.n)
+        str_pos = [
+            i
+            for i, (c, a) in enumerate(zip(consts, args))
+            if c is self._NOT_CONST and a.kind == STR
+        ]
+        if len(str_pos) == 1 and all(
+            c is not self._NOT_CONST
+            for i, c in enumerate(consts)
+            if i != str_pos[0]
+        ):
+            pos = str_pos[0]
+            col = args[pos]
+            if f.null_prop and any(
+                c is None for i, c in enumerate(consts) if i != pos
+            ):
+                return constant_column(None, self.n)
+
+            def per_entry(s, _c=consts, _p=pos, _f=f.fn):
+                a = list(_c)
+                a[_p] = s
+                return _f(*a)
+
+            res = self._vocab_apply(col, per_entry)
+            if not f.null_prop and res.kind in (I64, F64, BOOL):
+                # e.g. exists(): fn(None) is a real value, not null
+                try:
+                    nv = per_entry(None)
+                except Exception:
+                    nv = None
+                if nv is not None:
+                    const = constant_column(nv, self.n)
+                    if const.kind == res.kind:
+                        base = col.valid_mask()
+                        data = jnp.where(base, res.data, const.data)
+                        valid = jnp.where(base, res.valid_mask(), True)
+                        res = Column(res.kind, data, valid)
+            return res
+        if name == "tostring" and len(args) == 1 and args[0].kind == BOOL:
+            # two-entry vocab; 'false' < 'true' so code == bool value
+            return Column(
+                STR, args[0].data.astype(jnp.int32), args[0].valid, ["false", "true"]
+            )
         raise TpuUnsupportedExpr(f"function {name}")
+
+    def _vocab_apply(self, col: Column, fn) -> Column:
+        """Apply a scalar function per vocab entry; infer the result kind
+        from the outputs and build the matching device column."""
+        outs = [fn(s) for s in (col.vocab or [])]
+        non_null = [o for o in outs if o is not None]
+        if all(isinstance(o, str) for o in non_null):
+            return self._vocab_outs_str(col, outs)
+        if all(isinstance(o, bool) for o in non_null):
+            return self._vocab_outs_scalar(col, outs, BOOL)
+        if all(isinstance(o, int) and not isinstance(o, bool) for o in non_null):
+            return self._vocab_outs_scalar(col, outs, I64)
+        if all(
+            isinstance(o, (int, float)) and not isinstance(o, bool)
+            for o in non_null
+        ):
+            outs = [float(o) if o is not None else None for o in outs]
+            return self._vocab_outs_scalar(col, outs, F64)
+        raise TpuUnsupportedExpr("non-scalar vocab function result")
 
 
 def _mask_and(valid, cond):

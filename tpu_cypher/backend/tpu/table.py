@@ -56,6 +56,30 @@ from .column import (
 from .compiler import TpuEvaluator, TpuUnsupportedExpr
 
 
+class _FallbackCounter:
+    """Counts local-oracle fallbacks so host-bound regressions are visible
+    (VERDICT r1 asked for a per-query fallback rate on the acceptance suite).
+    Global because tables are created freely; tests reset() around a query."""
+
+    def __init__(self):
+        self.total = 0
+        self.by_reason: Dict[str, int] = {}
+
+    def record(self, reason: str) -> None:
+        self.total += 1
+        self.by_reason[reason] = self.by_reason.get(reason, 0) + 1
+
+    def reset(self) -> None:
+        self.total = 0
+        self.by_reason = {}
+
+    def snapshot(self) -> Dict[str, int]:
+        return dict(self.by_reason)
+
+
+FALLBACK_COUNTER = _FallbackCounter()
+
+
 class TpuTable(Table):
     def __init__(self, cols: Dict[str, Column], nrows: Optional[int] = None):
         self._cols = dict(cols)
@@ -91,9 +115,10 @@ class TpuTable(Table):
 
     # -- local-oracle fallback --------------------------------------------
 
-    def _to_local(self):
+    def _to_local(self, _reason: str = "unspecified"):
         from ..local.table import LocalTable
 
+        FALLBACK_COUNTER.record(_reason)
         return LocalTable(
             {c: col.to_values() for c, col in self._cols.items()}, self._nrows
         )
@@ -125,6 +150,9 @@ class TpuTable(Table):
     @property
     def size(self) -> int:
         return self._nrows
+
+    def column_values(self, col: str) -> List[Any]:
+        return self._cols[col].to_values()
 
     def rows(self) -> Iterator[Dict[str, Any]]:
         decoded = {c: col.to_values() for c, col in self._cols.items()}
@@ -178,7 +206,7 @@ class TpuTable(Table):
         try:
             c = TpuEvaluator(self, header, parameters).eval(expr)
         except TpuUnsupportedExpr:
-            return self._from_local(self._to_local().filter(expr, header, parameters))
+            return self._from_local(self._to_local('filter:expr').filter(expr, header, parameters))
         idx, _ = self._mask_to_idx(c.data & c.valid_mask())
         return self._take(idx)
 
@@ -216,10 +244,10 @@ class TpuTable(Table):
         rcols = [other._cols[r] for _, r in join_cols]
         if any(c.kind == OBJ for c in lcols + rcols):
             if swap_sides:
-                lt = other._to_local().join(self._to_local(), "right_outer",
+                lt = other._to_local('join:obj-keys').join(self._to_local('join:obj-keys'), "right_outer",
                                             [(r, l) for l, r in join_cols])
                 return self._from_local(lt)
-            lt = self._to_local().join(other._to_local(), kind, join_cols)
+            lt = self._to_local('join:obj-keys').join(other._to_local('join:obj-keys'), kind, join_cols)
             return self._from_local(lt)
         return self._join_device(other, kind, join_cols)
 
@@ -405,7 +433,7 @@ class TpuTable(Table):
 
     def order_by(self, items: Sequence[Tuple[str, bool]]) -> "TpuTable":
         if any(self._cols[c].kind == OBJ for c, _ in items):
-            return self._from_local(self._to_local().order_by(items))
+            return self._from_local(self._to_local('order_by:obj-keys').order_by(items))
         keys = []
         for colname, asc in reversed(list(items)):
             col = self._cols[colname]
@@ -467,7 +495,7 @@ class TpuTable(Table):
     def distinct(self, cols: Optional[Sequence[str]] = None) -> "TpuTable":
         on = list(cols) if cols is not None else self.physical_columns
         if any(self._cols[c].kind == OBJ for c in on):
-            return self._from_local(self._to_local().distinct(on))
+            return self._from_local(self._to_local('distinct:obj-keys').distinct(on))
         if not on:
             return self.limit(1) if self._nrows > 1 else self
         if self._nrows == 0:
@@ -487,7 +515,7 @@ class TpuTable(Table):
         try:
             return self._group_device(by, aggregations, header, parameters)
         except (TpuUnsupportedExpr, TpuBackendError):
-            lt = self._to_local().group(by, aggregations, header, parameters)
+            lt = self._to_local('group:agg').group(by, aggregations, header, parameters)
             return self._from_local(lt)
 
     def _group_device(self, by, aggregations, header, parameters) -> "TpuTable":
@@ -530,7 +558,7 @@ class TpuTable(Table):
                 out_cols[c] = self._cols[c].take(first_rows)
         elif by:  # zero rows with keys: no groups at all
             return self._from_local(
-                self._to_local().group(by, aggregations, header, parameters)
+                self._to_local('group:zero-rows').group(by, aggregations, header, parameters)
             )
         else:  # global aggregation: one group, even over zero rows
             seg_j = jnp.zeros(n, dtype=jnp.int64)
@@ -623,7 +651,7 @@ class TpuTable(Table):
                 out[col] = ev.eval(expr)
             return TpuTable(out, self._nrows)
         except TpuUnsupportedExpr:
-            lt = self._to_local().with_columns(items, header, parameters)
+            lt = self._to_local('with_columns:expr').with_columns(items, header, parameters)
             return self._from_local(lt)
 
     def project(self, pairs) -> "TpuTable":
@@ -635,7 +663,7 @@ class TpuTable(Table):
         return TpuTable(out, self._nrows)
 
     def explode(self, expr, col: str, header, parameters) -> "TpuTable":
-        lt = self._to_local().explode(expr, col, header, parameters)
+        lt = self._to_local('explode').explode(expr, col, header, parameters)
         return self._from_local(lt)
 
     def __repr__(self) -> str:

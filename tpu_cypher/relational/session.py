@@ -270,6 +270,16 @@ class CypherSession:
         input_fields: Dict[str, T.CypherType] = {}
         driving_header = None
         if driving_table is not None:
+            if not isinstance(driving_table, self.table_cls):
+                # coerce a foreign-backend driving table into this session's
+                # table type (columnwise; the reference instead requires the
+                # backend's own table type at the API boundary)
+                driving_table = self.table_cls.from_columns(
+                    {
+                        c: driving_table.column_values(c)
+                        for c in driving_table.physical_columns
+                    }
+                )
             driving_header = RecordHeader()
             from ..ir import expr as E
 
