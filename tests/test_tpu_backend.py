@@ -73,6 +73,22 @@ QUERIES = [
     "MATCH (b:Book) OPTIONAL MATCH (p:Person) RETURN b.title, count(p) AS n",
     "MATCH (a:Person)-[:KNOWS]->(b)-[:KNOWS]->(c) WITH DISTINCT a, c RETURN count(*) AS pairs",
     "MATCH (a:Person) OPTIONAL MATCH (x:Nope) WITH DISTINCT a RETURN count(a) AS n",
+    # device aggregate surface: stdev/percentiles/collect/DISTINCT aggs,
+    # grouped and global, empty groups, string percentileDisc
+    "MATCH (a:Person) RETURN stDev(a.age) AS sd, stDevP(a.age) AS sdp",
+    "MATCH (a:Person)-[k:KNOWS]->(b) RETURN b.name, stDev(k.since) AS sd ORDER BY b.name",
+    "MATCH (a:Person) RETURN percentileCont(a.age, 0.5) AS m, percentileDisc(a.age, 0.5) AS d",
+    "MATCH (a:Person) RETURN percentileCont(a.age, 0.0) AS lo, percentileCont(a.age, 1.0) AS hi",
+    "MATCH (a:Person)-[k:KNOWS]->(b) RETURN b.name, percentileDisc(k.since, 0.75) AS p ORDER BY b.name",
+    "MATCH (a:Person) RETURN percentileDisc(a.name, 0.5) AS mid",
+    "MATCH (a:Person) RETURN collect(a.age) AS ages",
+    "MATCH (a:Person)-[:KNOWS]->(b) RETURN a.name, collect(b.name) AS friends ORDER BY a.name",
+    "MATCH (a:Person) RETURN count(DISTINCT a.age > 30) AS d",
+    "MATCH (a:Person)-[:KNOWS]->(b) RETURN sum(DISTINCT b.age) AS s, avg(DISTINCT b.age) AS m",
+    "MATCH (a:Person)-[:KNOWS]->(b) RETURN a.name, collect(DISTINCT b.name) AS ns ORDER BY a.name",
+    "MATCH (a:Person) RETURN min(DISTINCT a.name) AS lo, max(DISTINCT a.age) AS hi",
+    "MATCH (x:Nope) RETURN stDev(x.v) AS sd, percentileCont(x.v, 0.5) AS p, collect(x.v) AS c",
+    "MATCH (a:Person) RETURN a.score AS s, collect(a.name) AS names ORDER BY s",
 ]
 
 
@@ -236,14 +252,36 @@ def test_group_runs_on_device_not_fallback(monkeypatch):
     assert rows["Bob"]["lo"] == rows["Bob"]["hi"] == 2019
 
 
-def test_group_collect_falls_back_cleanly():
+def test_full_aggregate_surface_on_device(monkeypatch):
+    # collect / stdev / stdevp / percentiles / DISTINCT variants now run as
+    # segment ops + segment-sorted gathers — no whole-table oracle fallback
     tpu = CypherSession.tpu()
     g = tpu.create_graph_from_create_query(CREATE)
-    r = g.cypher(
-        "MATCH (a:Person) RETURN collect(a.age) AS ages, count(DISTINCT a.age) AS d"
-    ).records.collect()
-    assert sorted(r[0]["ages"]) == [23, 42, 55]
-    assert r[0]["d"] == 3
+
+    def boom(self, _reason="x"):
+        raise AssertionError(f"aggregation fell back to the local oracle: {_reason}")
+
+    monkeypatch.setattr(TpuTable, "_to_local", boom)
+    try:
+        r = g.cypher(
+            "MATCH (a:Person) RETURN collect(a.age) AS ages, "
+            "count(DISTINCT a.age) AS d, stDev(a.age) AS sd, "
+            "stDevP(a.age) AS sdp, percentileCont(a.age, 0.5) AS med, "
+            "percentileDisc(a.age, 0.5) AS dmed, sum(DISTINCT a.age) AS sd2, "
+            "collect(DISTINCT a.name) AS names"
+        ).records.collect()
+    finally:
+        monkeypatch.undo()
+    row = r[0]
+    assert sorted(row["ages"]) == [23, 42, 55]
+    assert row["d"] == 3
+    # ages [23,42,55]: mean 40, sq dev 289+4+225=518
+    assert abs(row["sd"] - (518 / 2) ** 0.5) < 1e-9
+    assert abs(row["sdp"] - (518 / 3) ** 0.5) < 1e-9
+    assert row["med"] == 42.0
+    assert row["dmed"] == 42
+    assert row["sd2"] == 120
+    assert sorted(row["names"]) == ["Alice", "Bob", "Carol"]
 
 
 def test_right_and_full_outer_on_device(monkeypatch):

@@ -390,8 +390,9 @@ class TpuEvaluator:
         )
         kinds = {c.kind for c in thens} | {default.kind}
         if kinds <= {I64, F64} and len(kinds) > 1:
-            thens = [c.cast_f64() for c in thens]
-            default = default.cast_f64() if default.kind in (I64, F64) else default
+            thens = [c.as_f64_keeping_intness() for c in thens]
+            if default.kind in (I64, F64):
+                default = default.as_f64_keeping_intness()
             kinds = {F64}
         if len(kinds - {default.kind}) > 0 and len(kinds) > 1:
             raise TpuUnsupportedExpr("heterogeneous CASE branches")
@@ -408,11 +409,29 @@ class TpuEvaluator:
             take = cond.data & cond.valid_mask()
             data = jnp.where(take, then.data, out.data)
             valid = jnp.where(take, then.valid_mask(), out.valid_mask())
-            out = Column(then.kind, data, valid, then.vocab)
+            out = Column(
+                then.kind, data, valid, then.vocab,
+                int_flag=_merge_int_flag(take, then, out),
+            )
         return out
 
     def _function(self, expr: E.FunctionCall) -> Column:
+        from ...ir.functions import lookup as lookup_function
+
         name = expr.name
+        if name in _NONDETERMINISTIC:
+            # must run per row — const-folding would broadcast one sample
+            raise TpuUnsupportedExpr(f"nondeterministic function {name}")
+        consts = [self._const_value(a) for a in expr.args]
+        if consts and all(c is not self._NOT_CONST for c in consts):
+            # fold fully-constant calls before any device allocation
+            try:
+                f = lookup_function(name)
+            except Exception:
+                raise TpuUnsupportedExpr(f"unknown function {name}")
+            if f.null_prop and any(c is None for c in consts):
+                return constant_column(None, self.n)
+            return constant_column(f.fn(*consts), self.n)
         args = [self.eval(a) for a in expr.args]
         if name == "abs" and args[0].kind in (I64, F64):
             return Column(args[0].kind, jnp.abs(args[0].data), args[0].valid)
@@ -440,7 +459,7 @@ class TpuEvaluator:
         if name == "coalesce":
             kinds = {a.kind for a in args}
             if kinds <= {I64, F64} and len(kinds) > 1:
-                args = [a.cast_f64() for a in args]
+                args = [a.as_f64_keeping_intness() for a in args]
             elif kinds == {STR}:
                 # blend on one merged dictionary or codes are meaningless
                 from .column import _remap
@@ -457,6 +476,7 @@ class TpuEvaluator:
                     jnp.where(take, a.data, out.data),
                     jnp.where(take, True, out.valid_mask()),
                     a.vocab,
+                    int_flag=_merge_int_flag(take, a, out),
                 )
             return out
         return self._generic_function(expr, args)
@@ -483,15 +503,11 @@ class TpuEvaluator:
         from ...ir.functions import lookup as lookup_function
 
         name = expr.name
-        if name in _NONDETERMINISTIC:
-            # must run per row — const-folding would broadcast one sample
-            raise TpuUnsupportedExpr(f"nondeterministic function {name}")
-        f = lookup_function(name)
+        try:
+            f = lookup_function(name)
+        except Exception:
+            raise TpuUnsupportedExpr(f"unknown function {name}")
         consts = [self._const_value(a) for a in expr.args]
-        if all(c is not self._NOT_CONST for c in consts):
-            if f.null_prop and any(c is None for c in consts):
-                return constant_column(None, self.n)
-            return constant_column(f.fn(*consts), self.n)
         str_pos = [
             i
             for i, (c, a) in enumerate(zip(consts, args))
@@ -554,6 +570,16 @@ class TpuEvaluator:
             outs = [float(o) if o is not None else None for o in outs]
             return self._vocab_outs_scalar(col, outs, F64)
         raise TpuUnsupportedExpr("non-scalar vocab function result")
+
+
+def _merge_int_flag(take, a: Column, b: Column):
+    """int_flag of where(take, a, b) — None when neither side tracks it."""
+    if a.int_flag is None and b.int_flag is None:
+        return None
+    n = len(a)
+    ai = a.int_flag if a.int_flag is not None else jnp.zeros(n, bool)
+    bi = b.int_flag if b.int_flag is not None else jnp.zeros(n, bool)
+    return jnp.where(take, ai, bi)
 
 
 def _mask_and(valid, cond):

@@ -507,9 +507,24 @@ class TpuTable(Table):
 
     # -- aggregation / projection / explode --------------------------------
 
-    # aggregators the device path handles; the rest (collect, stdev,
-    # percentiles, DISTINCT variants, durations) use the local oracle
-    _DEVICE_AGGS = frozenset({"count", "sum", "avg", "min", "max"})
+    # aggregators the device path handles (durations and other
+    # object-valued inputs still use the local oracle)
+    _DEVICE_AGGS = frozenset(
+        {
+            "count",
+            "sum",
+            "avg",
+            "min",
+            "max",
+            "stdev",
+            "stdevp",
+            "percentilecont",
+            "percentiledisc",
+            "collect",
+        }
+    )
+    # DISTINCT runs as a device pre-dedup of (group, value) pairs
+    _DISTINCT_AGGS = frozenset({"count", "sum", "avg", "min", "max", "collect"})
 
     def group(self, by, aggregations, header, parameters) -> "TpuTable":
         try:
@@ -529,12 +544,10 @@ class TpuTable(Table):
         from ...ir import expr as E
 
         for _, agg in aggregations:
-            if (
-                not isinstance(agg, E.Agg)
-                or agg.name.lower() not in self._DEVICE_AGGS
-                or agg.distinct
-            ):
+            if not isinstance(agg, E.Agg) or agg.name.lower() not in self._DEVICE_AGGS:
                 raise TpuUnsupportedExpr(f"device agg {getattr(agg, 'name', agg)}")
+            if agg.distinct and agg.name.lower() not in self._DISTINCT_AGGS:
+                raise TpuUnsupportedExpr(f"device agg DISTINCT {agg.name}")
         if any(self._cols[c].kind == OBJ for c in by):
             raise TpuUnsupportedExpr("object-valued group keys")
 
@@ -579,69 +592,235 @@ class TpuTable(Table):
             col = ev.eval(agg.expr)
             if col.kind == OBJ:
                 raise TpuUnsupportedExpr("object-valued aggregation input")
-            data, kind, vocab = col.data, col.kind, col.vocab
-            valid = col.valid_mask()
-            cnt = jax.ops.segment_sum(
-                valid.astype(jnp.int64), seg_j, num_segments=k
-            )
-            if name == "count":
-                out_cols[out_col] = Column(I64, cnt, None)
-                continue
-            if name in ("sum", "avg"):
-                if kind not in (I64, F64):
-                    raise TpuUnsupportedExpr(f"{name} over {kind}")
-                if kind == F64 and name == "sum" and bool(jnp.any(cnt == 0)):
-                    # Cypher sum over no values is the INTEGER 0; a float
-                    # column cannot hold it — let the oracle type that group
-                    raise TpuUnsupportedExpr("float sum over an empty group")
-                zero = jnp.zeros((), data.dtype)
-                ssum = jax.ops.segment_sum(
-                    jnp.where(valid, data, zero), seg_j, num_segments=k
-                )
-                if name == "sum":
-                    out_cols[out_col] = Column(kind, ssum, None)
-                else:
-                    avg = ssum.astype(jnp.float64) / jnp.maximum(cnt, 1)
-                    out_cols[out_col] = Column(F64, avg, cnt > 0)
-                continue
-            # min / max with Cypher orderability: numbers < NaN; nulls skipped
-            d = data.astype(jnp.int8) if kind == BOOL else data
-            if kind == F64:
-                isnan = jnp.isnan(d) & valid
-                nn_valid = valid & ~isnan
-                nan_cnt = jax.ops.segment_sum(
-                    isnan.astype(jnp.int64), seg_j, num_segments=k
-                )
+            if agg.distinct:
+                seg_a, col_a, n_a = self._dedup_seg_values(seg_j, col)
             else:
-                nn_valid = valid
-                nan_cnt = None
-            big = jnp.asarray(
-                np.inf if kind == F64 else np.iinfo(np.dtype(d.dtype)).max,
-                d.dtype,
+                seg_a, col_a, n_a = seg_j, col, n
+            out_cols[out_col] = self._segment_agg(
+                name, agg, seg_a, col_a, n_a, k, parameters
             )
-            if name == "min":
-                agged = jax.ops.segment_min(
-                    jnp.where(nn_valid, d, big), seg_j, num_segments=k
-                )
-                if nan_cnt is not None:
-                    # all-NaN group: min is NaN (NaN sorts above numbers)
-                    nn_cnt = cnt - nan_cnt
-                    agged = jnp.where(
-                        (nn_cnt == 0) & (nan_cnt > 0), jnp.nan, agged
-                    )
-            else:
-                agged = jax.ops.segment_max(
-                    jnp.where(nn_valid, d, -big if kind != STR else -jnp.ones((), d.dtype)),
-                    seg_j,
-                    num_segments=k,
-                )
-                if nan_cnt is not None:
-                    # any NaN: NaN is the maximum under Cypher orderability
-                    agged = jnp.where(nan_cnt > 0, jnp.nan, agged)
-            if kind == BOOL:
-                agged = agged.astype(bool)
-            out_cols[out_col] = Column(kind, agged, cnt > 0, vocab)
         return TpuTable(out_cols, k)
+
+    def _dedup_seg_values(self, seg_j, col: Column):
+        """Device dedup of (group, value) pairs for DISTINCT aggregates:
+        first occurrence per Cypher-equivalence class within each group,
+        original row order preserved (collect DISTINCT emits values in
+        first-appearance order, like the oracle)."""
+        keys = [seg_j] + col.equivalence_keys()
+        order = jnp.lexsort(tuple(reversed(keys)))
+        nn = int(seg_j.shape[0])
+        if nn > 1:
+            diff = jnp.zeros(nn - 1, bool)
+            for kk in keys:
+                ks = jnp.take(kk, order)
+                diff = diff | (ks[1:] != ks[:-1])
+            flags = jnp.concatenate([jnp.ones(1, bool), diff])
+        else:
+            flags = jnp.ones(nn, bool)
+        idx, _ = self._mask_to_idx(flags)
+        rows = jnp.sort(jnp.take(order, idx))
+        return jnp.take(seg_j, rows), col.take(rows), int(rows.shape[0])
+
+    def _segment_agg(
+        self, name: str, agg, seg_j, col: Column, n: int, k: int, parameters=None
+    ) -> Column:
+        """One aggregator over (value column, group index) as device segment
+        ops — the TPU analog of the engines' shuffle aggregate plus the
+        codegen UDAFs (reference ``PercentileUdafs.scala``,
+        ``TemporalUdafs.scala`` play this role on Spark)."""
+        import jax
+
+        data, kind, vocab = col.data, col.kind, col.vocab
+        valid = col.valid_mask()
+        cnt = jax.ops.segment_sum(valid.astype(jnp.int64), seg_j, num_segments=k)
+        if name == "count":
+            return Column(I64, cnt, None)
+        if name == "collect":
+            # output is host lists by definition; only this column decodes
+            vals = col.to_values()
+            seg_np = np.asarray(seg_j)
+            valid_np = np.asarray(valid)
+            lists: List[List[Any]] = [[] for _ in range(k)]
+            for i in range(n):
+                if valid_np[i]:
+                    lists[int(seg_np[i])].append(vals[i])
+            from .column import _obj_array
+
+            return Column(OBJ, _obj_array(lists), None)
+        if name in ("sum", "avg", "stdev", "stdevp"):
+            if kind not in (I64, F64):
+                raise TpuUnsupportedExpr(f"{name} over {kind}")
+            zero = jnp.zeros((), data.dtype)
+            ssum = jax.ops.segment_sum(
+                jnp.where(valid, data, zero), seg_j, num_segments=k
+            )
+            if name == "sum":
+                if kind == F64:
+                    # Cypher sum over no values is the INTEGER 0, and the sum
+                    # of an all-integer group is an INTEGER — int_flag lets
+                    # the float column carry both exactly (ints < 2**53)
+                    empty = cnt == 0
+                    if col.int_flag is not None:
+                        int_if_valid = jnp.where(valid, col.int_flag, True)
+                        all_int = (
+                            jax.ops.segment_min(
+                                int_if_valid.astype(jnp.int8),
+                                seg_j,
+                                num_segments=k,
+                            )
+                            == 1
+                        )
+                        iflag = all_int | empty
+                    else:
+                        iflag = empty
+                    if not bool(jnp.any(iflag)):
+                        iflag = None
+                    return Column(
+                        F64, jnp.where(empty, 0.0, ssum), None, int_flag=iflag
+                    )
+                return Column(kind, ssum, None)
+            if name == "avg":
+                avg = ssum.astype(jnp.float64) / jnp.maximum(cnt, 1)
+                return Column(F64, avg, cnt > 0)
+            # stdev (sample) / stdevp (population): two-pass for stability;
+            # empty and single-value groups are 0.0 like the oracle
+            x = data.astype(jnp.float64)
+            mean = ssum.astype(jnp.float64) / jnp.maximum(cnt, 1)
+            diff = jnp.where(valid, x - jnp.take(mean, seg_j), 0.0)
+            ssq = jax.ops.segment_sum(diff * diff, seg_j, num_segments=k)
+            denom = jnp.maximum(cnt - (1 if name == "stdev" else 0), 1)
+            out = jnp.sqrt(ssq / denom)
+            return Column(F64, jnp.where(cnt >= 2, out, 0.0), None)
+        if name in ("percentilecont", "percentiledisc"):
+            return self._segment_percentile(
+                name, agg, seg_j, col, n, k, cnt, parameters
+            )
+        # min / max with Cypher orderability: numbers < NaN; nulls skipped
+        d = data.astype(jnp.int8) if kind == BOOL else data
+        if kind == F64:
+            isnan = jnp.isnan(d) & valid
+            nn_valid = valid & ~isnan
+            nan_cnt = jax.ops.segment_sum(
+                isnan.astype(jnp.int64), seg_j, num_segments=k
+            )
+        else:
+            nn_valid = valid
+            nan_cnt = None
+        big = jnp.asarray(
+            np.inf if kind == F64 else np.iinfo(np.dtype(d.dtype)).max,
+            d.dtype,
+        )
+        if name == "min":
+            agged = jax.ops.segment_min(
+                jnp.where(nn_valid, d, big), seg_j, num_segments=k
+            )
+            if nan_cnt is not None:
+                # all-NaN group: min is NaN (NaN sorts above numbers)
+                nn_cnt = cnt - nan_cnt
+                agged = jnp.where((nn_cnt == 0) & (nan_cnt > 0), jnp.nan, agged)
+        else:
+            agged = jax.ops.segment_max(
+                jnp.where(nn_valid, d, -big if kind != STR else -jnp.ones((), d.dtype)),
+                seg_j,
+                num_segments=k,
+            )
+            if nan_cnt is not None:
+                # any NaN: NaN is the maximum under Cypher orderability
+                agged = jnp.where(nan_cnt > 0, jnp.nan, agged)
+        if kind == BOOL:
+            agged = agged.astype(bool)
+        iflag = None
+        if kind == F64 and col.int_flag is not None:
+            # Cypher intness of the winning value: the oracle's min/max keeps
+            # the FIRST minimal/maximal element in row order, so take the
+            # int_flag of the first row matching the aggregate
+            cand = nn_valid & (d == jnp.take(agged, seg_j))
+            first_row = jax.ops.segment_min(
+                jnp.where(cand, jnp.arange(n, dtype=jnp.int64), n),
+                seg_j,
+                num_segments=k,
+            )
+            safe_row = jnp.clip(first_row, 0, max(n - 1, 0))
+            if n:
+                iflag = jnp.take(col.int_flag, safe_row) & (first_row < n)
+        return Column(kind, agged, cnt > 0, vocab, int_flag=iflag)
+
+    def _segment_percentile(
+        self, name: str, agg, seg_j, col: Column, n: int, k: int, cnt, parameters=None
+    ) -> Column:
+        """percentileCont/Disc as a segment-sorted gather: one device
+        lexsort groups each segment's valid values contiguously, then the
+        target rank is a direct index off the segment's start offset
+        (reference ``PercentileUdafs.scala`` sorts per group on the JVM)."""
+        import jax
+
+        from ...ir import expr as E
+
+        if not agg.extra:
+            raise TpuUnsupportedExpr("percentile without fraction")
+        pe = agg.extra[0]
+        if isinstance(pe, E.Lit):
+            p = pe.value
+        elif isinstance(pe, E.Param):
+            p = (parameters or {}).get(pe.name)
+        else:
+            raise TpuUnsupportedExpr("non-literal percentile fraction")
+        if not isinstance(p, (int, float)) or not 0 <= float(p) <= 1:
+            # let the oracle raise the proper CypherTypeError
+            raise TpuUnsupportedExpr("percentile fraction out of range")
+        p = float(p)
+        data, kind, vocab = col.data, col.kind, col.vocab
+        valid = col.valid_mask()
+        if kind == OBJ or kind == BOOL:
+            raise TpuUnsupportedExpr(f"percentile over {kind}")
+        if name == "percentilecont" and kind not in (I64, F64):
+            raise TpuUnsupportedExpr("percentileCont over non-numeric")
+        if kind == F64 and bool(jnp.any(jnp.isnan(data) & valid)):
+            raise TpuUnsupportedExpr("percentile over NaN values")
+        # explicit invalid flag as the secondary sort key — a value sentinel
+        # (+inf / int max) could tie with legitimate data and let a null
+        # row's payload be gathered as the percentile
+        order = jnp.lexsort((data, (~valid).astype(jnp.int8), seg_j))
+        sorted_val = jnp.take(data, order)
+        sizes = jax.ops.segment_sum(
+            jnp.ones(n, jnp.int64), seg_j, num_segments=k
+        )
+        starts = jnp.concatenate(
+            [jnp.zeros(1, jnp.int64), jnp.cumsum(sizes)]
+        )[:-1]
+        safe_cnt = jnp.maximum(cnt, 1)
+        if name == "percentiledisc":
+            idx = jnp.where(
+                p > 0,
+                jnp.ceil(p * safe_cnt.astype(jnp.float64)).astype(jnp.int64) - 1,
+                0,
+            )
+            idx = jnp.clip(idx, 0, safe_cnt - 1)
+            pos = jnp.clip(starts + idx, 0, max(n - 1, 0))
+            if n:
+                out = jnp.take(sorted_val, pos)
+                iflag = (
+                    jnp.take(col.int_flag, jnp.take(order, pos))
+                    if kind == F64 and col.int_flag is not None
+                    else None
+                )
+            else:
+                out = jnp.zeros(k, data.dtype)
+                iflag = None
+            return Column(kind, out, cnt > 0, vocab, int_flag=iflag)
+        fidx = p * (safe_cnt.astype(jnp.float64) - 1)
+        lo = jnp.floor(fidx).astype(jnp.int64)
+        hi = jnp.ceil(fidx).astype(jnp.int64)
+        frac = fidx - lo.astype(jnp.float64)
+        pos_lo = jnp.clip(starts + lo, 0, max(n - 1, 0))
+        pos_hi = jnp.clip(starts + hi, 0, max(n - 1, 0))
+        if n:
+            vlo = jnp.take(sorted_val, pos_lo).astype(jnp.float64)
+            vhi = jnp.take(sorted_val, pos_hi).astype(jnp.float64)
+            out = vlo * (1 - frac) + vhi * frac
+        else:
+            out = jnp.zeros(k, jnp.float64)
+        return Column(F64, out, cnt > 0)
 
     def with_columns(self, items, header, parameters) -> "TpuTable":
         out = dict(self._cols)
@@ -663,8 +842,26 @@ class TpuTable(Table):
         return TpuTable(out, self._nrows)
 
     def explode(self, expr, col: str, header, parameters) -> "TpuTable":
-        lt = self._to_local('explode').explode(expr, col, header, parameters)
-        return self._from_local(lt)
+        """UNWIND: only the LIST column itself is host-decoded (lists are
+        host objects by definition); every other column stays on device and
+        is flattened with one device gather over the repeat index."""
+        lists = TpuEvaluator(self, header, parameters).eval(expr).to_values()
+        idx: List[int] = []
+        values: List[Any] = []
+        for i, lst in enumerate(lists):
+            if lst is None:
+                continue  # UNWIND null produces no rows
+            if not isinstance(lst, (list, tuple)):
+                idx.append(i)
+                values.append(lst)
+                continue
+            for v in lst:
+                idx.append(i)
+                values.append(v)
+        take = jnp.asarray(np.array(idx, dtype=np.int64))
+        out = {c: c_.take(take) for c, c_ in self._cols.items()}
+        out[col] = Column.from_values(values)
+        return TpuTable(out, len(idx))
 
     def __repr__(self) -> str:
         return f"TpuTable({self._nrows} rows, cols={self.physical_columns})"

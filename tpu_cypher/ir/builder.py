@@ -325,13 +325,36 @@ class IRBuilder:
         # reference the projected items (Neo4j's scoping rule); otherwise the
         # wide pre-narrowing scope is visible
         rest_env = new_env if c.distinct else wide_env
+
+        def convert_rest(ast_expr) -> E.Expr:
+            """After aggregation, ORDER BY/WHERE may also reference grouping
+            or aggregate EXPRESSIONS (``ORDER BY b.name``, ``ORDER BY
+            count(*)``): convert them in the pre-projection scope and
+            substitute each projected expression with its output column."""
+            try:
+                return self.convert_expr(ast_expr, rest_env)
+            except IRBuildError:
+                if not has_agg:
+                    raise
+                e = self.convert_expr(ast_expr, env)
+                proj_sub = {
+                    pe: E.Var(nm).with_type(new_env[nm]) for nm, pe in items
+                }
+                e = E.substitute(e, proj_sub)
+                for node in e.iter_nodes():
+                    if isinstance(node, E.Var) and node.name not in rest_env:
+                        raise IRBuildError(
+                            f"Variable {node.name!r} not visible after aggregation"
+                        )
+                return e
+
         where_pred = None
         if c.where is not None:
-            where_pred = self.convert_expr(c.where, rest_env)
+            where_pred = convert_rest(c.where)
 
         sort_items = []
         for s in c.order_by:
-            sort_items.append(A.SortItem(self.convert_expr(s.expr, rest_env), s.ascending))
+            sort_items.append(A.SortItem(convert_rest(s.expr), s.ascending))
         skip = self.convert_expr(c.skip, rest_env) if c.skip is not None else None
         limit = self.convert_expr(c.limit, rest_env) if c.limit is not None else None
 
