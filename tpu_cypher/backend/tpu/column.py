@@ -25,6 +25,7 @@ import jax.numpy as jnp
 
 from ...api import types as T
 from ...api.types import CypherType
+from ...parallel.mesh import shard_rows
 
 # column kinds
 I64 = "i64"
@@ -74,27 +75,31 @@ class Column:
         n = len(values)
         valid_np = np.array([v is not None for v in values], dtype=bool)
         has_null = not valid_np.all()
+        dev = lambda a: shard_rows(jnp.asarray(a))
         if not non_null:
-            return Column(I64, jnp.zeros(n, jnp.int64), jnp.zeros(n, bool))
-        if all(isinstance(v, bool) for v in non_null):
+            return Column(I64, dev(np.zeros(n, np.int64)), dev(np.zeros(n, bool)))
+        _BOOLK = (bool, np.bool_)
+        _INTK = (int, np.integer)
+        _NUMK = (int, float, np.integer, np.floating)
+        if all(isinstance(v, _BOOLK) for v in non_null):
             data = np.array([bool(v) if v is not None else False for v in values])
-            return Column(BOOL, jnp.asarray(data), jnp.asarray(valid_np) if has_null else None)
-        if all(isinstance(v, int) and not isinstance(v, bool) for v in non_null):
+            return Column(BOOL, dev(data), dev(valid_np) if has_null else None)
+        if all(isinstance(v, _INTK) and not isinstance(v, _BOOLK) for v in non_null):
             data = np.array([int(v) if v is not None else 0 for v in values], dtype=np.int64)
-            return Column(I64, jnp.asarray(data), jnp.asarray(valid_np) if has_null else None)
-        if all(isinstance(v, (int, float)) and not isinstance(v, bool) for v in non_null):
+            return Column(I64, dev(data), dev(valid_np) if has_null else None)
+        if all(isinstance(v, _NUMK) and not isinstance(v, _BOOLK) for v in non_null):
             data = np.array(
                 [float(v) if v is not None else 0.0 for v in values], dtype=np.float64
             )
             iflag = np.array(
-                [isinstance(v, int) and not isinstance(v, bool) for v in values],
+                [isinstance(v, _INTK) and not isinstance(v, _BOOLK) for v in values],
                 dtype=bool,
             )
             return Column(
                 F64,
-                jnp.asarray(data),
-                jnp.asarray(valid_np) if has_null else None,
-                int_flag=jnp.asarray(iflag) if iflag.any() else None,
+                dev(data),
+                dev(valid_np) if has_null else None,
+                int_flag=dev(iflag) if iflag.any() else None,
             )
         if all(isinstance(v, str) for v in non_null):
             vocab = sorted(set(non_null))
@@ -105,8 +110,8 @@ class Column:
             )
             return Column(
                 STR,
-                jnp.asarray(codes),
-                jnp.asarray(valid_np) if has_null else None,
+                dev(codes),
+                dev(valid_np) if has_null else None,
                 vocab,
             )
         # fallback: host objects
@@ -118,13 +123,13 @@ class Column:
         fast path — ``from_values`` walks Python objects, O(n) interpreter
         work; this is one H2D transfer)."""
         arr = np.asarray(arr)
-        v = jnp.asarray(valid) if valid is not None else None
+        v = shard_rows(jnp.asarray(valid)) if valid is not None else None
         if arr.dtype == np.bool_:
-            return Column(BOOL, jnp.asarray(arr), v)
+            return Column(BOOL, shard_rows(jnp.asarray(arr)), v)
         if np.issubdtype(arr.dtype, np.integer):
-            return Column(I64, jnp.asarray(arr.astype(np.int64)), v)
+            return Column(I64, shard_rows(jnp.asarray(arr.astype(np.int64))), v)
         if np.issubdtype(arr.dtype, np.floating):
-            return Column(F64, jnp.asarray(arr.astype(np.float64)), v)
+            return Column(F64, shard_rows(jnp.asarray(arr.astype(np.float64))), v)
         raise TpuBackendError(f"from_numpy: unsupported dtype {arr.dtype}")
 
     def to_values(self, row_mask: Optional[np.ndarray] = None) -> List[Any]:

@@ -422,13 +422,14 @@ class TpuEvaluator:
         if name in _NONDETERMINISTIC:
             # must run per row — const-folding would broadcast one sample
             raise TpuUnsupportedExpr(f"nondeterministic function {name}")
+        try:
+            f = lookup_function(name)
+        except Exception:
+            raise TpuUnsupportedExpr(f"unknown function {name}")
         consts = [self._const_value(a) for a in expr.args]
-        if consts and all(c is not self._NOT_CONST for c in consts):
-            # fold fully-constant calls before any device allocation
-            try:
-                f = lookup_function(name)
-            except Exception:
-                raise TpuUnsupportedExpr(f"unknown function {name}")
+        if all(c is not self._NOT_CONST for c in consts):
+            # fold fully-constant (incl. zero-arg: pi(), e()) calls before
+            # any device allocation
             if f.null_prop and any(c is None for c in consts):
                 return constant_column(None, self.n)
             return constant_column(f.fn(*consts), self.n)
@@ -479,7 +480,7 @@ class TpuEvaluator:
                     int_flag=_merge_int_flag(take, a, out),
                 )
             return out
-        return self._generic_function(expr, args)
+        return self._generic_function(expr, args, f, consts)
 
     _NOT_CONST = object()
 
@@ -490,7 +491,9 @@ class TpuEvaluator:
             return self.params.get(e.name)
         return self._NOT_CONST
 
-    def _generic_function(self, expr: E.FunctionCall, args: List[Column]) -> Column:
+    def _generic_function(
+        self, expr: E.FunctionCall, args: List[Column], f, consts
+    ) -> Column:
         """Registry-driven device evaluation with EXACT oracle parity: the
         same scalar ``fn`` the local evaluator uses (``ir/functions.py``)
         runs once per constant set or once per vocab entry — never per row.
@@ -500,14 +503,7 @@ class TpuEvaluator:
           trim, replace, substring, size, toInteger, ... for free)
         * BOOL column tostring -> two-entry vocab
         """
-        from ...ir.functions import lookup as lookup_function
-
         name = expr.name
-        try:
-            f = lookup_function(name)
-        except Exception:
-            raise TpuUnsupportedExpr(f"unknown function {name}")
-        consts = [self._const_value(a) for a in expr.args]
         str_pos = [
             i
             for i, (c, a) in enumerate(zip(consts, args))

@@ -31,6 +31,7 @@ import jax.numpy as jnp
 
 from ...api import types as T
 from ...ir import expr as E
+from ...parallel.mesh import shard_rows
 from .column import Column, TpuBackendError
 
 # canonical scan variable names (reserved: queries cannot produce '$' vars)
@@ -187,15 +188,18 @@ class GraphIndex:
         a_sorted = a[order]
         row_ptr = np.searchsorted(a_sorted, np.arange(n + 1)).astype(np.int32)
         out = (
+            # row_ptr is node-dim (replicated); the edge-dim arrays shard
+            # over the active mesh — the hash-partitioned-relationship-table
+            # analog (SURVEY §2.3)
             jnp.asarray(row_ptr),
-            jnp.asarray(b[order].astype(np.int32)),
-            jnp.asarray(order.astype(np.int64)),
+            shard_rows(jnp.asarray(b[order].astype(np.int32))),
+            shard_rows(jnp.asarray(order.astype(np.int64))),
         )
         self._csr[(types_key, reverse)] = out
         if not reverse and types_key not in self._edge_keys:
             # forward CSR order is lexsorted by (src, dst) => keys sorted
             keys = a_sorted.astype(np.int64) * n + b[order].astype(np.int64)
-            self._edge_keys[types_key] = jnp.asarray(keys)
+            self._edge_keys[types_key] = shard_rows(jnp.asarray(keys))
         return out
 
     def edge_keys(self, types_key: Tuple[str, ...], ctx):

@@ -475,21 +475,25 @@ class TpuTable(Table):
             keys.extend(self._cols[c].equivalence_keys())
         return keys
 
-    def _first_occurrence_index(self, on: Sequence[str]) -> Tuple[Any, Any]:
+    def _first_occurrence_index(
+        self, on: Sequence[str], extra_keys: Sequence[Any] = ()
+    ) -> Tuple[Any, Any]:
         """Stable device lexsort over equivalence keys -> (sorted row order,
         first-of-group flags over the sorted order). The stable sort makes
         the first row of each equal-key run the earliest original row of
-        that group."""
-        keys = self._equivalence_keys(on)
+        that group. ``extra_keys`` prepend higher-priority key arrays (e.g.
+        a group index for DISTINCT aggregates)."""
+        keys = list(extra_keys) + self._equivalence_keys(on)
+        n = int(keys[0].shape[0]) if keys else self._nrows
         order = jnp.lexsort(tuple(reversed(keys)))
-        diff = jnp.zeros(self._nrows - 1, bool) if self._nrows > 1 else None
-        if diff is not None:
+        if n > 1:
+            diff = jnp.zeros(n - 1, bool)
             for k in keys:
                 ks = jnp.take(k, order)
                 diff = diff | (ks[1:] != ks[:-1])
             flags = jnp.concatenate([jnp.ones(1, bool), diff])
         else:
-            flags = jnp.ones(self._nrows, bool)
+            flags = jnp.ones(n, bool)
         return order, flags
 
     def distinct(self, cols: Optional[Sequence[str]] = None) -> "TpuTable":
@@ -603,20 +607,12 @@ class TpuTable(Table):
 
     def _dedup_seg_values(self, seg_j, col: Column):
         """Device dedup of (group, value) pairs for DISTINCT aggregates:
-        first occurrence per Cypher-equivalence class within each group,
-        original row order preserved (collect DISTINCT emits values in
-        first-appearance order, like the oracle)."""
-        keys = [seg_j] + col.equivalence_keys()
-        order = jnp.lexsort(tuple(reversed(keys)))
-        nn = int(seg_j.shape[0])
-        if nn > 1:
-            diff = jnp.zeros(nn - 1, bool)
-            for kk in keys:
-                ks = jnp.take(kk, order)
-                diff = diff | (ks[1:] != ks[:-1])
-            flags = jnp.concatenate([jnp.ones(1, bool), diff])
-        else:
-            flags = jnp.ones(nn, bool)
+        first occurrence per Cypher-equivalence class within each group
+        (the group index is the leading sort key), original row order
+        preserved (collect DISTINCT emits values in first-appearance order,
+        like the oracle)."""
+        tmp = TpuTable({"__v": col}, int(seg_j.shape[0]))
+        order, flags = tmp._first_occurrence_index(["__v"], extra_keys=[seg_j])
         idx, _ = self._mask_to_idx(flags)
         rows = jnp.sort(jnp.take(order, idx))
         return jnp.take(seg_j, rows), col.take(rows), int(rows.shape[0])

@@ -43,11 +43,70 @@ except ImportError:  # pragma: no cover
 
 EDGE_AXIS = "edges"
 
+# engine-level row axis: TpuTable columns and CSR edge arrays are sharded
+# over this axis while a mesh is active (SURVEY §2.3 "tables sharded on
+# id/hash dim across a TPU mesh")
+ROW_AXIS = "rows"
+
+_ACTIVE_MESH: Optional[Mesh] = None
+
 
 def make_mesh(devices: Optional[Sequence] = None) -> Mesh:
     if devices is None:
         devices = jax.devices()
     return Mesh(np.array(devices), (EDGE_AXIS,))
+
+
+def make_row_mesh(devices: Optional[Sequence] = None) -> Mesh:
+    """1-D engine mesh: every table row dimension shards over ROW_AXIS."""
+    if devices is None:
+        devices = jax.devices()
+    return Mesh(np.array(devices), (ROW_AXIS,))
+
+
+class use_mesh:
+    """Context manager activating engine sharding: while active, newly
+    created TpuTable columns and GraphIndex edge arrays are laid out as
+    ``NamedSharding(mesh, P(ROW_AXIS))`` and every downstream op runs under
+    XLA's GSPMD propagation — collectives (all_gather/all_to_all/psum) are
+    inserted by the compiler where ops cross shards, the idiomatic
+    replacement for the engines' shuffle exchanges (SURVEY §2.3)."""
+
+    def __init__(self, mesh: Mesh):
+        self.mesh = mesh
+        self._prev: Optional[Mesh] = None
+
+    def __enter__(self) -> Mesh:
+        global _ACTIVE_MESH
+        self._prev = _ACTIVE_MESH
+        _ACTIVE_MESH = self.mesh
+        return self.mesh
+
+    def __exit__(self, *exc) -> None:
+        global _ACTIVE_MESH
+        _ACTIVE_MESH = self._prev
+
+
+def current_mesh() -> Optional[Mesh]:
+    return _ACTIVE_MESH
+
+
+def shard_rows(arr):
+    """Row-shard a device array over the active mesh when its leading dim is
+    divisible by the mesh size (NamedSharding requires divisibility); other
+    arrays stay as-is — eager ops mix sharded and unsharded operands freely
+    (GSPMD replicates/reshards as needed)."""
+    mesh = _ACTIVE_MESH
+    if mesh is None:
+        return arr
+    shape = getattr(arr, "shape", None)
+    if not shape or shape[0] == 0:
+        return arr
+    size = int(np.prod(list(mesh.shape.values())))
+    if shape[0] % size != 0:
+        return arr
+    axis = mesh.axis_names[0]
+    return jax.device_put(arr, NamedSharding(mesh, P(axis)))
 
 
 def pad_edges(src_idx: np.ndarray, col_idx: np.ndarray, num_shards: int):
