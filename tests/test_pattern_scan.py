@@ -1,0 +1,371 @@
+"""Stored composite patterns end-to-end (reference ``PatternScanTests``,
+``Pattern.scala:135-182``, ``LogicalOptimizer.scala:67``).
+
+A graph whose relationships are stored as (source, rel, target) TRIPLET
+tables answers ``MATCH (a)-[r]->(b)`` with ONE pattern scan — no joins; a
+NodeRel-stored graph collapses the source+rel side and keeps one join to
+the target."""
+
+import pytest
+
+from tpu_cypher import CypherSession
+from tpu_cypher.api import types as T
+from tpu_cypher.api.graph_pattern import (
+    NodePattern,
+    NodeRelPattern,
+    RelationshipPattern,
+    TripletPattern,
+)
+from tpu_cypher.api.mapping import (
+    MappingError,
+    NodeMappingBuilder,
+    RelationshipMappingBuilder,
+    node_rel_mapping,
+    triplet_mapping,
+)
+from tpu_cypher.relational.graphs import ElementTable
+from tpu_cypher.testing.bag import Bag
+
+
+def _nt(labels=frozenset()):
+    return T.CTNodeType(frozenset(labels))
+
+
+def _rt(types=frozenset()):
+    return T.CTRelationshipType(frozenset(types))
+
+
+class TestFindMapping:
+    def test_same_shape_supertype(self):
+        stored = TripletPattern(_nt({"Person"}), _rt({"KNOWS"}), _nt({"Person"}))
+        search = TripletPattern(_nt(), _rt({"KNOWS"}), _nt({"Person"}))
+        m = stored.find_mapping(search)
+        assert m == {
+            "source_node": "source_node",
+            "rel": "rel",
+            "target_node": "target_node",
+        }
+
+    def test_shape_mismatch(self):
+        stored = TripletPattern(_nt({"Person"}), _rt({"KNOWS"}), _nt({"Person"}))
+        assert stored.find_mapping(NodePattern(_nt())) is None
+        assert stored.find_mapping(RelationshipPattern(_rt({"KNOWS"}))) is None
+
+    def test_label_not_covered(self):
+        stored = TripletPattern(_nt({"Person"}), _rt({"KNOWS"}), _nt({"Person"}))
+        search = TripletPattern(_nt({"Robot"}), _rt({"KNOWS"}), _nt())
+        assert stored.find_mapping(search) is None
+
+    def test_untyped_rel_search_matches(self):
+        stored = NodeRelPattern(_nt({"Person"}), _rt({"KNOWS"}))
+        search = NodeRelPattern(_nt(), _rt())
+        assert stored.find_mapping(search) is not None
+
+    def test_exact(self):
+        stored = NodePattern(_nt({"Person"}))
+        assert stored.find_mapping(NodePattern(_nt({"Person"})), exact=True)
+        assert stored.find_mapping(NodePattern(_nt()), exact=True) is None
+
+
+class TestMappingValidation:
+    def test_triplet_requires_shared_columns(self):
+        n1 = NodeMappingBuilder.on("src").with_implied_label("P").build()
+        n2 = NodeMappingBuilder.on("dst").with_implied_label("P").build()
+        rel_bad = (
+            RelationshipMappingBuilder.on("rid")
+            .from_("other")
+            .to("dst")
+            .with_relationship_type("KNOWS")
+            .build()
+        )
+        with pytest.raises(MappingError):
+            triplet_mapping(n1, rel_bad, n2)
+
+    def test_node_rel_requires_shared_source(self):
+        n = NodeMappingBuilder.on("nid").with_implied_label("P").build()
+        rel_bad = (
+            RelationshipMappingBuilder.on("rid")
+            .from_("elsewhere")
+            .to("dst")
+            .with_relationship_type("KNOWS")
+            .build()
+        )
+        with pytest.raises(MappingError):
+            node_rel_mapping(n, rel_bad)
+
+
+def _triplet_graph(session):
+    """Nodes stored normally; KNOWS edges stored ONLY as a triplet table."""
+    t = session.table_cls
+    nodes = t.from_columns(
+        {"id": [1, 2, 3], "name": ["Alice", "Bob", "Carol"]}
+    )
+    nm = (
+        NodeMappingBuilder.on("id")
+        .with_implied_label("Person")
+        .with_property_key("name")
+        .build()
+    )
+    # one row per (source, rel, target): ids + both endpoint property sets
+    trip = t.from_columns(
+        {
+            "src": [1, 2, 1],
+            "src_name": ["Alice", "Bob", "Alice"],
+            "rid": [100, 101, 102],
+            "since": [2019, 2020, 2021],
+            "dst": [2, 3, 3],
+            "dst_name": ["Bob", "Carol", "Carol"],
+        }
+    )
+    tm = triplet_mapping(
+        NodeMappingBuilder.on("src")
+        .with_implied_label("Person")
+        .with_property_key("name", "src_name")
+        .build(),
+        RelationshipMappingBuilder.on("rid")
+        .from_("src")
+        .to("dst")
+        .with_relationship_type("KNOWS")
+        .with_property_key("since")
+        .build(),
+        NodeMappingBuilder.on("dst")
+        .with_implied_label("Person")
+        .with_property_key("name", "dst_name")
+        .build(),
+    )
+    return session.read_from(ElementTable(nm, nodes), ElementTable(tm, trip))
+
+
+def _node_rel_graph(session):
+    """Nodes co-stored with their outgoing edges (NodeRel) + a node table."""
+    t = session.table_cls
+    nodes = t.from_columns({"id": [1, 2, 3], "name": ["Alice", "Bob", "Carol"]})
+    nm = (
+        NodeMappingBuilder.on("id")
+        .with_implied_label("Person")
+        .with_property_key("name")
+        .build()
+    )
+    nr = t.from_columns(
+        {
+            "nid": [1, 2, 1],
+            "nname": ["Alice", "Bob", "Alice"],
+            "rid": [100, 101, 102],
+            "since": [2019, 2020, 2021],
+            "dst": [2, 3, 3],
+        }
+    )
+    nrm = node_rel_mapping(
+        NodeMappingBuilder.on("nid")
+        .with_implied_label("Person")
+        .with_property_key("name", "nname")
+        .build(),
+        RelationshipMappingBuilder.on("rid")
+        .from_("nid")
+        .to("dst")
+        .with_relationship_type("KNOWS")
+        .with_property_key("since")
+        .build(),
+    )
+    return session.read_from(ElementTable(nm, nodes), ElementTable(nrm, nr))
+
+
+@pytest.fixture(params=["local", "tpu"])
+def session(request):
+    return getattr(CypherSession, request.param)()
+
+
+EXPECTED_EDGES = Bag(
+    [
+        {"a.name": "Alice", "r.since": 2019, "b.name": "Bob"},
+        {"a.name": "Bob", "r.since": 2020, "b.name": "Carol"},
+        {"a.name": "Alice", "r.since": 2021, "b.name": "Carol"},
+    ]
+)
+
+
+class TestTripletStoredGraph:
+    def test_expand_answers_from_triplet(self, session):
+        g = _triplet_graph(session)
+        r = g.cypher(
+            "MATCH (a:Person)-[r:KNOWS]->(b:Person) "
+            "RETURN a.name, r.since, b.name"
+        )
+        assert r.records.to_bag() == EXPECTED_EDGES
+
+    def test_single_scan_no_join(self, session):
+        g = _triplet_graph(session)
+        r = g.cypher(
+            "MATCH (a:Person)-[r:KNOWS]->(b:Person) RETURN a.name, b.name"
+        )
+        plans = r.plans
+        assert "PatternScan" in plans
+        assert "JoinOp" not in plans.split("Relational plan")[-1].split(
+            "PatternScan"
+        )[0], plans
+
+    def test_chain_joins_pattern_scans(self, session):
+        g = _triplet_graph(session)
+        r = g.cypher(
+            "MATCH (a:Person)-[:KNOWS]->(b:Person)-[:KNOWS]->(c:Person) "
+            "RETURN a.name, c.name"
+        )
+        assert r.records.to_bag() == Bag([{"a.name": "Alice", "c.name": "Carol"}])
+        assert "PatternScan" in r.plans
+
+    def test_where_and_aggregate_through_pattern_scan(self, session):
+        g = _triplet_graph(session)
+        r = g.cypher(
+            "MATCH (a:Person)-[r:KNOWS]->(b:Person) WHERE r.since >= 2020 "
+            "RETURN b.name, count(*) AS c ORDER BY b.name"
+        )
+        assert [dict(x) for x in r.records.collect()] == [
+            {"b.name": "Carol", "c": 2}
+        ]
+
+    def test_filtered_label_not_stored_falls_back_empty(self, session):
+        g = _triplet_graph(session)
+        r = g.cypher("MATCH (a:Robot)-[r:KNOWS]->(b) RETURN a")
+        assert r.records.collect() == []
+
+
+class TestNodeRelStoredGraph:
+    def test_expand_answers_from_node_rel(self, session):
+        g = _node_rel_graph(session)
+        r = g.cypher(
+            "MATCH (a:Person)-[r:KNOWS]->(b:Person) "
+            "RETURN a.name, r.since, b.name"
+        )
+        assert r.records.to_bag() == EXPECTED_EDGES
+
+    def test_plan_uses_pattern_scan(self, session):
+        g = _node_rel_graph(session)
+        assert "PatternScan" in g.cypher(
+            "MATCH (a:Person)-[r:KNOWS]->(b:Person) RETURN a.name"
+        ).plans
+
+
+class TestGraphPatternsProperty:
+    def test_scan_graph_reports_stored_patterns(self, session):
+        g = _triplet_graph(session)
+        pats = g._graph.patterns
+        assert any(isinstance(p, TripletPattern) for p in pats)
+        assert any(isinstance(p, NodePattern) for p in pats)
+
+
+class TestCompositeCorrectnessBeyondTheRewrite:
+    """Query shapes the rewrite does NOT cover must still see composite-stored
+    relationships (the rel sub-mapping extracts a plain relationship scan)."""
+
+    @pytest.mark.parametrize(
+        "query,expected",
+        [
+            (
+                "MATCH (a:Person)-[r:KNOWS]-(b:Person) RETURN count(*) AS c",
+                [{"c": 6}],  # undirected: each of 3 edges twice
+            ),
+            (
+                "MATCH (a)<-[r:KNOWS]-(b) RETURN count(*) AS c",
+                [{"c": 3}],
+            ),
+            (
+                # edges 1->2, 2->3, 1->3: three 1-hop walks + one 2-hop
+                "MATCH (a:Person)-[:KNOWS*1..2]->(b) RETURN count(*) AS walks",
+                [{"walks": 4}],
+            ),
+            (
+                "MATCH (a)-[r1:KNOWS]->(b), (a)-[r2:KNOWS]->(b) RETURN count(*) AS c",
+                [{"c": 3}],
+            ),
+        ],
+    )
+    def test_non_rewritten_shapes(self, session, query, expected):
+        g = _triplet_graph(session)
+        assert [dict(r) for r in g.cypher(query).records.collect()] == expected
+
+    def test_union_graph_keeps_composite_edges(self, session):
+        g = _triplet_graph(session)
+        u = g.union(_triplet_graph(session))
+        r = u.cypher(
+            "MATCH (a:Person)-[r:KNOWS]->(b:Person) RETURN count(*) AS c"
+        )
+        assert [dict(x) for x in r.records.collect()] == [{"c": 6}]
+
+
+class TestRewriteSoundnessVetoes:
+    """The PatternScan rewrite must NOT fire when it would change results."""
+
+    def test_edges_split_across_plain_and_triplet(self, session):
+        t = session.table_cls
+        nodes = t.from_columns({"id": [1, 2, 3, 4], "name": ["A", "B", "C", "D"]})
+        nm = (
+            NodeMappingBuilder.on("id")
+            .with_implied_label("Person")
+            .with_property_key("name")
+            .build()
+        )
+        trip = t.from_columns(
+            {"src": [1], "sn": ["A"], "rid": [100], "dst": [2], "dn": ["B"]}
+        )
+        tm = triplet_mapping(
+            NodeMappingBuilder.on("src")
+            .with_implied_label("Person")
+            .with_property_key("name", "sn")
+            .build(),
+            RelationshipMappingBuilder.on("rid")
+            .from_("src")
+            .to("dst")
+            .with_relationship_type("KNOWS")
+            .build(),
+            NodeMappingBuilder.on("dst")
+            .with_implied_label("Person")
+            .with_property_key("name", "dn")
+            .build(),
+        )
+        plain_rel = t.from_columns({"rid": [200], "s": [3], "t": [4]})
+        rm = (
+            RelationshipMappingBuilder.on("rid")
+            .from_("s")
+            .to("t")
+            .with_relationship_type("KNOWS")
+            .build()
+        )
+        g = session.read_from(
+            ElementTable(nm, nodes),
+            ElementTable(tm, trip),
+            ElementTable(rm, plain_rel),
+        )
+        r = g.cypher(
+            "MATCH (a:Person)-[r:KNOWS]->(b:Person) RETURN a.name, b.name"
+        )
+        assert r.records.to_bag() == Bag(
+            [{"a.name": "A", "b.name": "B"}, {"a.name": "C", "b.name": "D"}]
+        )
+        assert "PatternScan" not in r.plans  # veto: plain rel table exists
+
+    def test_uncovered_node_property_vetoes_rewrite(self, session):
+        t = session.table_cls
+        # node table carries 'name'; the triplet's node sub-mappings do NOT
+        nodes = t.from_columns({"id": [1, 2], "name": ["A", "B"]})
+        nm = (
+            NodeMappingBuilder.on("id")
+            .with_implied_label("Person")
+            .with_property_key("name")
+            .build()
+        )
+        trip = t.from_columns({"src": [1], "rid": [100], "dst": [2]})
+        tm = triplet_mapping(
+            NodeMappingBuilder.on("src").with_implied_label("Person").build(),
+            RelationshipMappingBuilder.on("rid")
+            .from_("src")
+            .to("dst")
+            .with_relationship_type("KNOWS")
+            .build(),
+            NodeMappingBuilder.on("dst").with_implied_label("Person").build(),
+        )
+        g = session.read_from(ElementTable(nm, nodes), ElementTable(tm, trip))
+        r = g.cypher(
+            "MATCH (a:Person)-[r:KNOWS]->(b:Person) RETURN a.name, b.name"
+        )
+        assert r.records.to_bag() == Bag([{"a.name": "A", "b.name": "B"}])
+        assert "PatternScan" not in r.plans  # veto: property not covered

@@ -35,6 +35,12 @@ class NodeMapping:
             + tuple(c for _, c in self.property_mapping)
         )
 
+    def pattern(self):
+        from .graph_pattern import NodePattern
+        from .types import CTNodeType
+
+        return NodePattern(CTNodeType(frozenset(self.implied_labels)))
+
 
 @dataclass(frozen=True)
 class RelationshipMapping:
@@ -49,6 +55,12 @@ class RelationshipMapping:
         return (self.id_key, self.source_key, self.target_key) + tuple(
             c for _, c in self.property_mapping
         )
+
+    def pattern(self):
+        from .graph_pattern import RelationshipPattern
+        from .types import CTRelationshipType
+
+        return RelationshipPattern(CTRelationshipType(frozenset({self.rel_type})))
 
 
 class NodeMappingBuilder:
@@ -160,3 +172,98 @@ def validate_relationship_mapping(m: RelationshipMapping):
     prop_cols = [c for _, c in m.property_mapping]
     if set(prop_cols) & ids:
         raise MappingError("Property columns overlap id/source/target columns")
+
+
+# -- composite (stored-pattern) mappings ------------------------------------
+#
+# Reference: ``ElementMapping`` generalized over a ``Pattern``
+# (``ElementMapping.scala:53`` + ``Pattern.scala:135-182``). A composite
+# table stores several elements per row: NodeRel = a node plus one of its
+# outgoing relationships; Triplet = (source node, relationship, target node).
+
+
+@dataclass(frozen=True)
+class NodeRelMapping:
+    """One table row = one (node, outgoing relationship) pair."""
+
+    node: NodeMapping
+    relationship: RelationshipMapping
+
+    @property
+    def all_columns(self) -> Tuple[str, ...]:
+        seen = dict.fromkeys(self.node.all_columns + self.relationship.all_columns)
+        return tuple(seen)
+
+    def pattern(self):
+        from .graph_pattern import NodeRelPattern
+        from .types import CTNodeType, CTRelationshipType
+
+        return NodeRelPattern(
+            CTNodeType(frozenset(self.node.implied_labels)),
+            CTRelationshipType(frozenset({self.relationship.rel_type})),
+        )
+
+
+@dataclass(frozen=True)
+class TripletMapping:
+    """One table row = one full (source)-[rel]->(target) triplet."""
+
+    source: NodeMapping
+    relationship: RelationshipMapping
+    target: NodeMapping
+
+    @property
+    def all_columns(self) -> Tuple[str, ...]:
+        seen = dict.fromkeys(
+            self.source.all_columns
+            + self.relationship.all_columns
+            + self.target.all_columns
+        )
+        return tuple(seen)
+
+    def pattern(self):
+        from .graph_pattern import TripletPattern
+        from .types import CTNodeType, CTRelationshipType
+
+        return TripletPattern(
+            CTNodeType(frozenset(self.source.implied_labels)),
+            CTRelationshipType(frozenset({self.relationship.rel_type})),
+            CTNodeType(frozenset(self.target.implied_labels)),
+        )
+
+
+def validate_node_rel_mapping(m: NodeRelMapping):
+    if m.relationship.source_key != m.node.id_key:
+        raise MappingError(
+            "NodeRel mapping: the relationship's source column must be the "
+            f"node id column ({m.relationship.source_key!r} != {m.node.id_key!r})"
+        )
+
+
+def validate_triplet_mapping(m: TripletMapping):
+    if m.relationship.source_key != m.source.id_key:
+        raise MappingError(
+            "Triplet mapping: relationship source column must be the source "
+            f"node id column ({m.relationship.source_key!r} != {m.source.id_key!r})"
+        )
+    if m.relationship.target_key != m.target.id_key:
+        raise MappingError(
+            "Triplet mapping: relationship target column must be the target "
+            f"node id column ({m.relationship.target_key!r} != {m.target.id_key!r})"
+        )
+    if m.source.id_key == m.target.id_key:
+        raise MappingError("Triplet mapping: source and target id columns collide")
+
+
+def node_rel_mapping(node: NodeMapping, relationship: RelationshipMapping) -> NodeRelMapping:
+    m = NodeRelMapping(node, relationship)
+    validate_node_rel_mapping(m)
+    return m
+
+
+def triplet_mapping(
+    source: NodeMapping, relationship: RelationshipMapping, target: NodeMapping
+) -> TripletMapping:
+    m = TripletMapping(source, relationship, target)
+    validate_triplet_mapping(m)
+    return m

@@ -129,15 +129,21 @@ class NodeScan(UnaryOp):
 
 @dataclass(frozen=True)
 class PatternScan(UnaryOp):
-    """Scan a stored composite pattern (NodeRel / Triplet) — used when the
-    optimizer recognises a stored pattern (``LogicalOptimizer.scala:67``)."""
+    """Scan a stored composite pattern (NodeRel / Triplet): one table scan
+    binds several query fields at once. Produced by the optimizer rule
+    ``replace_scans_with_recognized_patterns``
+    (``LogicalOptimizer.scala:67``, ``Pattern.scala:135-182``)."""
 
-    binds: FieldsT  # all fields bound by the stored pattern
-    pattern_key: str  # identifies the stored pattern shape
+    binds: FieldsT  # all fields bound by the stored pattern, entity order
+    entity_map: Tuple[Tuple[str, str], ...]  # (pattern entity name, field)
+    pattern: object = None  # the search GraphPattern (frozen, hashable)
 
     @property
     def fields(self) -> FieldsT:
         return self.in_op.fields + self.binds
+
+    def _show_inner(self) -> str:
+        return ", ".join(f"{e}={f}" for e, f in self.entity_map)
 
 
 @dataclass(frozen=True)

@@ -14,7 +14,13 @@ import itertools
 from typing import Dict, List, Optional, Sequence, Tuple, Union
 
 from ..api import types as T
-from ..api.mapping import NodeMapping, RelationshipMapping
+from ..api.graph_pattern import GraphPattern
+from ..api.mapping import (
+    NodeMapping,
+    NodeRelMapping,
+    RelationshipMapping,
+    TripletMapping,
+)
 from ..api.schema import PropertyGraphSchema
 from ..api.table import Table
 from ..ir import expr as E
@@ -31,7 +37,38 @@ from .ops import (
     UnionAllOp,
 )
 
-ElementMappingT = Union[NodeMapping, RelationshipMapping]
+ElementMappingT = Union[
+    NodeMapping, RelationshipMapping, NodeRelMapping, TripletMapping
+]
+
+
+def _element_alignment(m, e: E.Expr, col: str, pairs, consts) -> None:
+    """Dispatch ONE target header expression for ONE element mapping onto
+    (source column -> target column) pairs or constant columns — the single
+    copy of the alignment rules shared by node scans, relationship scans and
+    composite pattern scans (reference ``RelationalPlanner.alignWith``)."""
+    if isinstance(e, E.Id):
+        pairs.append((m.id_key, col))
+    elif isinstance(e, E.StartNode):
+        pairs.append((m.source_key, col))
+    elif isinstance(e, E.EndNode):
+        pairs.append((m.target_key, col))
+    elif isinstance(e, E.HasType):
+        consts.append((E.Lit(e.rel_type == m.rel_type), col))
+    elif isinstance(e, E.HasLabel):
+        opt = dict(m.optional_labels)
+        if e.label in m.implied_labels:
+            consts.append((E.Lit(True), col))
+        elif e.label in opt:
+            pairs.append((opt[e.label], col))
+        else:
+            consts.append((E.Lit(False), col))
+    elif isinstance(e, E.Property):
+        props = dict(m.property_mapping)
+        if e.key in props:
+            pairs.append((props[e.key], col))
+        else:
+            consts.append((E.Lit(None), col))
 
 
 class ElementTable:
@@ -51,9 +88,38 @@ class ElementTable:
     def is_node(self) -> bool:
         return isinstance(self.mapping, NodeMapping)
 
+    @property
+    def is_composite(self) -> bool:
+        return isinstance(self.mapping, (NodeRelMapping, TripletMapping))
+
+    def pattern(self) -> GraphPattern:
+        """The stored pattern this table answers (reference
+        ``ElementMapping.pattern``)."""
+        return self.mapping.pattern()
+
     def schema(self) -> PropertyGraphSchema:
         """Schema contributed by this table (reference ``ElementTable.schema``)."""
         m = self.mapping
+        if isinstance(m, NodeRelMapping):
+            return self._sub_schema(m.node) + self._sub_schema(m.relationship)
+        if isinstance(m, TripletMapping):
+            s = (
+                self._sub_schema(m.source)
+                + self._sub_schema(m.relationship)
+                + self._sub_schema(m.target)
+            )
+            from ..api.schema import SchemaPattern
+
+            return s.with_schema_patterns(
+                SchemaPattern(
+                    m.source.implied_labels,
+                    m.relationship.rel_type,
+                    m.target.implied_labels,
+                )
+            )
+        return self._sub_schema(m)
+
+    def _sub_schema(self, m) -> PropertyGraphSchema:
         prop_types = {
             key: self.table.column_type(col).nullable
             for key, col in m.property_mapping
@@ -81,6 +147,25 @@ class RelationalCypherGraph:
         self, var_name: str, ct: T.CypherType, ctx: RelationalRuntimeContext
     ) -> RelationalOperator:
         raise NotImplementedError
+
+    @property
+    def patterns(self) -> frozenset:
+        """Stored patterns this graph can answer with ONE scan (reference
+        ``RelationalCypherGraph.patterns`` / ``ScanGraph.scala:105``)."""
+        return frozenset()
+
+    def supports_pattern_rewrite(self, search) -> bool:
+        """True when replacing an Expand of ``search``'s shape with a
+        PatternScan is GUARANTEED bag-equivalent to the classic plan."""
+        return False
+
+    def pattern_scan_op(
+        self,
+        entity_fields,  # ((entity name, field name, CypherType), ...)
+        search,  # GraphPattern
+        ctx: RelationalRuntimeContext,
+    ) -> RelationalOperator:
+        raise NotImplementedError(f"{type(self).__name__} stores no patterns")
 
     # -- convenience -------------------------------------------------------
 
@@ -110,6 +195,7 @@ class ScanGraph(RelationalCypherGraph):
         schema: Optional[PropertyGraphSchema] = None,
     ):
         self.scans = list(scans)
+        self._patterns = None
         if schema is None:
             schema = PropertyGraphSchema.empty()
             for s in self.scans:
@@ -131,7 +217,7 @@ class ScanGraph(RelationalCypherGraph):
         required = set(ct.labels)
         aligned: List[RelationalOperator] = []
         for et in self.scans:
-            if not et.is_node:
+            if not et.is_node or et.is_composite:
                 continue
             m: NodeMapping = et.mapping
             available = m.implied_labels | {l for l, _ in m.optional_labels}
@@ -145,31 +231,16 @@ class ScanGraph(RelationalCypherGraph):
     ) -> RelationalOperator:
         m: NodeMapping = et.mapping
         opt = dict(m.optional_labels)
-        props = dict(m.property_mapping)
         t = et.table
         # filter rows lacking a required-but-optional label
         need_filter = [opt[l] for l in required if l in opt and l not in m.implied_labels]
-        rename: Dict[str, str] = {}
+        pairs: List[Tuple[str, str]] = []
         consts: List[Tuple[E.Expr, str]] = []
         for e in target.expressions:
-            col = target.column(e)
-            if isinstance(e, E.Id):
-                rename[m.id_key] = col
-            elif isinstance(e, E.HasLabel):
-                if e.label in m.implied_labels:
-                    consts.append((E.Lit(True), col))
-                elif e.label in opt:
-                    rename[opt[e.label]] = col
-                else:
-                    consts.append((E.Lit(False), col))
-            elif isinstance(e, E.Property):
-                if e.key in props:
-                    rename[props[e.key]] = col
-                else:
-                    consts.append((E.Lit(None), col))
+            _element_alignment(m, e, target.column(e), pairs, consts)
         for c in need_filter:
             t = t.filter(E.Var(c).with_type(T.CTBoolean), _col_header(c), {})
-        t = t.select([c for c in rename]).rename(rename)
+        t = t.project(pairs)
         if consts:
             t = t.with_columns(consts, None, {})
         t = t.select(target.columns)
@@ -181,36 +252,145 @@ class ScanGraph(RelationalCypherGraph):
         wanted = ct.types or self.schema.relationship_types
         aligned: List[RelationalOperator] = []
         for et in self.scans:
-            if et.is_node:
+            if et.is_node and not et.is_composite:
                 continue
-            m: RelationshipMapping = et.mapping
+            # composite tables store exactly ONE relationship per row: the
+            # rel sub-mapping extracts a plain relationship scan (keeps every
+            # query shape correct even when edges live only in composites)
+            m = et.mapping.relationship if et.is_composite else et.mapping
             if m.rel_type not in wanted:
                 continue
-            props = dict(m.property_mapping)
             t = et.table
             pairs: List[Tuple[str, str]] = []
             consts: List[Tuple[E.Expr, str]] = []
             for e in target.expressions:
-                col = target.column(e)
-                if isinstance(e, E.Id):
-                    pairs.append((m.id_key, col))
-                elif isinstance(e, E.StartNode):
-                    pairs.append((m.source_key, col))
-                elif isinstance(e, E.EndNode):
-                    pairs.append((m.target_key, col))
-                elif isinstance(e, E.HasType):
-                    consts.append((E.Lit(e.rel_type == m.rel_type), col))
-                elif isinstance(e, E.Property):
-                    if e.key in props:
-                        pairs.append((props[e.key], col))
-                    else:
-                        consts.append((E.Lit(None), col))
+                _element_alignment(m, e, target.column(e), pairs, consts)
             t = t.project(pairs)
             if consts:
                 t = t.with_columns(consts, None, {})
             t = t.select(target.columns)
             aligned.append(TableOp(self, ctx, target, t))
         return self._union(aligned, target, ctx)
+
+    # -- stored composite patterns (reference ScanGraph.scala:59-110) ----
+
+    @property
+    def patterns(self) -> frozenset:
+        if self._patterns is None:
+            self._patterns = frozenset(et.pattern() for et in self.scans)
+        return self._patterns
+
+    def supports_pattern_rewrite(self, search) -> bool:
+        """The rewrite is bag-equivalent iff (a) some composite tables embed
+        the search, (b) EVERY table contributing relationships of the
+        searched types is one of them (edges split across plain rel tables
+        or other-shape composites would silently vanish), (c) the stored
+        node label sets are exact in the schema (no combo strictly extends
+        them — otherwise HasLabel columns lie), and (d) the composite
+        sub-mappings cover every schema property of their elements
+        (uncovered properties would flip from values to nulls)."""
+        matching = [
+            et
+            for et in self.scans
+            if et.is_composite and et.pattern().find_mapping(search) is not None
+        ]
+        if not matching:
+            return False
+        rel_ct = search.rel_type
+        searched = set(rel_ct.types) if rel_ct.types else None  # None = any
+        for et in self.scans:
+            if et.is_node and not et.is_composite:
+                continue
+            m = et.mapping.relationship if et.is_composite else et.mapping
+            contributes = searched is None or m.rel_type in searched
+            if contributes and all(et is not x for x in matching):
+                return False
+        combos = self.schema.label_combinations
+        def label_exact(implied) -> bool:
+            i = frozenset(implied)
+            return not any(i < frozenset(c) for c in combos)
+        for et in matching:
+            cm = et.mapping
+            node_subs = (
+                [cm.source, cm.target]
+                if isinstance(cm, TripletMapping)
+                else [cm.node]
+            )
+            for nm_ in node_subs:
+                if not label_exact(nm_.implied_labels):
+                    return False
+                want = set(self.schema.node_property_keys(nm_.implied_labels) or {})
+                if not want <= {k for k, _ in nm_.property_mapping}:
+                    return False
+            rm_ = cm.relationship
+            want = set(self.schema.relationship_property_keys(rm_.rel_type) or {})
+            if not want <= {k for k, _ in rm_.property_mapping}:
+                return False
+        return True
+
+    def pattern_scan_op(self, entity_fields, search, ctx) -> RelationalOperator:
+        """One scan answering a whole stored pattern: selects the composite
+        tables whose stored pattern embeds ``search`` (``find_mapping``),
+        aligns each to the target header and unions
+        (reference ``ScanGraph.scanOperator`` + ``scansForType``)."""
+        target = RecordHeader()
+        for _, field, ct in entity_fields:
+            m = ct.material if hasattr(ct, "material") else ct
+            if isinstance(m, T.CTNodeType):
+                target = header_for_node(field, m, self.schema, target)
+            else:
+                target = header_for_relationship(field, m, self.schema, target)
+        aligned: List[RelationalOperator] = []
+        for et in self.scans:
+            if not et.is_composite:
+                continue
+            embedding = et.pattern().find_mapping(search)
+            if embedding is None:
+                continue
+            aligned.append(
+                self._align_composite(et, entity_fields, target, ctx)
+            )
+        return self._union(aligned, target, ctx)
+
+    def _align_composite(
+        self, et: ElementTable, entity_fields, target: RecordHeader, ctx
+    ) -> RelationalOperator:
+        """Rename/derive the composite table's columns onto the target
+        header — one pass over all bound elements of the single table (the
+        reference folds per-element ``alignWith`` calls instead)."""
+        cm = et.mapping
+        sub: Dict[str, object] = {}
+        if isinstance(cm, TripletMapping):
+            from ..api.graph_pattern import REL_ENTITY, SOURCE_ENTITY, TARGET_ENTITY
+
+            sub = {
+                SOURCE_ENTITY: cm.source,
+                REL_ENTITY: cm.relationship,
+                TARGET_ENTITY: cm.target,
+            }
+        else:
+            from ..api.graph_pattern import NODE_ENTITY, REL_ENTITY
+
+            sub = {NODE_ENTITY: cm.node, REL_ENTITY: cm.relationship}
+        field_to_sub: Dict[str, object] = {}
+        field_to_ct: Dict[str, object] = {}
+        for entity, field, ct in entity_fields:
+            field_to_sub[field] = sub[entity]
+            field_to_ct[field] = ct.material if hasattr(ct, "material") else ct
+        t = et.table
+        pairs: List[Tuple[str, str]] = []
+        consts: List[Tuple[E.Expr, str]] = []
+        for e in target.expressions:
+            col = target.column(e)
+            owner = getattr(getattr(e, "expr", None), "name", None)
+            if owner is None or owner not in field_to_sub:
+                continue
+            _element_alignment(field_to_sub[owner], e, col, pairs, consts)
+        t = t.project(pairs)
+        if consts:
+            t = t.with_columns(consts, None, {})
+        t = t.select(target.columns)
+        return TableOp(self, ctx, target, t)
 
     def _union(
         self, ops: List[RelationalOperator], header: RecordHeader, ctx
@@ -234,6 +414,20 @@ class PrefixedGraph(RelationalCypherGraph):
 
     def scan_operator(self, var_name, ct, ctx) -> RelationalOperator:
         op = self.graph.scan_operator(var_name, ct, ctx)
+        return self._prefixed(op, ctx)
+
+    @property
+    def patterns(self) -> frozenset:
+        return self.graph.patterns
+
+    def supports_pattern_rewrite(self, search) -> bool:
+        return self.graph.supports_pattern_rewrite(search)
+
+    def pattern_scan_op(self, entity_fields, search, ctx) -> RelationalOperator:
+        op = self.graph.pattern_scan_op(entity_fields, search, ctx)
+        return self._prefixed(op, ctx)
+
+    def _prefixed(self, op: RelationalOperator, ctx) -> RelationalOperator:
         h = op.header
         items: List[Tuple[E.Expr, str]] = []
         for e in h.expressions:

@@ -115,6 +115,20 @@ class RelationalPlanner:
             return JoinOp(in_plan, scan, [], "cross")
         return scan
 
+    def _plan_PatternScan(self, op: L.PatternScan) -> RelationalOperator:
+        """One scan binding every field of a stored composite pattern
+        (reference ``RelationalPlanner`` PatternScan case + ``ScanGraph
+        .scanOperator``); no joins — the point of the rewrite."""
+        in_plan = self.process(op.in_op)
+        by_field = dict(op.binds)
+        entity_fields = tuple(
+            (entity, field, by_field[field]) for entity, field in op.entity_map
+        )
+        scan = in_plan.graph.pattern_scan_op(entity_fields, op.pattern, self.ctx)
+        if in_plan.header.expressions:
+            return JoinOp(in_plan, scan, [], "cross")
+        return scan
+
     # -- unary ----------------------------------------------------------
 
     def _plan_Filter(self, op: L.Filter) -> RelationalOperator:

@@ -224,6 +224,21 @@ class CypherSession:
             self._resolve_qgn, dict(parameters or {}), self.table_cls
         )
 
+    def _graph_patterns(self) -> Dict[str, Any]:
+        """qgn -> graph, for the optimizer's
+        ``replace_scans_with_recognized_patterns`` — the graph carries both
+        its stored patterns and the bag-equivalence check
+        (``supports_pattern_rewrite``). Only resolved graphs: pattern
+        metadata is not worth forcing a source load."""
+        out: Dict[str, Any] = {}
+        for qgn, g in self._catalog.items():
+            if any(
+                type(p).__name__ in ("NodeRelPattern", "TripletPattern")
+                for p in g.patterns
+            ):
+                out[qgn] = g
+        return out
+
     def _catalog_schemas(self) -> Dict[str, Any]:
         """qgn -> schema for every known graph; source-backed graphs resolve
         their schema lazily on first access (stored schema JSON — no full
@@ -331,6 +346,7 @@ class CypherSession:
             self._catalog[ambient_qgn].schema,
             schemas if schemas is not None else self._catalog_schemas(),
             ambient_qgn,
+            self._graph_patterns(),
         )
         rctx = self._runtime_context(parameters)
         relational = time_stage(
