@@ -1009,3 +1009,121 @@ class TestNamedPaths:
         (row,) = list(rows.counter)
         assert [n.properties.get("n") for n in row["ns"]] == [1, 2, 3]
         assert set(row["ns"][1].labels) == {"B"}
+
+
+class TestViews:
+    """Parameterized views are callable: FROM GRAPH view(args) re-plans the
+    stored text with graph parameters substituted and caches per argument
+    tuple (reference RelationalCypherSession.scala:185-187,
+    CypherCatalog.scala)."""
+
+    def test_view_invocation(self, session):
+        g = init_graph(
+            session,
+            "CREATE (:Person {name:'Alice', age:23}), (:Person {name:'Bob', age:42})",
+        )
+        session.store_graph("people", g)
+        session.cypher(
+            "CATALOG CREATE VIEW adults($g) { FROM GRAPH $g "
+            "MATCH (p:Person) WHERE p.age >= 30 "
+            "CONSTRUCT NEW (:Adult {name: p.name}) RETURN GRAPH }"
+        )
+        r = session.cypher(
+            "FROM GRAPH adults(people) MATCH (a:Adult) RETURN a.name"
+        )
+        assert r.records.to_bag() == Bag([{"a.name": "Bob"}])
+
+    def test_view_cached_per_args_and_invalidated_on_drop(self, session):
+        g = init_graph(session, "CREATE (:X {v: 1})")
+        session.store_graph("gx", g)
+        session.cypher(
+            "CATALOG CREATE VIEW keep($g) { FROM GRAPH $g MATCH (n:X) "
+            "CONSTRUCT NEW (:Y {v: n.v}) RETURN GRAPH }"
+        )
+        def keep_entries():
+            return [k for k in session._view_cache if k[0] == "keep"]
+
+        r1 = session.cypher("FROM GRAPH keep(gx) MATCH (y:Y) RETURN y.v")
+        assert r1.records.to_bag() == Bag([{"y.v": 1}])
+        # cached: same mounted qgn reused
+        assert len(keep_entries()) == 1
+        session.cypher("FROM GRAPH keep(gx) MATCH (y:Y) RETURN y.v")
+        assert len(keep_entries()) == 1
+        session.cypher("CATALOG DROP VIEW keep")
+        assert len(keep_entries()) == 0
+        import pytest as _pytest
+
+        with _pytest.raises(Exception):
+            session.cypher("FROM GRAPH keep(gx) MATCH (y:Y) RETURN y.v")
+
+    def test_view_wrong_arity(self, session):
+        session.cypher(
+            "CATALOG CREATE VIEW two($a, $b) { FROM GRAPH $a RETURN GRAPH }"
+        )
+        import pytest as _pytest
+
+        with _pytest.raises(Exception, match="argument"):
+            session.cypher("FROM GRAPH two(one) RETURN 1 AS x")
+
+    def test_view_value_parameters_pass_through(self, session):
+        g = init_graph(session, "CREATE (:X {v: 1}), (:X {v: 5})")
+        session.store_graph("gpv", g)
+        session.cypher(
+            "CATALOG CREATE VIEW big($g) { FROM GRAPH $g MATCH (n:X) "
+            "WHERE n.v >= $minv CONSTRUCT NEW (:Y {v: n.v}) RETURN GRAPH }"
+        )
+        r = session.cypher(
+            "FROM GRAPH big(gpv) MATCH (y:Y) RETURN y.v", {"minv": 3}
+        )
+        assert r.records.to_bag() == Bag([{"y.v": 5}])
+        # different parameter value -> different cached execution
+        r2 = session.cypher(
+            "FROM GRAPH big(gpv) MATCH (y:Y) RETURN y.v", {"minv": 0}
+        )
+        assert r2.records.to_bag() == Bag([{"y.v": 1}, {"y.v": 5}])
+
+    def test_view_invalidated_when_arg_graph_replaced(self, session):
+        g1 = init_graph(session, "CREATE (:X {v: 1})")
+        session.store_graph("gswap", g1)
+        session.cypher(
+            "CATALOG CREATE VIEW snap($g) { FROM GRAPH $g MATCH (n:X) "
+            "CONSTRUCT NEW (:Y {v: n.v}) RETURN GRAPH }"
+        )
+        r1 = session.cypher("FROM GRAPH snap(gswap) MATCH (y:Y) RETURN y.v")
+        assert r1.records.to_bag() == Bag([{"y.v": 1}])
+        g2 = init_graph(session, "CREATE (:X {v: 99})")
+        session.store_graph("gswap", g2)
+        r2 = session.cypher("FROM GRAPH snap(gswap) MATCH (y:Y) RETURN y.v")
+        assert r2.records.to_bag() == Bag([{"y.v": 99}])
+
+    def test_dollar_inside_string_literal_untouched(self, session):
+        g = init_graph(session, "CREATE (:X {tag: '$g'})")
+        session.store_graph("glit", g)
+        session.cypher(
+            "CATALOG CREATE VIEW lit($g) { FROM GRAPH $g MATCH (n:X) "
+            "WHERE n.tag = '$g' CONSTRUCT NEW (:Y {t: n.tag}) RETURN GRAPH }"
+        )
+        r = session.cypher("FROM GRAPH lit(glit) MATCH (y:Y) RETURN y.t")
+        assert r.records.to_bag() == Bag([{"y.t": "$g"}])
+
+    def test_recursive_view_raises(self, session):
+        g = init_graph(session, "CREATE (:X)")
+        session.store_graph("grec", g)
+        session.cypher(
+            "CATALOG CREATE VIEW rec($g) { FROM GRAPH rec($g) RETURN GRAPH }"
+        )
+        import pytest as _pytest
+
+        with _pytest.raises(Exception, match="[Rr]ecursive"):
+            session.cypher("FROM GRAPH rec(grec) MATCH (n) RETURN n")
+
+    def test_graph_wins_over_same_named_view_for_bare_name(self, session):
+        g = init_graph(session, "CREATE (:X {v: 7})")
+        session.store_graph("dual", g)
+        session.cypher(
+            "CATALOG CREATE VIEW dual { FROM GRAPH session.dual "
+            "CONSTRUCT NEW (:Y) RETURN GRAPH }"
+        )
+        # bare FROM GRAPH dual still reads the stored GRAPH, not the view
+        r = session.cypher("FROM GRAPH dual MATCH (n:X) RETURN n.v")
+        assert r.records.to_bag() == Bag([{"n.v": 7}])

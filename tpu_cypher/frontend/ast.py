@@ -148,9 +148,11 @@ class Return(ProjectionClause):
 
 @dataclass(frozen=True)
 class FromGraph(Clause):
-    """FROM GRAPH <qualified name> (multiple-graph support)."""
+    """FROM GRAPH <qualified name> or a parameterized VIEW invocation
+    ``FROM GRAPH v(g1, g2)`` (multiple-graph support)."""
 
     graph_name: str
+    args: Tuple[str, ...] = ()
 
 
 @dataclass(frozen=True)

@@ -231,7 +231,15 @@ class Parser:
         if self.at_kw("FROM"):
             self.next()
             self.try_kw("GRAPH")
-            return A.FromGraph(self.parse_qgn())
+            name = self.parse_qgn()
+            args: List[str] = []
+            if self.try_sym("("):
+                # parameterized view invocation: FROM GRAPH v(g1, g2)
+                while not self.at_sym(")"):
+                    args.append(self.parse_qgn())
+                    self.try_sym(",")
+                self.eat_sym(")")
+            return A.FromGraph(name, tuple(args))
         if self.at_kw("CONSTRUCT"):
             self.next()
             return self.parse_construct()

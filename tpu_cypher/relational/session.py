@@ -42,6 +42,44 @@ class CatalogError(Exception):
     pass
 
 
+def _substitute_graph_params(body: str, mapping: Dict[str, str]) -> str:
+    """Replace ``$param`` graph references in view body TEXT with argument
+    QGNs — quote-aware (occurrences inside '...'/"..."/`...` literals are
+    left alone) and without regex replacement-escape pitfalls."""
+    out: List[str] = []
+    i, n = 0, len(body)
+    quote: Optional[str] = None
+    while i < n:
+        ch = body[i]
+        if quote is not None:
+            out.append(ch)
+            if ch == "\\" and quote in "'\"" and i + 1 < n:
+                out.append(body[i + 1])
+                i += 2
+                continue
+            if ch == quote:
+                quote = None
+            i += 1
+            continue
+        if ch in ("'", '"', '`'):
+            quote = ch
+            out.append(ch)
+            i += 1
+            continue
+        if ch == "$":
+            j = i + 1
+            while j < n and (body[j].isalnum() or body[j] == "_"):
+                j += 1
+            word = body[i + 1 : j]
+            if word in mapping:
+                out.append(mapping[word])
+                i = j
+                continue
+        out.append(ch)
+        i += 1
+    return "".join(out)
+
+
 class CypherResult:
     """Lazy result (reference ``RelationalCypherResult``)."""
 
@@ -133,6 +171,12 @@ class CypherSession:
         self.table_cls = table_cls
         self._catalog: Dict[str, RelationalCypherGraph] = {}
         self._views: Dict[str, Tuple[Tuple[str, ...], str]] = {}
+        # cache key -> mounted result qgn; the key includes the argument
+        # QGNs, the identity of each resolved argument graph (so replacing
+        # a stored graph invalidates), and the value parameters (reference
+        # CypherCatalog caches view executions per argument tuple)
+        self._view_cache: Dict[Tuple, str] = {}
+        self._views_expanding: set = set()  # cycle guard
         self._sources: Dict[str, "PropertyGraphDataSource"] = {}
         self._counter = itertools.count()
 
@@ -217,6 +261,92 @@ class CypherSession:
 
         return graph_from_create_query(self, create_query)
 
+    # -- parameterized views (reference RelationalCypherSession.scala:185-187,
+    # CypherCatalog.scala) ---------------------------------------------------
+
+    def _expand_views(self, stmt, parameters=None):
+        """Rewrite every ``FROM GRAPH view(args)`` into a plain FROM GRAPH
+        of the view's materialized result: the stored view text is re-planned
+        (with the caller's value parameters) against the argument graphs, the
+        resulting graph is mounted, and the execution is cached per
+        (view, argument graphs, parameters)."""
+        if isinstance(stmt, A.SingleQuery):
+            new = tuple(
+                self._expand_view_clause(c, parameters) for c in stmt.clauses
+            )
+            return stmt if new == stmt.clauses else A.SingleQuery(new)
+        if isinstance(stmt, A.UnionQuery):
+            new = tuple(self._expand_views(q, parameters) for q in stmt.queries)
+            return (
+                stmt
+                if new == stmt.queries
+                else A.UnionQuery(new, stmt.all)
+            )
+        if isinstance(stmt, A.CreateGraphStatement):
+            inner = self._expand_views(stmt.inner, parameters)
+            return (
+                stmt
+                if inner is stmt.inner
+                else A.CreateGraphStatement(stmt.qgn, inner)
+            )
+        return stmt
+
+    def _expand_view_clause(self, c, parameters=None):
+        if not isinstance(c, A.FromGraph):
+            return c
+        is_view = c.graph_name in self._views
+        if is_view and not c.args:
+            # a stored graph of the same bare name wins — creating a view
+            # must not silently change the meaning of FROM GRAPH <graph>
+            try:
+                self._resolve_qgn(self._qualify(c.graph_name))
+                is_view = False
+            except CatalogError:
+                pass
+        if c.args or is_view:
+            return A.FromGraph(
+                self._resolve_view(c.graph_name, c.args, parameters)
+            )
+        return c
+
+    def _resolve_view(
+        self, name: str, args: Sequence[str], parameters=None
+    ) -> str:
+        if name not in self._views:
+            raise CatalogError(f"Unknown view {name!r}")
+        params, text = self._views[name]
+        if len(args) != len(params):
+            raise CatalogError(
+                f"View {name!r} takes {len(params)} graph argument(s) "
+                f"({', '.join('$' + p for p in params)}), got {len(args)}"
+            )
+        arg_qgns = tuple(self._qualify(a) for a in args)
+        # resolve argument graphs NOW: their identity is part of the cache
+        # key, so replacing/updating a stored graph invalidates the cache
+        arg_graphs = tuple(self._resolve_qgn(q) for q in arg_qgns)
+        param_key = tuple(
+            sorted((k, repr(v)) for k, v in (parameters or {}).items())
+        )
+        key = (name, arg_qgns, tuple(id(g) for g in arg_graphs), param_key)
+        cached = self._view_cache.get(key)
+        if cached is not None and cached in self._catalog:
+            return cached
+        if key in self._views_expanding:
+            raise CatalogError(f"Recursive view definition: {name!r}")
+        body = _substitute_graph_params(text, dict(zip(params, arg_qgns)))
+        self._views_expanding.add(key)
+        try:
+            result = self.cypher(body, parameters)  # views-of-views recurse
+        finally:
+            self._views_expanding.discard(key)
+        g = result.graph
+        if g is None:
+            raise CatalogError(f"View {name!r} must produce a graph")
+        vq = f"{AMBIENT_NS}.view_{name}_{next(self._counter)}"
+        self._catalog[vq] = g._graph
+        self._view_cache[key] = vq
+        return vq
+
     # -- runtime -----------------------------------------------------------
 
     def _runtime_context(self, parameters: Dict[str, Any]) -> RelationalRuntimeContext:
@@ -281,6 +411,7 @@ class CypherSession:
         self._catalog[ambient_qgn] = ambient  # mountAmbientGraph (reference :117)
 
         stmt = time_stage("parse", parse_cypher, query)
+        stmt = self._expand_views(stmt, parameters)
 
         input_fields: Dict[str, T.CypherType] = {}
         driving_header = None
@@ -327,6 +458,8 @@ class CypherSession:
         if isinstance(ir, B.DropGraphIR):
             if ir.view:
                 self._views.pop(ir.qgn, None)
+                for key in [k for k in self._view_cache if k[0] == ir.qgn]:
+                    self._catalog.pop(self._view_cache.pop(key), None)
             else:
                 self.drop_graph(ir.qgn)
             return CypherResult(self, None, None, None)
