@@ -88,6 +88,16 @@ class Column:
             data = np.array([int(v) if v is not None else 0 for v in values], dtype=np.int64)
             return Column(I64, dev(data), dev(valid_np) if has_null else None)
         if all(isinstance(v, _NUMK) and not isinstance(v, _BOOLK) for v in non_null):
+            ints = [
+                v
+                for v in non_null
+                if isinstance(v, _INTK) and not isinstance(v, _BOOLK)
+            ]
+            if any(abs(int(v)) > 2**53 for v in ints):
+                # mixed int/float with ints beyond f64 exactness: the f64
+                # payload would silently round (2**53+1 -> 2**53) — keep the
+                # column host-exact instead
+                return Column(OBJ, _obj_array(values), None)
             data = np.array(
                 [float(v) if v is not None else 0.0 for v in values], dtype=np.float64
             )
@@ -236,8 +246,14 @@ class Column:
         if a.kind != b.kind:
             # unify: promote numerics (keeping Cypher intness), else objects
             if {a.kind, b.kind} == {I64, F64}:
-                a = a.as_f64_keeping_intness()
-                b = b.as_f64_keeping_intness()
+                iside = a if a.kind == I64 else b
+                big = iside.valid_mask() & (jnp.abs(iside.data) > 2**53)
+                if bool(jnp.any(big)):
+                    a = a.to_obj()
+                    b = b.to_obj()
+                else:
+                    a = a.as_f64_keeping_intness()
+                    b = b.as_f64_keeping_intness()
             else:
                 a = a.to_obj()
                 b = b.to_obj()

@@ -237,7 +237,8 @@ class Parser:
                 # parameterized view invocation: FROM GRAPH v(g1, g2)
                 while not self.at_sym(")"):
                     args.append(self.parse_qgn())
-                    self.try_sym(",")
+                    if not self.at_sym(")"):
+                        self.eat_sym(",")
                 self.eat_sym(")")
             return A.FromGraph(name, tuple(args))
         if self.at_kw("CONSTRUCT"):

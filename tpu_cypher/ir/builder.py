@@ -108,6 +108,14 @@ class IRBuilder:
                     blocks.append(B.ResultBlock(returns))
                     saw_return = True
             elif isinstance(c, A.FromGraph):
+                if c.args:
+                    # view invocations are expanded by the session BEFORE IR
+                    # building; reaching here means the caller skipped
+                    # CypherSession._expand_views
+                    raise IRBuildError(
+                        f"Unresolved view invocation {c.graph_name}(...) — "
+                        "views resolve at the session level"
+                    )
                 qgn = self._resolve_qgn(c.graph_name)
                 if qgn not in self.ctx.catalog_schemas:
                     raise IRBuildError(f"Unknown graph {qgn!r}")

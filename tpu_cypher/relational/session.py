@@ -42,6 +42,25 @@ class CatalogError(Exception):
     pass
 
 
+def _referenced_params(body: str) -> set:
+    """Names of every ``$param`` referenced in view body text (quote-aware,
+    same scan as ``_substitute_graph_params``)."""
+    out: set = set()
+    _substitute_graph_params(body, _Collector(out))
+    return out
+
+
+class _Collector(dict):
+    """Mapping that records lookups and never substitutes."""
+
+    def __init__(self, out: set):
+        self._out = out
+
+    def __contains__(self, k) -> bool:
+        self._out.add(k)
+        return False
+
+
 def _substitute_graph_params(body: str, mapping: Dict[str, str]) -> str:
     """Replace ``$param`` graph references in view body TEXT with argument
     QGNs — quote-aware (occurrences inside '...'/"..."/`...` literals are
@@ -171,11 +190,13 @@ class CypherSession:
         self.table_cls = table_cls
         self._catalog: Dict[str, RelationalCypherGraph] = {}
         self._views: Dict[str, Tuple[Tuple[str, ...], str]] = {}
-        # cache key -> mounted result qgn; the key includes the argument
-        # QGNs, the identity of each resolved argument graph (so replacing
-        # a stored graph invalidates), and the value parameters (reference
-        # CypherCatalog caches view executions per argument tuple)
-        self._view_cache: Dict[Tuple, str] = {}
+        # (view, arg qgns, referenced params) -> (argument graph objects,
+        # mounted result qgn). The stored graph objects are compared by
+        # identity at lookup (and keep the arguments alive, so a recycled
+        # id can never produce a stale hit); replacing a stored graph
+        # therefore misses, and the superseded mounted result is evicted
+        # (reference CypherCatalog caches view executions per arg tuple)
+        self._view_cache: Dict[Tuple, Tuple[Tuple, str]] = {}
         self._views_expanding: set = set()  # cycle guard
         self._sources: Dict[str, "PropertyGraphDataSource"] = {}
         self._counter = itertools.count()
@@ -321,16 +342,27 @@ class CypherSession:
                 f"({', '.join('$' + p for p in params)}), got {len(args)}"
             )
         arg_qgns = tuple(self._qualify(a) for a in args)
-        # resolve argument graphs NOW: their identity is part of the cache
-        # key, so replacing/updating a stored graph invalidates the cache
         arg_graphs = tuple(self._resolve_qgn(q) for q in arg_qgns)
+        # only parameters the view body actually references key the cache
+        referenced = _referenced_params(text) - set(params)
         param_key = tuple(
-            sorted((k, repr(v)) for k, v in (parameters or {}).items())
+            sorted(
+                (k, repr(v))
+                for k, v in (parameters or {}).items()
+                if k in referenced
+            )
         )
-        key = (name, arg_qgns, tuple(id(g) for g in arg_graphs), param_key)
+        key = (name, arg_qgns, param_key)
         cached = self._view_cache.get(key)
-        if cached is not None and cached in self._catalog:
-            return cached
+        if cached is not None:
+            prev_graphs, vq = cached
+            if all(a is b for a, b in zip(prev_graphs, arg_graphs)) and (
+                vq in self._catalog
+            ):
+                return vq
+            # argument graph replaced: evict the superseded materialization
+            self._catalog.pop(vq, None)
+            del self._view_cache[key]
         if key in self._views_expanding:
             raise CatalogError(f"Recursive view definition: {name!r}")
         body = _substitute_graph_params(text, dict(zip(params, arg_qgns)))
@@ -344,7 +376,7 @@ class CypherSession:
             raise CatalogError(f"View {name!r} must produce a graph")
         vq = f"{AMBIENT_NS}.view_{name}_{next(self._counter)}"
         self._catalog[vq] = g._graph
-        self._view_cache[key] = vq
+        self._view_cache[key] = (arg_graphs, vq)
         return vq
 
     # -- runtime -----------------------------------------------------------
@@ -459,7 +491,8 @@ class CypherSession:
             if ir.view:
                 self._views.pop(ir.qgn, None)
                 for key in [k for k in self._view_cache if k[0] == ir.qgn]:
-                    self._catalog.pop(self._view_cache.pop(key), None)
+                    _, vq = self._view_cache.pop(key)
+                    self._catalog.pop(vq, None)
             else:
                 self.drop_graph(ir.qgn)
             return CypherResult(self, None, None, None)
