@@ -174,14 +174,19 @@ def test_shared_subplan_computes_once(graphs):
         "RETURN count(*) AS c"
     )
     plan = r.relational_plan
-    seen = {}
+    visits = {}
 
     def walk(op):
-        seen[id(op)] = seen.get(id(op), 0) + 1
+        visits[id(op)] = visits.get(id(op), 0) + 1
+        if visits[id(op)] > 1:
+            return  # shared subtree: one object, multiple parents
         for ch in op.children:
             walk(ch)
 
     walk(plan)
+    # the planner memo makes the shared MATCH subtree ONE object with
+    # multiple parents
+    assert any(v > 1 for v in visits.values())
     import tpu_cypher.relational.ops as R
 
     calls = {"n": 0}

@@ -50,6 +50,12 @@ class TpuBackendError(Exception):
     pass
 
 
+class InexactPromotionError(TpuBackendError):
+    """An I64->F64 promotion would round integers beyond 2**53; the caller
+    must use a host-exact representation (OBJ / local oracle) instead."""
+
+
+
 @dataclass
 class Column:
     kind: str
@@ -62,6 +68,19 @@ class Column:
     # distinguishes 1 from 1.0 as *values* even though 1 = 1.0 compares
     # true, so decode must restore intness). None = no integer rows.
     int_flag: Optional[Any] = None
+    # I64 only: cached 'has valid values beyond 2**53' probe (None = not yet
+    # computed); computed at most once per column instance so f64-promotion
+    # guards don't sync repeatedly
+    _beyond_f64: Optional[bool] = None
+
+    def ints_beyond_f64(self) -> bool:
+        """True when a VALID int64 payload exceeds f64 exactness (2**53)."""
+        if self.kind != I64:
+            return False
+        if self._beyond_f64 is None:
+            big = self.valid_mask() & (jnp.abs(self.data) > 2**53)
+            self._beyond_f64 = bool(jnp.any(big))
+        return self._beyond_f64
 
     def __len__(self) -> int:
         return int(self.data.shape[0]) if self.kind != OBJ else len(self.data)
@@ -247,8 +266,7 @@ class Column:
             # unify: promote numerics (keeping Cypher intness), else objects
             if {a.kind, b.kind} == {I64, F64}:
                 iside = a if a.kind == I64 else b
-                big = iside.valid_mask() & (jnp.abs(iside.data) > 2**53)
-                if bool(jnp.any(big)):
+                if iside.ints_beyond_f64():
                     a = a.to_obj()
                     b = b.to_obj()
                 else:
@@ -310,6 +328,10 @@ class Column:
         if self.kind == F64:
             return self
         if self.kind == I64:
+            if self.ints_beyond_f64():
+                raise InexactPromotionError(
+                    "int64 values beyond 2**53 cannot promote to f64 exactly"
+                )
             return Column(
                 F64,
                 self.data.astype(jnp.float64),

@@ -34,6 +34,7 @@ from .column import (
     OBJ,
     STR,
     Column,
+    InexactPromotionError,
     TpuBackendError,
     _NULL_CODE,
     constant_column,
@@ -60,7 +61,7 @@ class TpuEvaluator:
     def eval(self, expr: E.Expr) -> Column:
         try:
             return self._eval_device(expr)
-        except TpuUnsupportedExpr:
+        except (TpuUnsupportedExpr, InexactPromotionError):
             return self._host_island(expr)
 
     def _host_island(self, expr: E.Expr) -> Column:

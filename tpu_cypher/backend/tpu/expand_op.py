@@ -181,6 +181,42 @@ class CsrExpandOp(_FusedExpandBase):
         t = "|".join(self.types_key) or "*"
         return f"({self.frontier_fld}){arrow}[{self.rel_fld}:{t}]({self.far_fld})"
 
+    def _count_total(self, gi: GraphIndex, pos, present, ctx) -> int:
+        """Output cardinality without materialization: per-frontier-row CSR
+        degree sums; far-label filtering and undirected self-loop exclusion
+        count per edge but never gather ``orig``/assemble columns."""
+        halves = [(self.backwards, False)]
+        if self.undirected:
+            halves.append((not self.backwards, True))
+        unrestricted = not self.far_labels
+        if not unrestricted:
+            _, _, row_map = gi.node_scan(self.far_labels, ctx)
+        total = 0
+        for reverse, drop_loops in halves:
+            rp, ci, _ = gi.csr(self.types_key, reverse, ctx)
+            deg = (jnp.take(rp, pos + 1) - jnp.take(rp, pos)).astype(jnp.int64)
+            deg = jnp.where(present, deg, 0)
+            if unrestricted and not drop_loops:
+                total += int(deg.sum())
+                continue
+            t = int(deg.sum())
+            nrows = int(pos.shape[0])
+            row = jnp.repeat(
+                jnp.arange(nrows, dtype=jnp.int64), deg, total_repeat_length=t
+            )
+            base = jnp.take(rp, pos).astype(jnp.int64) - _exclusive_cumsum(deg)
+            edge = jnp.repeat(base, deg, total_repeat_length=t) + jnp.arange(
+                t, dtype=jnp.int64
+            )
+            nbr = jnp.take(ci, edge).astype(jnp.int64)
+            keep = jnp.ones(t, bool)
+            if not unrestricted:
+                keep = keep & (jnp.take(row_map, nbr) >= 0) if gi.num_nodes else keep
+            if drop_loops:
+                keep = keep & (nbr != jnp.take(pos, row))
+            total += int(keep.sum())
+        return total
+
     def _expand_half(self, gi: GraphIndex, pos, present, reverse: bool, drop_loops: bool):
         ctx = self.context
         rp, ci, eo = gi.csr(self.types_key, reverse, ctx)
@@ -211,6 +247,12 @@ class CsrExpandOp(_FusedExpandBase):
         frontier_var = in_op.header.var(self.frontier_fld)
         id_col = in_t._cols[in_op.header.column(in_op.header.id_expr(frontier_var))]
         pos, present = gi.compact_of(id_col, ctx)
+        if not self.header.expressions:
+            # pure-multiplicity consumer (a pruned count(*) plan): the row
+            # count is a degree sum — skip materializing rows entirely
+            from .table import TpuTable
+
+            return TpuTable({}, self._count_total(gi, pos, present, ctx))
         primary_reverse = self.backwards
         row, nbr, orig = self._expand_half(
             gi, pos, present, reverse=primary_reverse, drop_loops=False

@@ -330,6 +330,21 @@ class CypherSession:
             )
         return c
 
+    def _view_param_closure(self, name: str, _seen: frozenset = frozenset()) -> set:
+        """``$params`` referenced by a view's body text, transitively through
+        views its body appears to invoke (textual name match — conservative:
+        a false positive only widens the cache key)."""
+        params, text = self._views[name]
+        refs = _referenced_params(text)
+        for other in self._views:
+            if other == name or other in _seen:
+                continue
+            if re.search(r"\b" + re.escape(other) + r"\s*\(", text) or re.search(
+                r"GRAPH\s+" + re.escape(other) + r"\b", text
+            ):
+                refs |= self._view_param_closure(other, _seen | {name})
+        return refs
+
     def _resolve_view(
         self, name: str, args: Sequence[str], parameters=None
     ) -> str:
@@ -343,8 +358,9 @@ class CypherSession:
             )
         arg_qgns = tuple(self._qualify(a) for a in args)
         arg_graphs = tuple(self._resolve_qgn(q) for q in arg_qgns)
-        # only parameters the view body actually references key the cache
-        referenced = _referenced_params(text) - set(params)
+        # parameters referenced by the body OR any view it may invoke key
+        # the cache (nested views receive the caller's parameters too)
+        referenced = self._view_param_closure(name) - set(params)
         param_key = tuple(
             sorted(
                 (k, repr(v))
