@@ -1,0 +1,48 @@
+Feature: LabelsAcceptance
+
+  Scenario: labels() of multi-labeled nodes
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:A:B {v: 1}), (:A {v: 2})
+      """
+    When executing query:
+      """
+      MATCH (n) RETURN n.v, labels(n) AS ls ORDER BY n.v
+      """
+    Then the result should be, in order:
+      | n.v | ls         |
+      | 1   | ['A', 'B'] |
+      | 2   | ['A']      |
+    And no side effects
+
+  Scenario: Label predicate in WHERE
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:A:B {v: 1}), (:A {v: 2}), (:B {v: 3})
+      """
+    When executing query:
+      """
+      MATCH (n) WHERE n:B RETURN n.v ORDER BY n.v
+      """
+    Then the result should be, in order:
+      | n.v |
+      | 1   |
+      | 3   |
+    And no side effects
+
+  Scenario: Negated label predicate
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:A:B {v: 1}), (:A {v: 2})
+      """
+    When executing query:
+      """
+      MATCH (n:A) WHERE NOT n:B RETURN n.v
+      """
+    Then the result should be, in any order:
+      | n.v |
+      | 2   |
+    And no side effects

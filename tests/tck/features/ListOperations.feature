@@ -1,0 +1,94 @@
+Feature: ListOperations
+
+  Scenario: Indexing into a literal list
+    Given an empty graph
+    When executing query:
+      """
+      RETURN [1, 2, 3][0] AS x, [1, 2, 3][-1] AS y, [1, 2, 3][5] AS z
+      """
+    Then the result should be, in any order:
+      | x | y | z    |
+      | 1 | 3 | null |
+    And no side effects
+
+  Scenario: Slicing a list property
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:A {xs: [10, 20, 30, 40]})
+      """
+    When executing query:
+      """
+      MATCH (a:A) RETURN a.xs[1..3] AS mid, a.xs[..2] AS head, a.xs[2..] AS tail
+      """
+    Then the result should be, in any order:
+      | mid      | head     | tail     |
+      | [20, 30] | [10, 20] | [30, 40] |
+    And no side effects
+
+  Scenario: Concatenating lists with +
+    Given an empty graph
+    When executing query:
+      """
+      RETURN [1, 2] + [3] AS a, [] + [1] AS b
+      """
+    Then the result should be, in any order:
+      | a         | b   |
+      | [1, 2, 3] | [1] |
+    And no side effects
+
+  Scenario: IN over nested lists compares structurally
+    Given an empty graph
+    When executing query:
+      """
+      RETURN [1, 2] IN [[1, 2], [3]] AS yes, [1] IN [[1, 2]] AS no
+      """
+    Then the result should be, in any order:
+      | yes  | no    |
+      | true | false |
+    And no side effects
+
+  Scenario: List comprehension with filter and map
+    Given an empty graph
+    When executing query:
+      """
+      RETURN [x IN range(1, 5) WHERE x % 2 = 1 | x * x] AS odds
+      """
+    Then the result should be, in any order:
+      | odds       |
+      | [1, 9, 25] |
+    And no side effects
+
+  Scenario: reduce over a list
+    Given an empty graph
+    When executing query:
+      """
+      RETURN reduce(acc = '', s IN ['a', 'b', 'c'] | acc + s) AS cat
+      """
+    Then the result should be, in any order:
+      | cat   |
+      | 'abc' |
+    And no side effects
+
+  Scenario: head last and tail of lists
+    Given an empty graph
+    When executing query:
+      """
+      RETURN head([1, 2, 3]) AS h, last([1, 2, 3]) AS l, tail([1, 2, 3]) AS t, head([]) AS eh
+      """
+    Then the result should be, in any order:
+      | h | l | t      | eh   |
+      | 1 | 3 | [2, 3] | null |
+    And no side effects
+
+  Scenario: Quantifiers over lists
+    Given an empty graph
+    When executing query:
+      """
+      RETURN all(x IN [1, 2] WHERE x > 0) AS a, any(x IN [1, 2] WHERE x > 1) AS s,
+             none(x IN [1, 2] WHERE x > 2) AS n, single(x IN [1, 2] WHERE x = 1) AS o
+      """
+    Then the result should be, in any order:
+      | a    | s    | n    | o    |
+      | true | true | true | true |
+    And no side effects

@@ -1,0 +1,107 @@
+Feature: MatchAcceptance2
+
+  Scenario: Matching a self loop
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (a:A {v: 1})-[:R]->(a)
+      """
+    When executing query:
+      """
+      MATCH (a)-[:R]->(a) RETURN a.v
+      """
+    Then the result should be, in any order:
+      | a.v |
+      | 1   |
+    And no side effects
+
+  Scenario: Undirected match includes a self loop once per orientation pair
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (a:A {v: 1})-[:R]->(a)
+      """
+    When executing query:
+      """
+      MATCH (a)-[:R]-(b) RETURN count(*) AS c
+      """
+    Then the result should be, in any order:
+      | c |
+      | 1 |
+    And no side effects
+
+  Scenario: Matching nodes with many labels
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:A:B:C {v: 1}), (:A:B {v: 2}), (:A {v: 3})
+      """
+    When executing query:
+      """
+      MATCH (n:A:B) RETURN n.v ORDER BY n.v
+      """
+    Then the result should be, in order:
+      | n.v |
+      | 1   |
+      | 2   |
+    And no side effects
+
+  Scenario: Anonymous intermediate nodes do not bind
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:S {v: 1})-[:R]->(:M)-[:R]->(:E {v: 9})
+      """
+    When executing query:
+      """
+      MATCH (s:S)-[:R]->()-[:R]->(e) RETURN s.v, e.v
+      """
+    Then the result should be, in any order:
+      | s.v | e.v |
+      | 1   | 9   |
+    And no side effects
+
+  Scenario: Direction flip between two bound endpoints
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (a:A {n:'a'})-[:R]->(b:B {n:'b'}), (b)-[:R]->(a)
+      """
+    When executing query:
+      """
+      MATCH (x:A)-[:R]->(y:B), (y)-[:R]->(x) RETURN x.n, y.n
+      """
+    Then the result should be, in any order:
+      | x.n | y.n |
+      | 'a' | 'b' |
+    And no side effects
+
+  Scenario: Filtering on relationship property in pattern map
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:A)-[:R {k: 1}]->(:B {v: 'one'}), (:A)-[:R {k: 2}]->(:B {v: 'two'})
+      """
+    When executing query:
+      """
+      MATCH ()-[:R {k: 2}]->(b) RETURN b.v
+      """
+    Then the result should be, in any order:
+      | b.v   |
+      | 'two' |
+    And no side effects
+
+  Scenario: Matching with multiple comma patterns sharing variables
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (a:P {n:'a'})-[:K]->(b:P {n:'b'})-[:K]->(c:P {n:'c'}), (a)-[:K]->(c)
+      """
+    When executing query:
+      """
+      MATCH (x)-[:K]->(y), (y)-[:K]->(z), (x)-[:K]->(z) RETURN x.n, y.n, z.n
+      """
+    Then the result should be, in any order:
+      | x.n | y.n | z.n |
+      | 'a' | 'b' | 'c' |
+    And no side effects

@@ -1,0 +1,15 @@
+Feature: PatternComprehension
+
+  Scenario: Pattern comprehension over outgoing relationships
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (a:A {n:'a'})-[:T]->(:B {n:'b1'}), (a)-[:T]->(:B {n:'b2'})
+      """
+    When executing query:
+      """
+      MATCH (a:A) RETURN [(a)-[:T]->(x) | x.n] AS names
+      """
+    Then the result should be, in any order:
+      | names        |
+      | ['b1', 'b2'] |

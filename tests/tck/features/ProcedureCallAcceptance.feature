@@ -1,0 +1,26 @@
+Feature: ProcedureCallAcceptance
+
+  Scenario: Standalone procedure call
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:A), (:B)
+      """
+    When executing query:
+      """
+      CALL db.labels() YIELD label RETURN label
+      """
+    Then the result should be, in any order:
+      | label |
+      | 'A'   |
+      | 'B'   |
+
+  Scenario: Correlated CALL subquery
+    Given an empty graph
+    When executing query:
+      """
+      WITH 1 AS x CALL { RETURN 2 AS y } RETURN x, y
+      """
+    Then the result should be, in any order:
+      | x | y |
+      | 1 | 2 |

@@ -1,0 +1,50 @@
+Feature: SkipLimitAcceptance
+
+  Scenario: SKIP and LIMIT with literals
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:N {v: 1}), (:N {v: 2}), (:N {v: 3}), (:N {v: 4})
+      """
+    When executing query:
+      """
+      MATCH (n:N) RETURN n.v ORDER BY n.v SKIP 1 LIMIT 2
+      """
+    Then the result should be, in order:
+      | n.v |
+      | 2   |
+      | 3   |
+    And no side effects
+
+  Scenario: SKIP and LIMIT with parameters
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:N {v: 1}), (:N {v: 2}), (:N {v: 3})
+      """
+    And parameters are:
+      | s | 1 |
+      | l | 1 |
+    When executing query:
+      """
+      MATCH (n:N) RETURN n.v ORDER BY n.v SKIP $s LIMIT $l
+      """
+    Then the result should be, in order:
+      | n.v |
+      | 2   |
+    And no side effects
+
+  Scenario: SKIP with an expression that does not depend on variables
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:N {v: 1}), (:N {v: 2}), (:N {v: 3})
+      """
+    When executing query:
+      """
+      MATCH (n:N) RETURN n.v ORDER BY n.v SKIP 1 + 1
+      """
+    Then the result should be, in order:
+      | n.v |
+      | 3   |
+    And no side effects

@@ -1,0 +1,59 @@
+Feature: StartsWithAcceptance
+
+  Scenario: STARTS WITH CONTAINS ENDS WITH on strings
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:T {s: 'abcdef'}), (:T {s: 'abc'}), (:T {s: 'xabc'})
+      """
+    When executing query:
+      """
+      MATCH (t:T) WHERE t.s STARTS WITH 'abc' RETURN t.s
+      """
+    Then the result should be, in any order:
+      | t.s      |
+      | 'abcdef' |
+      | 'abc'    |
+    And no side effects
+
+  Scenario: Handling non-string operands for STARTS WITH
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:T {v: 1})
+      """
+    When executing query:
+      """
+      MATCH (t:T) RETURN t.v STARTS WITH 'a' AS a, 1 CONTAINS 'a' AS b, true ENDS WITH 'a' AS c
+      """
+    Then the result should be, in any order:
+      | a    | b    | c    |
+      | null | null | null |
+    And no side effects
+
+  Scenario: NULL pattern operand yields null
+    Given an empty graph
+    When executing query:
+      """
+      RETURN 'abc' STARTS WITH null AS a, null CONTAINS 'a' AS b
+      """
+    Then the result should be, in any order:
+      | a    | b    |
+      | null | null |
+    And no side effects
+
+  Scenario: Regular expression match
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:T {s: 'seven'}), (:T {s: 'severe'}), (:T {s: 'sever'})
+      """
+    When executing query:
+      """
+      MATCH (t:T) WHERE t.s =~ 'seve[rn]' RETURN t.s
+      """
+    Then the result should be, in any order:
+      | t.s     |
+      | 'seven' |
+      | 'sever' |
+    And no side effects

@@ -1,0 +1,70 @@
+Feature: TypeConversionFunctions
+
+  Scenario: toInteger on strings
+    Given an empty graph
+    When executing query:
+      """
+      RETURN toInteger('42') AS a, toInteger('4.2') AS b, toInteger('foo') AS c, toInteger(null) AS d
+      """
+    Then the result should be, in any order:
+      | a  | b | c    | d    |
+      | 42 | 4 | null | null |
+    And no side effects
+
+  Scenario: toFloat on strings and numbers
+    Given an empty graph
+    When executing query:
+      """
+      RETURN toFloat('1.5') AS a, toFloat(2) AS b, toFloat('bar') AS c
+      """
+    Then the result should be, in any order:
+      | a   | b   | c    |
+      | 1.5 | 2.0 | null |
+    And no side effects
+
+  Scenario: toBoolean on strings
+    Given an empty graph
+    When executing query:
+      """
+      RETURN toBoolean('true') AS t, toBoolean('FALSE') AS f, toBoolean('maybe') AS m
+      """
+    Then the result should be, in any order:
+      | t    | f     | m    |
+      | true | false | null |
+    And no side effects
+
+  Scenario: toString on numbers and booleans
+    Given an empty graph
+    When executing query:
+      """
+      RETURN toString(7) AS i, toString(1.5) AS f, toString(true) AS b, toString('x') AS s
+      """
+    Then the result should be, in any order:
+      | i   | f     | b      | s   |
+      | '7' | '1.5' | 'true' | 'x' |
+    And no side effects
+
+  Scenario: toInteger on a boolean is a type error
+    Given an empty graph
+    When executing query:
+      """
+      RETURN toInteger(true) AS x
+      """
+    Then a TypeError should be raised at runtime: InvalidArgumentValue
+
+  Scenario: Conversions over a column of strings
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:A {s: '1'}), (:A {s: '2'}), (:A {s: 'x'})
+      """
+    When executing query:
+      """
+      MATCH (a:A) RETURN toInteger(a.s) AS v ORDER BY v
+      """
+    Then the result should be, in order:
+      | v    |
+      | 1    |
+      | 2    |
+      | null |
+    And no side effects

@@ -1,0 +1,67 @@
+Feature: UpdateClauses
+
+  Scenario: Creating a node with CREATE
+    Given an empty graph
+    When executing query:
+      """
+      CREATE (n:Made {v: 1}) RETURN n.v
+      """
+    Then the result should be, in any order:
+      | n.v |
+      | 1   |
+
+  Scenario: MERGE matches before creating
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:K {k: 1})
+      """
+    When executing query:
+      """
+      MERGE (n:K {k: 1}) RETURN n.k
+      """
+    Then the result should be, in any order:
+      | n.k |
+      | 1   |
+
+  Scenario: DELETE removes a node
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:D {v: 1})
+      """
+    When executing query:
+      """
+      MATCH (n:D) DELETE n RETURN count(*) AS c
+      """
+    Then the result should be, in any order:
+      | c |
+      | 1 |
+
+  Scenario: SET writes a property
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:S {v: 1})
+      """
+    When executing query:
+      """
+      MATCH (n:S) SET n.v = 2 RETURN n.v
+      """
+    Then the result should be, in any order:
+      | n.v |
+      | 2   |
+
+  Scenario: REMOVE drops a property
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:S {v: 1})
+      """
+    When executing query:
+      """
+      MATCH (n:S) REMOVE n.v RETURN n.v
+      """
+    Then the result should be, in any order:
+      | n.v  |
+      | null |

@@ -1,0 +1,58 @@
+Feature: VarLengthAcceptance
+
+  Scenario: Fixed length through bounded variable length
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:S {v:'s'})-[:R]->(:M {v:'m'})-[:R]->(:E {v:'e'})
+      """
+    When executing query:
+      """
+      MATCH (a:S)-[:R*2..2]->(b) RETURN b.v
+      """
+    Then the result should be, in any order:
+      | b.v |
+      | 'e' |
+    And no side effects
+
+  Scenario: Handling unbounded variable length match
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:S)-[:R]->(:M)-[:R]->(:E)
+      """
+    When executing query:
+      """
+      MATCH (a:S)-[:R*]->(b) RETURN count(*) AS c
+      """
+    Then the result should be, in any order:
+      | c |
+      | 2 |
+
+  Scenario: Handling lower bounded variable length match without upper bound
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:S)-[:R]->(:M)-[:R]->(:E)
+      """
+    When executing query:
+      """
+      MATCH (a:S)-[:R*1..]->(b) RETURN count(*) AS c
+      """
+    Then the result should be, in any order:
+      | c |
+      | 2 |
+
+  Scenario: Handling relationships that are already bound in variable length paths
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:S)-[:R]->(:E)
+      """
+    When executing query:
+      """
+      MATCH ()-[r:R]->() MATCH (a)-[r*1..2]->(b) RETURN count(*) AS c
+      """
+    Then the result should be, in any order:
+      | c |
+      | 1 |

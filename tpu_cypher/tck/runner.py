@@ -54,7 +54,8 @@ def load_blacklist(*paths: str) -> frozenset:
     for p in paths:
         with open(p) as f:
             for line in f:
-                line = line.strip()
+                # strip trailing reason comments ("... # [unbounded]")
+                line = line.split("  #", 1)[0].strip()
                 if line and not line.startswith("#"):
                     entries.append(line)
     dupes = {e for e in entries if entries.count(e) > 1}
