@@ -50,6 +50,12 @@ class Table(ABC):
         with a columnar read; the default goes through ``rows``."""
         return [r[col] for r in self.rows()]
 
+    def distinct_count(self, cols: Sequence[str]) -> Optional[int]:
+        """Number of distinct rows over ``cols`` without materializing them,
+        or None when this backend has no cheaper path than ``distinct()``
+        (count-over-distinct aggregate pushdown)."""
+        return None
+
     # -- algebra ----------------------------------------------------------
 
     @abstractmethod

@@ -388,6 +388,11 @@ class Column:
             keys.append(nan)
         elif self.kind == BOOL:
             data = data.astype(jnp.int8)
+        if self.valid is None:
+            # no nulls: the null-class key is constant — skip it (halves the
+            # stable sorts for the hot id-distinct path)
+            keys.append(data)
+            return keys
         data = jnp.where(valid, data, jnp.zeros((), data.dtype))
         keys.append(data)
         keys.append(~valid)

@@ -80,6 +80,31 @@ class _FallbackCounter:
 FALLBACK_COUNTER = _FallbackCounter()
 
 
+def _pack_int_keys(keys: List[Any]) -> List[Any]:
+    """Fold multiple integer/bool sort-key arrays into one int64 key when
+    their value ranges fit 63 bits combined (equality-preserving, order of
+    groups permuted — fine for factorization/dedup, NOT for ORDER BY)."""
+    if len(keys) <= 1 or int(keys[0].shape[0]) == 0:
+        return keys
+    for k in keys:
+        if not (jnp.issubdtype(k.dtype, jnp.integer) or k.dtype == jnp.bool_):
+            return keys
+    ints = [k.astype(jnp.int64) for k in keys]
+    mm = np.asarray(
+        jnp.stack(
+            [jnp.stack([k.min() for k in ints]), jnp.stack([k.max() for k in ints])]
+        )
+    )  # one device->host sync for every min/max
+    # unbounded Python ints: an int64 hi-lo could wrap and undercount bits
+    bits = [(int(hi) - int(lo)).bit_length() for lo, hi in zip(mm[0], mm[1])]
+    if sum(bits) > 63:
+        return keys
+    acc = jnp.zeros_like(ints[0])
+    for k, lo, b in zip(ints, mm[0], bits):
+        acc = (acc << b) | (k - int(lo))
+    return [acc]
+
+
 class TpuTable(Table):
     def __init__(self, cols: Dict[str, Column], nrows: Optional[int] = None):
         self._cols = dict(cols)
@@ -482,8 +507,11 @@ class TpuTable(Table):
         first-of-group flags over the sorted order). The stable sort makes
         the first row of each equal-key run the earliest original row of
         that group. ``extra_keys`` prepend higher-priority key arrays (e.g.
-        a group index for DISTINCT aggregates)."""
-        keys = list(extra_keys) + self._equivalence_keys(on)
+        a group index for DISTINCT aggregates). All-integer key sets whose
+        ranges fit 63 bits are PACKED into one key — one sort instead of k
+        (group order is irrelevant here: callers renumber by first
+        occurrence)."""
+        keys = _pack_int_keys(list(extra_keys) + self._equivalence_keys(on))
         n = int(keys[0].shape[0]) if keys else self._nrows
         order = jnp.lexsort(tuple(reversed(keys)))
         if n > 1:
@@ -495,6 +523,16 @@ class TpuTable(Table):
         else:
             flags = jnp.ones(n, bool)
         return order, flags
+
+    def distinct_count(self, cols: Sequence[str]) -> Optional[int]:
+        """Number of distinct rows over ``cols`` WITHOUT materializing them
+        (count-over-distinct pushdown): one packed sort + flag sum."""
+        if not cols or any(self._cols[c].kind == OBJ for c in cols):
+            return None
+        if self._nrows == 0:
+            return 0
+        _, flags = self._first_occurrence_index(list(cols))
+        return int(flags.sum())
 
     def distinct(self, cols: Optional[Sequence[str]] = None) -> "TpuTable":
         on = list(cols) if cols is not None else self.physical_columns

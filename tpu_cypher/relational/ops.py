@@ -303,7 +303,7 @@ class DistinctOp(RelationalOperator):
         super().__init__(in_op)
         self.fields = list(fields)
 
-    def _compute_table(self) -> Table:
+    def distinct_columns(self) -> List[str]:
         h = self.header
         cols: List[str] = []
         for f in self.fields:
@@ -321,6 +321,10 @@ class DistinctOp(RelationalOperator):
                 c = h.column(e)
                 if c not in cols:
                     cols.append(c)
+        return cols
+
+    def _compute_table(self) -> Table:
+        cols = self.distinct_columns()
         t = self.children[0].table
         return t.distinct(cols) if cols else t.distinct()
 
@@ -366,6 +370,23 @@ class AggregateOp(RelationalOperator):
         for name, agg in self.aggregations:
             out_col = self.header.column(E.Var(name))
             aggs.append((out_col, agg))
+        # count-over-distinct pushdown: WITH DISTINCT a, b ... RETURN
+        # count(*) never materializes the deduped rows — the count is the
+        # number of first-occurrence groups (the engines get the same from
+        # their optimizers' aggregate pushdown)
+        if (
+            not by
+            and isinstance(in_op, DistinctOp)
+            and all(
+                getattr(agg, "expr", None) is None and not getattr(agg, "distinct", False)
+                for _, agg in self.aggregations
+            )
+        ):
+            src = in_op.children[0].table
+            n = src.distinct_count(in_op.distinct_columns())
+            if n is not None:
+                cols = {out_col: [n] for out_col, _ in aggs}
+                return type(src).from_columns(cols)
         return in_op.table.group(by, aggs, in_h, self.context.parameters)
 
     def _show_inner(self) -> str:
