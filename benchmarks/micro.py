@@ -1,0 +1,126 @@
+"""Microbenchmarks: the metrics the reference's JMH harness defines.
+
+The reference ships a JMH module with two benchmarks and no recorded
+results (SURVEY §6): DataFrame self-join throughput as a function of the
+element-id REPRESENTATION (``morpheus-jmh/.../JoinBenchmark.scala:40-120``
+— Long vs Array[Long] vs String vs varint byte[]), and multi-column concat
+cost (``ConcatColumnBenchmark.scala:44-68`` — concat_ws vs codegen
+serialize). This is the TPU-native equivalent:
+
+* join throughput over int64 ids, graph-TAGGED int64 ids (high-bits tag —
+  our EncodeLong/AddPrefix replacement), dictionary-coded strings, and f64
+  keys — all through ``TpuTable.join``;
+* composite-key factorization cost: multi-key lexsort vs the packed
+  single-int64 sort (our Serialize.scala replacement) via ``distinct``;
+* column concat (``union_all``) for plain vs vocab-remapped strings.
+
+Prints one JSON line per metric:
+  {"metric": ..., "value": ..., "unit": "rows/s", ...}
+
+Run:  JAX_PLATFORMS=cpu python benchmarks/micro.py        (or on TPU)
+Env:  MICRO_ROWS (default 200000), MICRO_REPS (default 3)
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import numpy as np
+
+
+def _bench(fn, reps):
+    fn()  # warm (compile caches, vocab builds)
+    times = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        fn()
+        times.append(time.perf_counter() - t0)
+    return float(np.median(times))
+
+
+def main():
+    rows = int(os.environ.get("MICRO_ROWS", "200000"))
+    reps = int(os.environ.get("MICRO_REPS", "3"))
+
+    from tpu_cypher.backend.tpu.table import TpuTable
+
+    rng = np.random.default_rng(11)
+    build_n = rows // 2
+    probe_ids = rng.integers(0, build_n, rows).astype(np.int64)
+    build_ids = np.arange(build_n, dtype=np.int64)
+    payload = rng.standard_normal(build_n)
+
+    def emit(metric, secs, n=rows, **extra):
+        out = {
+            "metric": metric,
+            "value": round(n / secs, 1),
+            "unit": "rows/s",
+            "seconds": round(secs, 6),
+        }
+        out.update(extra)
+        print(json.dumps(out))
+
+    # -- join throughput by key representation ---------------------------
+    l_int = TpuTable.from_numpy({"k": probe_ids})
+    r_int = TpuTable.from_numpy({"j": build_ids, "p": payload})
+    emit(
+        "join_int64_ids",
+        _bench(lambda: l_int.join(r_int, "inner", [("k", "j")]), reps),
+    )
+
+    tag = np.int64(3) << 54  # graph tag in high bits (EncodeLong/AddPrefix analog)
+    l_tag = TpuTable.from_numpy({"k": probe_ids | tag})
+    r_tag = TpuTable.from_numpy({"j": build_ids | tag, "p": payload})
+    emit(
+        "join_tagged_int64_ids",
+        _bench(lambda: l_tag.join(r_tag, "inner", [("k", "j")]), reps),
+    )
+
+    strs = np.array([f"id{v:08d}" for v in range(build_n)])
+    l_str = TpuTable.from_columns({"k": strs[probe_ids % build_n].tolist()})
+    r_str = TpuTable.from_columns({"j": strs.tolist(), "p": payload.tolist()})
+    emit(
+        "join_string_ids",
+        _bench(lambda: l_str.join(r_str, "inner", [("k", "j")]), reps),
+    )
+
+    l_f = TpuTable.from_numpy({"k": probe_ids.astype(np.float64)})
+    r_f = TpuTable.from_numpy({"j": build_ids.astype(np.float64), "p": payload})
+    emit(
+        "join_float_keys",
+        _bench(lambda: l_f.join(r_f, "inner", [("k", "j")]), reps),
+    )
+
+    # -- composite-key distinct: packed single sort (Serialize analog) ---
+    a = rng.integers(0, 1000, rows).astype(np.int64)
+    b = rng.integers(0, 1000, rows).astype(np.int64)
+    t2 = TpuTable.from_numpy({"a": a, "b": b})
+    emit("distinct_two_int_keys_packed", _bench(lambda: t2.distinct(["a", "b"]), reps))
+    emit(
+        "distinct_count_two_int_keys",
+        _bench(lambda: t2.distinct_count(["a", "b"]), reps),
+    )
+
+    # -- column concat (union_all) ---------------------------------------
+    emit(
+        "union_all_int_columns",
+        _bench(lambda: l_int.union_all(l_int), reps),
+        n=rows * 2,
+    )
+    # two DISTINCT overlapping vocabularies so the union exercises a real
+    # vocab merge + code remap
+    vhalf = build_n // 2
+    s1 = TpuTable.from_columns({"k": strs[:vhalf].tolist()})
+    s2 = TpuTable.from_columns({"k": strs[vhalf // 2 : vhalf // 2 + vhalf].tolist()})
+    emit(
+        "union_all_string_columns_vocab_merge",
+        _bench(lambda: s1.union_all(s2), reps),
+        n=2 * vhalf,
+    )
+
+
+if __name__ == "__main__":
+    main()

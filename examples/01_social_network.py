@@ -11,6 +11,16 @@ import sys
 
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
+import jax
+
+try:
+    # quickstart demos pin CPU: some environments pre-register an accelerator
+    # platform that wins over env vars (see tests/conftest.py); on real TPU
+    # hardware drop this line
+    jax.config.update("jax_platforms", "cpu")
+except Exception:
+    pass
+
 from tpu_cypher import CypherSession
 from tpu_cypher.api.mapping import NodeMappingBuilder, RelationshipMappingBuilder
 from tpu_cypher.relational.graphs import ElementTable

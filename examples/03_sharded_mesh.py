@@ -63,8 +63,7 @@ def main():
         g = s.read_from(ElementTable(nm, nodes), ElementTable(rm, rels))
         r = g.cypher("MATCH (a:V)-[:E]->(b)-[:E]->(c) RETURN count(*) AS paths")
         print(r.records.show())
-        col = g._graph.scans[0].table._cols["id"]
-        print("node id column sharding:", col.data.sharding)
+        print("executed over mesh:", mesh)
 
 
 if __name__ == "__main__":

@@ -166,3 +166,27 @@ def test_sharded_training_step(mesh):
     assert int(two_hop) == expected_two_hop
     # hop 1 count with all-ones start = number of edges
     assert int(np.asarray(hop_counts)[0]) == g.num_edges
+
+
+def test_microbenchmarks_run(monkeypatch):
+    """The JMH-analog microbench module (benchmarks/micro.py) must stay
+    runnable: every metric prints a valid JSON line at tiny sizes."""
+    import io
+    import json
+    import os
+    import runpy
+    from contextlib import redirect_stdout
+
+    monkeypatch.setenv("MICRO_ROWS", "400")
+    monkeypatch.setenv("MICRO_REPS", "1")
+    here = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    buf = io.StringIO()
+    with redirect_stdout(buf):
+        runpy.run_path(
+            os.path.join(here, "benchmarks", "micro.py"), run_name="__main__"
+        )
+    lines = [l for l in buf.getvalue().splitlines() if l.strip()]
+    assert len(lines) >= 8
+    for l in lines:
+        rec = json.loads(l)
+        assert rec["value"] > 0 and rec["unit"] == "rows/s"

@@ -421,8 +421,9 @@ def _unify_vocab(a: Column, b: Column) -> Tuple[Column, Column]:
 
 def _remap(c: Column, merged: List[str]) -> Column:
     old = c.vocab or []
+    index = {s: i for i, s in enumerate(merged)}  # O(V), not list.index O(V^2)
     lut = np.array(
-        [merged.index(s) for s in old] + [0], dtype=np.int32
+        [index[s] for s in old] + [0], dtype=np.int32
     )  # extra slot for null code indexing
     codes = np.asarray(c.data)
     new_codes = np.where(codes >= 0, lut[np.clip(codes, 0, len(old) - 1 if old else 0)], _NULL_CODE)

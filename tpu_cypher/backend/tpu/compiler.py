@@ -325,7 +325,8 @@ class TpuEvaluator:
             arr = jnp.asarray(np.array(cand, dtype=np.float64)) if cand else None
         elif l.kind == STR:
             vocab = l.vocab or []
-            cand = [vocab.index(v) for v in values if isinstance(v, str) and v in vocab]
+            idx = {s: i for i, s in enumerate(vocab)}
+            cand = [idx[v] for v in values if isinstance(v, str) and v in idx]
             arr = jnp.asarray(np.array(cand, dtype=np.int32)) if cand else None
         else:
             raise TpuUnsupportedExpr(f"IN over {l.kind}")
