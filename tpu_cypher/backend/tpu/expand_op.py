@@ -194,11 +194,16 @@ class CsrExpandOp(_FusedExpandBase):
         total = 0
         for reverse, drop_loops in halves:
             rp, ci, _ = gi.csr(self.types_key, reverse, ctx)
+            if unrestricted and not drop_loops:
+                # the hot reduction: sum of CSR degrees over the frontier —
+                # a Pallas kernel tiles it through VMEM on a TPU backend,
+                # an O(frontier) jnp two-gather elsewhere
+                from .pallas_kernels import csr_frontier_degree_sum
+
+                total += int(csr_frontier_degree_sum(rp, pos, present))
+                continue
             deg = (jnp.take(rp, pos + 1) - jnp.take(rp, pos)).astype(jnp.int64)
             deg = jnp.where(present, deg, 0)
-            if unrestricted and not drop_loops:
-                total += int(deg.sum())
-                continue
             t = int(deg.sum())
             nrows = int(pos.shape[0])
             row = jnp.repeat(
