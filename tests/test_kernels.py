@@ -193,15 +193,15 @@ def test_microbenchmarks_run(monkeypatch):
 
 
 def test_pallas_frontier_degree_sum_matches_jnp():
-    """The Pallas degree-sum kernel (interpret mode on CPU) is bit-identical
-    to the jnp gather+sum it replaces, incl. padding slots and empty input."""
+    """The Pallas degree-sum program (interpret mode on CPU) is bit-identical
+    to the jnp gather+sum it replaces, incl. masked slots and empty input."""
     import numpy as np
     import jax.numpy as jnp
 
     from tpu_cypher.backend.tpu.pallas_kernels import (
         HAVE_PALLAS,
-        frontier_degree_sum,
-        frontier_degree_sum_or_jnp,
+        _csr_deg_sum_jnp,
+        csr_frontier_degree_sum,
     )
 
     if not HAVE_PALLAS:
@@ -210,32 +210,68 @@ def test_pallas_frontier_degree_sum_matches_jnp():
         pytest.skip("pallas unavailable in this jax build")
     rng = np.random.default_rng(5)
     for n_nodes, n_frontier in [(1, 1), (7, 3), (1000, 3333), (4096, 1024)]:
-        deg = jnp.asarray(rng.integers(0, 100, n_nodes).astype(np.int32))
-        fr = jnp.asarray(rng.integers(0, n_nodes, n_frontier).astype(np.int32))
-        want = int(np.asarray(deg)[np.asarray(fr)].sum())
-        assert int(frontier_degree_sum(deg, fr)) == want
-        assert int(frontier_degree_sum_or_jnp(deg, fr)) == want
-    # masked (padding) slots contribute zero
-    deg = jnp.asarray(np.array([5, 7], np.int32))
-    fr = jnp.asarray(np.array([1, -1, 0], np.int32))
-    assert int(frontier_degree_sum(deg, fr)) == 12
-    assert int(frontier_degree_sum(deg, jnp.zeros(0, jnp.int32))) == 0
+        deg = rng.integers(0, 100, n_nodes).astype(np.int32)
+        rp = jnp.asarray(np.concatenate([[0], np.cumsum(deg)]).astype(np.int32))
+        pos = jnp.asarray(rng.integers(0, n_nodes, n_frontier).astype(np.int64))
+        present = jnp.asarray(rng.random(n_frontier) < 0.8)
+        want = int(
+            np.where(np.asarray(present), deg[np.asarray(pos)], 0).sum()
+        )
+        got_pallas = int(
+            csr_frontier_degree_sum(
+                rp, pos, present, max_deg=int(deg.max()), interpret=True
+            )
+        )
+        got_jnp = int(_csr_deg_sum_jnp(rp, pos, present))
+        assert got_pallas == want
+        assert got_jnp == want
+    # empty frontier routes to the jnp path and sums to zero
+    rp = jnp.asarray(np.array([0, 5, 12], np.int32))
+    assert (
+        int(
+            csr_frontier_degree_sum(
+                rp, jnp.zeros(0, jnp.int64), jnp.zeros(0, bool), max_deg=7,
+                interpret=True,
+            )
+        )
+        == 0
+    )
 
 
-def test_count_only_expand_uses_degree_sum_path(monkeypatch):
-    """2-hop count through the engine is exact (differential vs oracle) AND
-    genuinely routes through the degree-sum count path."""
+def test_branching_pattern_counts_match_oracle():
+    """Branching MATCH patterns stack CsrExpandOps whose frontier is NOT the
+    child's far node; the fused count chain must NOT compose them (regression
+    for a real miscount found in review: 1 vs 5)."""
     from tpu_cypher import CypherSession
-    from tpu_cypher.backend.tpu import expand_op, pallas_kernels
+
+    create = "CREATE (a:V)-[:E]->(b:V), (a)-[:E]->(c:V), (b)-[:E]->(c)"
+    queries = [
+        "MATCH (x:V)-[:E]->(y), (x)-[:E]->(z) RETURN count(*) AS c",
+        "MATCH (x)-[:E]->(y), (z)-[:E]->(x) RETURN count(*) AS c",
+        "MATCH (x)-[:E]->(y)-[:E]->(z), (y)-[:E]->(w) RETURN count(*) AS c",
+    ]
+    gl = CypherSession.local().create_graph_from_create_query(create)
+    gt = CypherSession.tpu().create_graph_from_create_query(create)
+    for q in queries:
+        want = gl.cypher(q).records.collect()
+        got = gt.cypher(q).records.collect()
+        assert got == want, f"{q}: {got} != {want}"
+
+
+def test_count_only_2hop_uses_fused_chain(monkeypatch):
+    """2-hop count through the engine is exact (differential vs oracle) AND
+    genuinely routes through the single-program fused count chain."""
+    from tpu_cypher import CypherSession
+    from tpu_cypher.backend.tpu import jit_ops
 
     calls = {"n": 0}
-    orig = pallas_kernels.csr_frontier_degree_sum
+    orig = jit_ops.path_count_chain
 
-    def spy(rp, pos, present):
+    def spy(*a, **kw):
         calls["n"] += 1
-        return orig(rp, pos, present)
+        return orig(*a, **kw)
 
-    monkeypatch.setattr(pallas_kernels, "csr_frontier_degree_sum", spy)
+    monkeypatch.setattr(jit_ops, "path_count_chain", spy)
 
     create = (
         "CREATE (a:V {i:0})-[:E]->(b:V {i:1})-[:E]->(c:V {i:2}),"
@@ -245,4 +281,50 @@ def test_count_only_expand_uses_degree_sum_path(monkeypatch):
     want = CypherSession.local().create_graph_from_create_query(create).cypher(q).records.collect()
     got = CypherSession.tpu().create_graph_from_create_query(create).cypher(q).records.collect()
     assert got == want
-    assert calls["n"] >= 1, "count query bypassed the degree-sum path"
+    assert calls["n"] >= 1, "count query bypassed the fused count chain"
+
+
+def test_count_chain_failure_falls_back_to_classic(monkeypatch):
+    """If the fused count chain raises, the classic shadow cascade must
+    still answer correctly — including with PRUNED fused inputs (the shadow
+    shares the pruned child op, so its headers must recompute post-prune)."""
+    from tpu_cypher import CypherSession
+    from tpu_cypher.backend.tpu import jit_ops
+    from tpu_cypher.backend.tpu.graph_index import GraphIndexError
+
+    def boom(*a, **kw):
+        raise GraphIndexError("forced chain failure")
+
+    monkeypatch.setattr(jit_ops, "path_count_chain", boom)
+
+    create = (
+        "CREATE (a:V {i:0})-[:E]->(b:V {i:1})-[:E]->(c:V {i:2}),"
+        "(a)-[:E]->(c), (c)-[:E]->(a)"
+    )
+    q = "MATCH (x:V)-[:E]->(y)-[:E]->(z) RETURN count(*) AS c"
+    want = CypherSession.local().create_graph_from_create_query(create).cypher(q).records.collect()
+    got = CypherSession.tpu().create_graph_from_create_query(create).cypher(q).records.collect()
+    assert got == want
+
+
+def test_count_only_1hop_uses_degree_sum_path(monkeypatch):
+    """Single-hop unrestricted count routes through the Pallas/jnp frontier
+    degree-sum (O(frontier) with VMEM tiling on TPU), not the edge dot."""
+    from tpu_cypher import CypherSession
+    from tpu_cypher.backend.tpu import pallas_kernels
+
+    calls = {"n": 0}
+    orig = pallas_kernels.csr_frontier_degree_sum
+
+    def spy(rp, pos, present, **kw):
+        calls["n"] += 1
+        return orig(rp, pos, present, **kw)
+
+    monkeypatch.setattr(pallas_kernels, "csr_frontier_degree_sum", spy)
+
+    create = "CREATE (a:V)-[:E]->(b:V)-[:E]->(c:V), (a)-[:E]->(c)"
+    q = "MATCH (x:V)-[:E]->(y) RETURN count(*) AS c"
+    want = CypherSession.local().create_graph_from_create_query(create).cypher(q).records.collect()
+    got = CypherSession.tpu().create_graph_from_create_query(create).cypher(q).records.collect()
+    assert got == want
+    assert calls["n"] >= 1, "1-hop count bypassed the degree-sum path"

@@ -72,6 +72,10 @@ class Column:
     # computed); computed at most once per column instance so f64-promotion
     # guards don't sync repeatedly
     _beyond_f64: Optional[bool] = None
+    # host mirror of ``data`` when the column was BUILT from host data
+    # (``from_numpy``): decoding such a column costs zero device round
+    # trips (a D2H fetch is ~73ms over a tunneled TPU even for one scalar)
+    _np_cache: Optional[np.ndarray] = None
 
     def ints_beyond_f64(self) -> bool:
         """True when a VALID int64 payload exceeds f64 exactness (2**53)."""
@@ -154,11 +158,14 @@ class Column:
         arr = np.asarray(arr)
         v = shard_rows(jnp.asarray(valid)) if valid is not None else None
         if arr.dtype == np.bool_:
-            return Column(BOOL, shard_rows(jnp.asarray(arr)), v)
+            host = arr.copy()
+            return Column(BOOL, shard_rows(jnp.asarray(host)), v, _np_cache=host)
         if np.issubdtype(arr.dtype, np.integer):
-            return Column(I64, shard_rows(jnp.asarray(arr.astype(np.int64))), v)
+            host = arr.astype(np.int64, copy=True)
+            return Column(I64, shard_rows(jnp.asarray(host)), v, _np_cache=host)
         if np.issubdtype(arr.dtype, np.floating):
-            return Column(F64, shard_rows(jnp.asarray(arr.astype(np.float64))), v)
+            host = arr.astype(np.float64, copy=True)
+            return Column(F64, shard_rows(jnp.asarray(host)), v, _np_cache=host)
         raise TpuBackendError(f"from_numpy: unsupported dtype {arr.dtype}")
 
     def to_values(self, row_mask: Optional[np.ndarray] = None) -> List[Any]:
@@ -166,7 +173,7 @@ class Column:
         if self.kind == OBJ:
             vals = list(self.data)
         else:
-            data = np.asarray(self.data)
+            data = self._np_cache if self._np_cache is not None else np.asarray(self.data)
             valid = np.asarray(self.valid) if self.valid is not None else None
             if self.kind == I64:
                 vals = [
@@ -207,15 +214,15 @@ class Column:
     # -- ops ---------------------------------------------------------------
 
     def take(self, idx) -> "Column":
-        """Gather rows by index array (device gather)."""
+        """Gather rows by index array (ONE jitted dispatch for data +
+        masks; eager per-array gathers pay ~1s dispatch each on a tunneled
+        TPU — see ``jit_ops``)."""
         if self.kind == OBJ:
             return Column(OBJ, self.data[np.asarray(idx)], None)
-        data = jnp.take(self.data, idx, axis=0)
-        valid = jnp.take(self.valid, idx, axis=0) if self.valid is not None else None
-        iflag = (
-            jnp.take(self.int_flag, idx, axis=0) if self.int_flag is not None else None
-        )
-        return Column(self.kind, data, valid, self.vocab, int_flag=iflag)
+        from .jit_ops import cols_take
+
+        d, v, i = cols_take({"c": (self.data, self.valid, self.int_flag)}, idx)["c"]
+        return Column(self.kind, d, v, self.vocab, int_flag=i)
 
     def take_or_null(self, idx, in_bounds) -> "Column":
         """Gather; rows where ``in_bounds`` is False become null (outer joins)."""
@@ -239,17 +246,12 @@ class Column:
             for i in range(len(idx_np)):
                 out[i] = self.data[idx_np[i]] if ib[i] else None
             return Column(OBJ, out, None)
-        safe = jnp.where(in_bounds, idx, 0)
-        data = jnp.take(self.data, safe, axis=0)
-        valid = (
-            jnp.take(self.valid, safe, axis=0) if self.valid is not None else jnp.ones(len(idx), bool)
-        )
-        iflag = (
-            jnp.take(self.int_flag, safe, axis=0) & in_bounds
-            if self.int_flag is not None
-            else None
-        )
-        return Column(self.kind, data, valid & in_bounds, self.vocab, int_flag=iflag)
+        from .jit_ops import cols_take_or_null
+
+        d, v, i = cols_take_or_null(
+            {"c": (self.data, self.valid, self.int_flag)}, idx, in_bounds
+        )["c"]
+        return Column(self.kind, d, v, self.vocab, int_flag=i)
 
     def concat(self, other: "Column") -> "Column":
         a, b = self, other
@@ -350,16 +352,6 @@ class Column:
             return jnp.ones(len(self), bool)
         return self.valid
 
-    def sort_key(self, descending: bool = False):
-        """A numeric array whose ascending order == Cypher orderability
-        (nulls last ascending). Returns (primary, is_null) pair arrays —
-        both device-resident."""
-        if self.kind == OBJ:
-            raise TpuBackendError("Cannot sort object columns on device")
-        null = ~self.valid_mask()
-        data = self.data.astype(jnp.float64) if self.kind == F64 else self.data
-        return data, null
-
     def slice(self, lo: int, hi: int) -> "Column":
         """Contiguous row slice (device slice — no gather)."""
         if self.kind == OBJ:
@@ -369,34 +361,11 @@ class Column:
         iflag = self.int_flag[lo:hi] if self.int_flag is not None else None
         return Column(self.kind, data, valid, self.vocab, int_flag=iflag)
 
-    def equivalence_keys(self) -> List[Any]:
-        """Device key arrays whose row-wise equality == Cypher equivalence
-        for this column: null payloads canonicalized to 0 (outer joins leave
-        arbitrary data under valid=False), NaN gets its own equivalence class
-        (keyed by a separate flag), and -0.0 == 0.0. Shared by ``distinct``
-        and ``group`` ONLY — join keys deliberately implement ``=`` semantics
-        instead (NaN never matches), so they must not use these keys."""
-        if self.kind == OBJ:
-            raise TpuBackendError("object columns have no device keys")
-        valid = self.valid_mask()
-        data = self.data
-        keys: List[Any] = []
-        if self.kind == F64:
-            nan = jnp.isnan(data) & valid
-            data = jnp.where(valid & ~nan, data, 0.0)
-            data = data + 0.0  # -0.0 == 0.0
-            keys.append(nan)
-        elif self.kind == BOOL:
-            data = data.astype(jnp.int8)
-        if self.valid is None:
-            # no nulls: the null-class key is constant — skip it (halves the
-            # stable sorts for the hot id-distinct path)
-            keys.append(data)
-            return keys
-        data = jnp.where(valid, data, jnp.zeros((), data.dtype))
-        keys.append(data)
-        keys.append(~valid)
-        return keys
+    # NOTE: Cypher-equivalence sort keys (null canonical 0, NaN its own
+    # class, -0.0 == 0.0) are built inside the jitted factorization —
+    # ``jit_ops._equivalence_keys_traced`` — shared by distinct and group.
+    # Join keys deliberately implement ``=`` semantics instead (NaN never
+    # matches), so they must not use those keys.
 
     def cypher_type(self) -> CypherType:
         base = {
@@ -432,9 +401,11 @@ def _remap(c: Column, merged: List[str]) -> Column:
 
 def mask_to_idx(mask) -> Tuple[Any, int]:
     """Boolean device mask -> (index array, count) with ONE scalar sync —
-    the shared compaction idiom of the table ops and the fused expand path."""
-    count = int(mask.sum())
-    return jnp.nonzero(mask, size=count)[0], count
+    the shared compaction idiom of the table ops and the fused expand path.
+    Both phases are cached jitted programs (``jit_ops.mask_to_idx``)."""
+    from .jit_ops import mask_to_idx as _jit_mask_to_idx
+
+    return _jit_mask_to_idx(mask)
 
 
 def constant_column(value: Any, n: int) -> Column:

@@ -29,7 +29,8 @@ import jax.numpy as jnp
 from ...ir import expr as E
 from ...relational.header import RecordHeader
 from ...relational.ops import RelationalOperator
-from .column import Column, TpuBackendError, mask_to_idx as _mask_to_idx
+from . import jit_ops as J
+from .column import OBJ, Column, TpuBackendError, mask_to_idx as _mask_to_idx
 from .graph_index import CANON_NODE, CANON_REL, GraphIndex, GraphIndexError, rekey_element_expr
 
 
@@ -40,10 +41,6 @@ def _owner_name(e: E.Expr) -> Optional[str]:
     if isinstance(inner, E.Var):
         return inner.name
     return None
-
-
-def _exclusive_cumsum(x):
-    return jnp.concatenate([jnp.zeros(1, x.dtype), jnp.cumsum(x)])[:-1]
 
 
 class _FusedExpandBase(RelationalOperator):
@@ -106,13 +103,16 @@ class _FusedExpandBase(RelationalOperator):
         header = self.header
         canon_rel = E.Var(CANON_REL)
         canon_node = E.Var(CANON_NODE)
-        out: Dict[str, Column] = {}
+        # gather plan: (source column, which index) per output column; the
+        # actual gathers run as ONE jitted dispatch per index source
+        plan: Dict[str, Tuple[Column, str]] = {}
+        swap_plan: Dict[str, Tuple[Column, Column]] = {}
         for e in header.expressions:
             col = header.column(e)
-            if col in out:
+            if col in plan or col in swap_plan:
                 continue
             if e in in_op.header:
-                out[col] = in_t._cols[in_op.header.column(e)].take(row)
+                plan[col] = (in_t._cols[in_op.header.column(e)], "row")
                 continue
             owner = _owner_name(e)
             if owner == rel_var:
@@ -123,25 +123,46 @@ class _FusedExpandBase(RelationalOperator):
                         if isinstance(e, E.StartNode)
                         else E.StartNode(canon_rel)
                     )
-                    a = rel_cols[rel_header.column(key)].take(orig)
-                    b = rel_cols[rel_header.column(flipped)].take(orig)
-                    data = jnp.where(swapped, b.data, a.data)
-                    valid = None
-                    if a.valid is not None or b.valid is not None:
-                        valid = jnp.where(swapped, b.valid_mask(), a.valid_mask())
-                    out[col] = Column(a.kind, data, valid, a.vocab)
+                    swap_plan[col] = (
+                        rel_cols[rel_header.column(key)],
+                        rel_cols[rel_header.column(flipped)],
+                    )
                     continue
                 if key is None or key not in rel_header:
                     raise GraphIndexError(f"unmapped rel expr {e!r}")
-                out[col] = rel_cols[rel_header.column(key)].take(orig)
+                plan[col] = (rel_cols[rel_header.column(key)], "orig")
                 continue
             if far_var is not None and owner == far_var:
                 key = rekey_element_expr(e, canon_node)
                 if key is None or key not in node_header:
                     raise GraphIndexError(f"unmapped node expr {e!r}")
-                out[col] = node_cols[node_header.column(key)].take(far_rows)
+                plan[col] = (node_cols[node_header.column(key)], "far")
                 continue
             raise GraphIndexError(f"unmapped expr {e!r}")
+        idx_by_tag = {"row": row, "orig": orig, "far": far_rows}
+        out: Dict[str, Column] = {}
+        for tag, idx in idx_by_tag.items():
+            group = {c: src for c, (src, t) in plan.items() if t == tag}
+            if not group:
+                continue
+            obj_cols = {c: s for c, s in group.items() if s.kind == OBJ}
+            dev = {
+                c: (s.data, s.valid, s.int_flag)
+                for c, s in group.items()
+                if s.kind != OBJ
+            }
+            if dev:
+                taken = J.cols_take(dev, idx)
+                for c, (d, v, i) in taken.items():
+                    s = group[c]
+                    out[c] = Column(s.kind, d, v, s.vocab, int_flag=i)
+            for c, s in obj_cols.items():
+                out[c] = s.take(idx)
+        for c, (a, b) in swap_plan.items():
+            data, valid = J.gather_swapped(
+                a.data, b.data, a.valid, b.valid, orig, swapped
+            )
+            out[c] = Column(a.kind, data, valid, a.vocab)
         return TpuTable(out, n_out)
 
 
@@ -181,83 +202,105 @@ class CsrExpandOp(_FusedExpandBase):
         t = "|".join(self.types_key) or "*"
         return f"({self.frontier_fld}){arrow}[{self.rel_fld}:{t}]({self.far_fld})"
 
-    def _count_total(self, gi: GraphIndex, pos, present, ctx) -> int:
-        """Output cardinality without materialization: per-frontier-row CSR
-        degree sums; far-label filtering and undirected self-loop exclusion
-        count per edge but never gather ``orig``/assemble columns."""
-        halves = [(self.backwards, False)]
-        if self.undirected:
-            halves.append((not self.backwards, True))
-        unrestricted = not self.far_labels
-        if not unrestricted:
-            _, _, row_map = gi.node_scan(self.far_labels, ctx)
-        total = 0
-        for reverse, drop_loops in halves:
-            rp, ci, _ = gi.csr(self.types_key, reverse, ctx)
-            if unrestricted and not drop_loops:
-                # the hot reduction: sum of CSR degrees over the frontier —
-                # a Pallas kernel tiles it through VMEM on a TPU backend,
-                # an O(frontier) jnp two-gather elsewhere
-                from .pallas_kernels import csr_frontier_degree_sum
-
-                total += int(csr_frontier_degree_sum(rp, pos, present))
-                continue
-            deg = (jnp.take(rp, pos + 1) - jnp.take(rp, pos)).astype(jnp.int64)
-            deg = jnp.where(present, deg, 0)
-            t = int(deg.sum())
-            nrows = int(pos.shape[0])
-            row = jnp.repeat(
-                jnp.arange(nrows, dtype=jnp.int64), deg, total_repeat_length=t
-            )
-            base = jnp.take(rp, pos).astype(jnp.int64) - _exclusive_cumsum(deg)
-            edge = jnp.repeat(base, deg, total_repeat_length=t) + jnp.arange(
-                t, dtype=jnp.int64
-            )
-            nbr = jnp.take(ci, edge).astype(jnp.int64)
-            keep = jnp.ones(t, bool)
-            if not unrestricted:
-                keep = keep & (jnp.take(row_map, nbr) >= 0) if gi.num_nodes else keep
-            if drop_loops:
-                keep = keep & (nbr != jnp.take(pos, row))
-            total += int(keep.sum())
-        return total
-
     def _expand_half(self, gi: GraphIndex, pos, present, reverse: bool, drop_loops: bool):
         ctx = self.context
         rp, ci, eo = gi.csr(self.types_key, reverse, ctx)
-        deg = (jnp.take(rp, pos + 1) - jnp.take(rp, pos)).astype(jnp.int64)
-        deg = jnp.where(present, deg, 0)
-        total = int(deg.sum())
-        nrows = int(pos.shape[0])
-        row = jnp.repeat(
-            jnp.arange(nrows, dtype=jnp.int64), deg, total_repeat_length=total
-        )
-        base = jnp.take(rp, pos).astype(jnp.int64) - _exclusive_cumsum(deg)
-        edge = jnp.repeat(base, deg, total_repeat_length=total) + jnp.arange(
-            total, dtype=jnp.int64
-        )
-        nbr = jnp.take(ci, edge).astype(jnp.int64)
-        orig = jnp.take(eo, edge)
+        deg, t_dev = J.expand_degrees_total(rp, pos, present)
+        total = int(t_dev)
+        row, nbr, orig = J.expand_materialize(rp, ci, eo, pos, deg, total=total)
         if drop_loops and total:
-            keep = nbr != jnp.take(pos, row)
+            keep = J.drop_loops_mask(nbr, pos, row)
             idx, _ = _mask_to_idx(keep)
-            row, nbr, orig = row[idx], nbr[idx], orig[idx]
+            row, nbr, orig = J.tree_take((row, nbr, orig), idx)
         return row, nbr, orig
 
-    def _fused_table(self):
-        in_op = self.children[0]
+    def _chain_hops(self) -> List["CsrExpandOp"]:
+        """Walk the input chain of directly-stacked CsrExpandOps over the
+        same graph (deepest last). Intermediate output columns are
+        irrelevant for counting: each op's row MULTISET is exactly its
+        child's multiset expanded, so a per-node multiplicity vector carries
+        complete information down the chain."""
+        hops: List[CsrExpandOp] = [self]
+        node = self
+        while True:
+            child = node.children[0]
+            if (
+                isinstance(child, CsrExpandOp)
+                and child._graph_obj is self._graph_obj
+                # linkage: this hop must expand FROM the child's far node —
+                # branching patterns ((x)-->(y), (x)-->(z)) stack expands
+                # whose frontier is NOT the previous far end, and composing
+                # their SpMVs would count the wrong paths
+                and node.frontier_fld == child.far_fld
+            ):
+                hops.append(child)
+                node = child
+                continue
+            return hops
+
+    def _count_via_chain(self, gi: GraphIndex, ctx) -> int:
+        """Whole-chain count as ONE jitted program (``path_count_chain``):
+        the engine's replacement for the reference's 2k-join cascade on a
+        count(*) query (``RelationalPlanner.scala:130-165``)."""
+        hops = self._chain_hops()
+        base = hops[-1]
+        in_op = base.children[0]
         in_t = in_op.table
+        frontier_var = in_op.header.var(base.frontier_fld)
+        id_col = in_t._cols[in_op.header.column(in_op.header.id_expr(frontier_var))]
+        gi.node_ids(ctx)  # build the compact id space (validates the graph)
+        if gi.num_nodes == 0:
+            return 0
+        if len(hops) == 1 and not self.undirected and not self.far_labels:
+            # single unrestricted hop: O(frontier) Pallas degree-sum (VMEM
+            # tiling) beats the chain's O(edges) SpMV
+            from .pallas_kernels import csr_frontier_degree_sum
+
+            pos, present = gi.compact_of(id_col, ctx)
+            rp, _, _ = gi.csr(self.types_key, self.backwards, ctx)
+            return int(
+                csr_frontier_degree_sum(
+                    rp, pos, present,
+                    max_deg=gi.csr_max_degree(self.types_key, self.backwards, ctx),
+                )
+            )
+        hop_data = []
+        for hop in reversed(hops):  # deepest (first executed) hop first
+            mask = gi.label_mask(hop.far_labels, ctx)
+            if hop.undirected:
+                rp_a, ci_a, _ = gi.csr(hop.types_key, hop.backwards, ctx)
+                rp_b, ci_b, _ = gi.csr(hop.types_key, not hop.backwards, ctx)
+                loop_cnt = gi.loop_count(hop.types_key, ctx)
+                hop_data.append((rp_a, ci_a, rp_b, ci_b, loop_cnt, mask))
+            else:
+                rp, ci, _ = gi.csr(hop.types_key, hop.backwards, ctx)
+                hop_data.append((rp, ci, None, None, None, mask))
+        dev_ids, _ = gi.node_ids(ctx)
+        return int(
+            J.path_count_chain(
+                dev_ids,
+                id_col.data,
+                id_col.valid,
+                tuple(hop_data),
+                num_nodes=gi.num_nodes,
+            )
+        )
+
+    def _fused_table(self):
         gi = GraphIndex.of(self.graph)
         ctx = self.context
+        if not self.header.expressions:
+            # pure-multiplicity consumer (a pruned count(*) plan): no rows
+            # are materialized at all — the whole stacked-expand chain runs
+            # as one fused device program
+            from .table import TpuTable
+
+            return TpuTable({}, self._count_via_chain(gi, ctx))
+        in_op = self.children[0]
+        in_t = in_op.table
         frontier_var = in_op.header.var(self.frontier_fld)
         id_col = in_t._cols[in_op.header.column(in_op.header.id_expr(frontier_var))]
         pos, present = gi.compact_of(id_col, ctx)
-        if not self.header.expressions:
-            # pure-multiplicity consumer (a pruned count(*) plan): the row
-            # count is a degree sum — skip materializing rows entirely
-            from .table import TpuTable
-
-            return TpuTable({}, self._count_total(gi, pos, present, ctx))
         primary_reverse = self.backwards
         row, nbr, orig = self._expand_half(
             gi, pos, present, reverse=primary_reverse, drop_loops=False
@@ -267,21 +310,32 @@ class CsrExpandOp(_FusedExpandBase):
             row2, nbr2, orig2 = self._expand_half(
                 gi, pos, present, reverse=not primary_reverse, drop_loops=True
             )
-            swapped = jnp.concatenate(
-                [jnp.zeros(row.shape[0], bool), jnp.ones(row2.shape[0], bool)]
+            row, nbr, orig, swapped = J.concat_expand_halves(
+                row, nbr, orig, row2, nbr2, orig2
             )
-            row = jnp.concatenate([row, row2])
-            nbr = jnp.concatenate([nbr, nbr2])
-            orig = jnp.concatenate([orig, orig2])
         # far-end label filter + node-table row lookup in one gather
         _, _, row_map = gi.node_scan(self.far_labels, ctx)
-        far_rows = jnp.take(row_map, nbr) if gi.num_nodes else jnp.zeros(0, jnp.int64)
-        keep = far_rows >= 0
-        idx, n_out = _mask_to_idx(keep)
-        if n_out != int(row.shape[0]):  # skip the no-op gather when all match
-            row, orig, far_rows = row[idx], orig[idx], far_rows[idx]
+        if gi.num_nodes and not self.far_labels:
+            # unrestricted far end: every neighbour is in the scan, so the
+            # keep mask is all-true by construction — skip the count sync
+            far_rows, _ = J.far_lookup(row_map, nbr)
+            n_out = int(row.shape[0])
+        elif gi.num_nodes:
+            far_rows, keep = J.far_lookup(row_map, nbr)
+            idx, n_out = _mask_to_idx(keep)
+            if n_out != int(row.shape[0]):  # skip the no-op gather when all match
+                if swapped is not None:
+                    row, orig, far_rows, swapped = J.tree_take(
+                        (row, orig, far_rows, swapped), idx
+                    )
+                else:
+                    row, orig, far_rows = J.tree_take((row, orig, far_rows), idx)
+        else:
+            far_rows = jnp.zeros(0, jnp.int64)
+            n_out = 0
+            row, orig = jnp.zeros(0, jnp.int64), jnp.zeros(0, jnp.int64)
             if swapped is not None:
-                swapped = swapped[idx]
+                swapped = jnp.zeros(0, bool)
         return self._assemble(
             gi, row, orig, swapped, far_rows, self.far_labels,
             self.rel_fld, self.far_fld, n_out,
@@ -320,24 +374,11 @@ class CsrExpandIntoOp(_FusedExpandBase):
     def _probe(self, gi: GraphIndex, keys, s_pos, t_pos, ok, drop_loops: bool):
         ctx = self.context
         _, _, eo = gi.csr(self.types_key, False, ctx)
-        n = gi.num_nodes
-        probe = s_pos * n + t_pos
-        if drop_loops:
-            ok = ok & (s_pos != t_pos)
-        lo = jnp.searchsorted(keys, probe, side="left")
-        hi = jnp.searchsorted(keys, probe, side="right")
-        counts = jnp.where(ok, hi - lo, 0).astype(jnp.int64)
-        total = int(counts.sum())
-        nrows = int(s_pos.shape[0])
-        row = jnp.repeat(
-            jnp.arange(nrows, dtype=jnp.int64), counts, total_repeat_length=total
+        lo, counts, total_dev = J.into_probe(
+            keys, s_pos, t_pos, ok, gi.num_nodes, drop_loops=drop_loops
         )
-        base = lo.astype(jnp.int64) - _exclusive_cumsum(counts)
-        edge = jnp.repeat(base, counts, total_repeat_length=total) + jnp.arange(
-            total, dtype=jnp.int64
-        )
-        orig = jnp.take(eo, edge)
-        return row, orig
+        total = int(total_dev)
+        return J.into_materialize(eo, lo, counts, total=total)
 
     def _fused_table(self):
         in_op = self.children[0]
@@ -355,11 +396,7 @@ class CsrExpandIntoOp(_FusedExpandBase):
         swapped = None
         if self.undirected:
             row2, orig2 = self._probe(gi, keys, t_pos, s_pos, ok, drop_loops=True)
-            swapped = jnp.concatenate(
-                [jnp.zeros(row.shape[0], bool), jnp.ones(row2.shape[0], bool)]
-            )
-            row = jnp.concatenate([row, row2])
-            orig = jnp.concatenate([orig, orig2])
+            row, orig, swapped = J.concat_into_halves(row, orig, row2, orig2)
         return self._assemble(
             gi, row, orig, swapped, None, (), self.rel_fld, None,
             int(row.shape[0]),

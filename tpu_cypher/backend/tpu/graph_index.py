@@ -89,8 +89,19 @@ class GraphIndex:
         self._rel_scans: Dict[Tuple[str, ...], Tuple[Dict, Any]] = {}
         # (types_key, reverse) -> (row_ptr, col_idx, edge_orig) device arrays
         self._csr: Dict[Tuple[Tuple[str, ...], bool], Tuple[Any, Any, Any]] = {}
+        # (types_key, reverse) -> host max out-degree (Pallas eligibility
+        # probe — computed once at build, never synced per query)
+        self._csr_max_deg: Dict[Tuple[Tuple[str, ...], bool], int] = {}
         # types_key -> sorted edge keys (src*N + dst), device int64
         self._edge_keys: Dict[Tuple[str, ...], Any] = {}
+        # types_key -> device int64[num_nodes] self-loop counts (undirected
+        # count chains subtract the double-counted loop contribution)
+        self._loop_count: Dict[Tuple[str, ...], Any] = {}
+        # labels_key -> device bool[num_nodes] (node carries the labels) or
+        # None for the unrestricted set
+        self._label_mask: Dict[Tuple[str, ...], Optional[Any]] = {}
+        # labels_key -> host row_map copy (mask building without a D2H sync)
+        self._row_map_np: Dict[Tuple[str, ...], np.ndarray] = {}
 
     # -- nodes -------------------------------------------------------------
 
@@ -139,9 +150,22 @@ class GraphIndex:
             raise GraphIndexError("node scan id outside the graph id space")
         row_map = np.full(n, -1, dtype=np.int64)
         row_map[pos] = np.arange(len(ids_np), dtype=np.int64)
+        self._row_map_np[key] = row_map
         out = (table._cols, header, jnp.asarray(row_map))
         self._node_scans[key] = out
         return out
+
+    def label_mask(self, labels: Tuple[str, ...], ctx) -> Optional[Any]:
+        """Device bool[num_nodes]: node carries the label set. ``None`` for
+        the empty set (every node qualifies — structurally skips the mask
+        multiply in fused count chains)."""
+        key = tuple(sorted(labels))
+        if not key:
+            return None
+        if key not in self._label_mask:
+            self.node_scan(key, ctx)
+            self._label_mask[key] = jnp.asarray(self._row_map_np[key] >= 0)
+        return self._label_mask[key]
 
     # -- relationships -----------------------------------------------------
 
@@ -187,6 +211,8 @@ class GraphIndex:
         order = np.lexsort((b, a))
         a_sorted = a[order]
         row_ptr = np.searchsorted(a_sorted, np.arange(n + 1)).astype(np.int32)
+        degs = row_ptr[1:] - row_ptr[:-1]
+        self._csr_max_deg[(types_key, reverse)] = int(degs.max()) if n else 0
         out = (
             # row_ptr is node-dim (replicated); the edge-dim arrays shard
             # over the active mesh — the hash-partitioned-relationship-table
@@ -200,7 +226,19 @@ class GraphIndex:
             # forward CSR order is lexsorted by (src, dst) => keys sorted
             keys = a_sorted.astype(np.int64) * n + b[order].astype(np.int64)
             self._edge_keys[types_key] = shard_rows(jnp.asarray(keys))
+        if not reverse and types_key not in self._loop_count:
+            loops = s[s == d]
+            self._loop_count[types_key] = jnp.asarray(
+                np.bincount(loops, minlength=n).astype(np.int64)
+            )
         return out
+
+    def loop_count(self, types_key: Tuple[str, ...], ctx):
+        """Device int64[num_nodes]: self-loop edges per node for one type
+        set (built host-side once with the forward CSR)."""
+        if types_key not in self._loop_count:
+            self.csr(types_key, False, ctx)
+        return self._loop_count[types_key]
 
     def edge_keys(self, types_key: Tuple[str, ...], ctx):
         """Sorted (src*N + dst) int64 device keys for ExpandInto probes."""
@@ -208,17 +246,22 @@ class GraphIndex:
             self.csr(types_key, False, ctx)
         return self._edge_keys[types_key]
 
+    def csr_max_degree(self, types_key: Tuple[str, ...], reverse: bool, ctx) -> int:
+        """Host-cached max degree of one CSR orientation (computed at
+        build — the Pallas int32 block-sum precondition check)."""
+        if (types_key, reverse) not in self._csr_max_deg:
+            self.csr(types_key, reverse, ctx)
+        return self._csr_max_deg[(types_key, reverse)]
+
     # -- id -> compact mapping --------------------------------------------
 
     def compact_of(self, id_col: Column, ctx) -> Tuple[Any, Any]:
         """Map an int64 element-id column to (compact ids, present mask)."""
+        from . import jit_ops as J
+
         dev_ids, _ = self.node_ids(ctx)
-        n = self.num_nodes
         ids = id_col.data
-        valid = id_col.valid_mask()
-        if n == 0:
+        if self.num_nodes == 0:
             z = jnp.zeros(ids.shape[0], jnp.int64)
             return z, jnp.zeros(ids.shape[0], bool)
-        pos = jnp.clip(jnp.searchsorted(dev_ids, ids), 0, n - 1)
-        present = valid & (jnp.take(dev_ids, pos) == ids)
-        return pos.astype(jnp.int64), present
+        return J.compact_lookup(dev_ids, ids, id_col.valid)

@@ -1,29 +1,30 @@
-"""Pallas TPU kernels for the hot frontier reductions.
+"""Pallas TPU kernel for the hot frontier degree-sum reduction.
 
-The fused expand path's count-only plans reduce to frontier degree sums
-(``expand_op._count_total``): ``total = sum_i deg[frontier[i]]``. XLA
+Single-hop count-only plans reduce to a frontier degree sum
+(``expand_op._count_via_chain``): ``total = sum_i deg[frontier[i]]``. XLA
 lowers that as gather + reduce through HBM; this Pallas kernel tiles the
 frontier through VMEM in (8, 128) int32 blocks with the degree vector
 VMEM-resident, accumulating one partial per program — the hand-scheduled
 version of the engine's hottest reduction (pallas guide: VPU elementwise +
 grid partials).
 
-CPU/tests run the same kernel under ``interpret=True`` (bit-identical
-semantics); the real lowering engages only on a TPU backend. Everything is
-gated: if Pallas is unavailable or the kernel fails to build, callers fall
-back to the jnp formulation.
+The single entry point is ``csr_frontier_degree_sum``; everything —
+degree-vector construction, frontier masking, padding, the grid call — is
+ONE cached jitted program (eager dispatch is ~1s/op on a tunneled TPU).
+CPU/tests run the identical program under ``interpret=True``; the real
+Mosaic lowering engages only on a TPU backend, and a lowering failure is
+remembered so the jnp formulation takes over permanently.
 
 Degrees are int32 and a (8x128)-element block sum must fit int32 — true
-for any graph with < 2**21 max degree; the cross-block total accumulates
-in int64 on the host side of the kernel.
+for any graph with < 2**21 max degree; callers pass the host-cached max
+degree (``GraphIndex.csr_max_degree``) so the eligibility check costs no
+device sync. The cross-block total accumulates in int64.
 """
 
 from __future__ import annotations
 
 from functools import partial
 from typing import Any
-
-import numpy as np
 
 import jax
 import jax.numpy as jnp
@@ -43,50 +44,41 @@ _BLOCK = _ROWS * _LANES
 
 def _deg_sum_kernel(deg_ref, idx_ref, out_ref):
     idx = idx_ref[...]
-    valid = idx >= 0  # padding slots are -1
+    valid = idx >= 0  # padding / not-present slots are -1
     vals = deg_ref[jnp.clip(idx, 0, deg_ref.shape[0] - 1)]
     out_ref[0, 0] = jnp.sum(jnp.where(valid, vals, 0))
 
 
+@jax.jit
+def _csr_deg_sum_jnp(rp, pos, present):
+    deg = (jnp.take(rp, pos + 1) - jnp.take(rp, pos)).astype(jnp.int64)
+    return jnp.sum(jnp.where(present, deg, 0))
+
+
 @partial(jax.jit, static_argnames=("interpret",))
-def _deg_sum_call(deg, idx2d, interpret):
+def _csr_deg_sum_pallas(rp, pos, present, interpret: bool = False):
+    """One jitted program: degree vector + frontier mask + pad/reshape +
+    the Pallas grid call (shapes are static under trace, so the padding
+    arithmetic costs nothing at dispatch time)."""
+    node_deg = (rp[1:] - rp[:-1]).astype(jnp.int32)
+    idx = jnp.where(present, pos, -1).astype(jnp.int32)
+    pad = (-idx.shape[0]) % _BLOCK
+    if pad:
+        idx = jnp.concatenate([idx, jnp.full(pad, -1, jnp.int32)])
+    idx2d = idx.reshape(-1, _LANES)
     grid = (idx2d.shape[0] // _ROWS,)
     partials = pl.pallas_call(
         _deg_sum_kernel,
         out_shape=jax.ShapeDtypeStruct((grid[0], 1), jnp.int32),
         grid=grid,
         in_specs=[
-            pl.BlockSpec((deg.shape[0],), lambda i: (0,)),
+            pl.BlockSpec((node_deg.shape[0],), lambda i: (0,)),
             pl.BlockSpec((_ROWS, _LANES), lambda i: (i, 0)),
         ],
         out_specs=pl.BlockSpec((1, 1), lambda i: (i, 0)),
         interpret=interpret,
-    )(deg, idx2d)
+    )(node_deg, idx2d)
     return jnp.sum(partials.astype(jnp.int64))
-
-
-def frontier_degree_sum(deg, frontier, *, interpret: bool | None = None):
-    """``sum(deg[frontier])`` via the Pallas kernel.
-
-    ``deg``: int32/int64 per-node degree vector; ``frontier``: int array of
-    node positions (may be empty). Returns a scalar int64 device value.
-    ``interpret`` defaults to True off-TPU so tests exercise the kernel
-    everywhere.
-    """
-    if not HAVE_PALLAS:
-        raise RuntimeError("pallas unavailable")
-    if interpret is None:
-        interpret = jax.default_backend() != "tpu"
-    n = int(frontier.shape[0])
-    if n == 0:
-        return jnp.int64(0)
-    deg32 = deg.astype(jnp.int32)
-    idx = frontier.astype(jnp.int32)
-    pad = (-n) % _BLOCK
-    if pad:
-        idx = jnp.concatenate([idx, jnp.full(pad, -1, jnp.int32)])
-    idx2d = idx.reshape(-1, _LANES)
-    return _deg_sum_call(deg32, idx2d, interpret)
 
 
 # set after the first lowering failure so a broken Mosaic build is paid for
@@ -94,40 +86,32 @@ def frontier_degree_sum(deg, frontier, *, interpret: bool | None = None):
 _PALLAS_BROKEN = False
 
 
-def _pallas_eligible(deg) -> bool:
-    if not HAVE_PALLAS or _PALLAS_BROKEN or jax.default_backend() != "tpu":
-        return False
-    # int32 block-sum precondition: an (8x128) block of max degrees must
-    # fit int32 — enforce, don't just document
-    return int(jnp.max(deg)) < 2**21 if deg.shape[0] else True
-
-
-def frontier_degree_sum_or_jnp(deg, frontier) -> Any:
-    """Pallas on a TPU backend (guarded), jnp gather+sum elsewhere — same
-    result (interpret mode is for TESTS; the interpreted grid loop would be
-    pure overhead in a CPU hot path)."""
-    global _PALLAS_BROKEN
-    if _pallas_eligible(deg):
-        try:
-            return frontier_degree_sum(deg, frontier, interpret=False)
-        except Exception:  # lowering failure: remember and fall back
-            _PALLAS_BROKEN = True
-    valid = frontier >= 0
-    safe = jnp.clip(frontier, 0, deg.shape[0] - 1)
-    vals = jnp.where(valid, jnp.take(deg, safe), 0)
-    return jnp.sum(vals.astype(jnp.int64))
-
-
-def csr_frontier_degree_sum(rp, pos, present) -> Any:
+def csr_frontier_degree_sum(
+    rp, pos, present, max_deg: int | None = None, *, interpret: bool | None = None
+) -> Any:
     """``sum over frontier rows of (rp[pos+1] - rp[pos])`` with ``present``
     masking. The Pallas path materializes the O(V) per-node degree vector it
     tiles through VMEM; the jnp path keeps the O(frontier) two-gather
-    formulation (no full-vector diff on CPU/GPU)."""
-    node_dim_ok = HAVE_PALLAS and not _PALLAS_BROKEN and jax.default_backend() == "tpu"
-    if node_dim_ok:
-        node_deg = rp[1:] - rp[:-1]
-        if _pallas_eligible(node_deg):
-            fr = jnp.where(present, pos, -1)
-            return frontier_degree_sum_or_jnp(node_deg, fr)
-    deg = (jnp.take(rp, pos + 1) - jnp.take(rp, pos)).astype(jnp.int64)
-    return jnp.sum(jnp.where(present, deg, 0))
+    formulation (no full-vector diff on CPU/GPU). ``max_deg``: host-cached
+    max degree — the int32 block-sum eligibility check without a per-call
+    device sync. ``interpret=True`` forces the interpreted Pallas program
+    (tests exercise the kernel semantics off-TPU)."""
+    global _PALLAS_BROKEN
+    force_interpret = interpret is True
+    pallas_ok = (
+        HAVE_PALLAS
+        and not _PALLAS_BROKEN
+        and (force_interpret or jax.default_backend() == "tpu")
+        and max_deg is not None
+        and max_deg < 2**21
+        and int(pos.shape[0]) > 0
+    )
+    if pallas_ok:
+        try:
+            return _csr_deg_sum_pallas(rp, pos, present, interpret=force_interpret)
+        except Exception:  # lowering failure: remember and fall back
+            if not force_interpret:
+                _PALLAS_BROKEN = True
+            else:
+                raise
+    return _csr_deg_sum_jnp(rp, pos, present)

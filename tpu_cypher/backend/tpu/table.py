@@ -42,6 +42,7 @@ import jax.numpy as jnp
 from ...api import types as T
 from ...api.table import Table
 from ...api.types import CypherType
+from . import jit_ops as J
 from .column import (
     BOOL,
     F64,
@@ -78,31 +79,6 @@ class _FallbackCounter:
 
 
 FALLBACK_COUNTER = _FallbackCounter()
-
-
-def _pack_int_keys(keys: List[Any]) -> List[Any]:
-    """Fold multiple integer/bool sort-key arrays into one int64 key when
-    their value ranges fit 63 bits combined (equality-preserving, order of
-    groups permuted — fine for factorization/dedup, NOT for ORDER BY)."""
-    if len(keys) <= 1 or int(keys[0].shape[0]) == 0:
-        return keys
-    for k in keys:
-        if not (jnp.issubdtype(k.dtype, jnp.integer) or k.dtype == jnp.bool_):
-            return keys
-    ints = [k.astype(jnp.int64) for k in keys]
-    mm = np.asarray(
-        jnp.stack(
-            [jnp.stack([k.min() for k in ints]), jnp.stack([k.max() for k in ints])]
-        )
-    )  # one device->host sync for every min/max
-    # unbounded Python ints: an int64 hi-lo could wrap and undercount bits
-    bits = [(int(hi) - int(lo)).bit_length() for lo, hi in zip(mm[0], mm[1])]
-    if sum(bits) > 63:
-        return keys
-    acc = jnp.zeros_like(ints[0])
-    for k, lo, b in zip(ints, mm[0], bits):
-        acc = (acc << b) | (k - int(lo))
-    return [acc]
 
 
 class TpuTable(Table):
@@ -201,8 +177,23 @@ class TpuTable(Table):
         )
 
     def _take(self, idx) -> "TpuTable":
+        """Gather all columns' device arrays in ONE jitted dispatch (per-op
+        eager gathers pay a dispatch round trip each on a tunneled TPU)."""
         n = int(idx.shape[0]) if hasattr(idx, "shape") else len(idx)
-        return TpuTable({c: col.take(idx) for c, col in self._cols.items()}, n)
+        dev = {
+            c: (col.data, col.valid, col.int_flag)
+            for c, col in self._cols.items()
+            if col.kind != OBJ
+        }
+        taken = J.cols_take(dev, idx) if dev else {}
+        out: Dict[str, Column] = {}
+        for c, col in self._cols.items():
+            if col.kind == OBJ:
+                out[c] = col.take(idx)
+            else:
+                d, v, i = taken[c]
+                out[c] = Column(col.kind, d, v, col.vocab, int_flag=i)
+        return TpuTable(out, n)
 
     def skip(self, n: int) -> "TpuTable":
         n = min(n, self._nrows)
@@ -232,7 +223,9 @@ class TpuTable(Table):
             c = TpuEvaluator(self, header, parameters).eval(expr)
         except TpuUnsupportedExpr:
             return self._from_local(self._to_local('filter:expr').filter(expr, header, parameters))
-        idx, _ = self._mask_to_idx(c.data & c.valid_mask())
+        if c.kind == OBJ:
+            return self._from_local(self._to_local('filter:obj-mask').filter(expr, header, parameters))
+        idx, _ = self._mask_to_idx(J.and_valid_mask(c.data, c.valid))
         return self._take(idx)
 
     # -- join --------------------------------------------------------------
@@ -301,101 +294,87 @@ class TpuTable(Table):
                     rk = _float_as_exact_int(rk)
             else:  # cross-kind keys never match
                 return self._join_empty_result(other, kind)
-        lvalid = lk.valid_mask()
-        rvalid = rk.valid_mask()
-        for c in [self._cols[l] for l, _ in join_cols[1:]]:
-            lvalid = lvalid & c.valid_mask()
-        for c in [other._cols[r] for _, r in join_cols[1:]]:
-            rvalid = rvalid & c.valid_mask()
-        ld, rd = lk.data, rk.data
-        if lk.kind == F64:  # NaN = NaN is false in Cypher: NaN keys never join
-            lvalid = lvalid & ~jnp.isnan(ld)
-            rvalid = rvalid & ~jnp.isnan(rd)
-        if lk.kind == BOOL:
-            ld, rd = ld.astype(jnp.int8), rd.astype(jnp.int8)
-        n = self._nrows
-        # build side: valid rows first, sorted by key (stable lexsort,
-        # primary key LAST in the tuple)
-        r_order = jnp.lexsort((rd, ~rvalid))
-        nvalid = int(rvalid.sum())
-        r_idx_valid = r_order[:nvalid]
-        r_sorted = rd[r_idx_valid]
-        lo = jnp.searchsorted(r_sorted, ld, side="left")
-        hi = jnp.searchsorted(r_sorted, ld, side="right")
-        counts = jnp.where(lvalid, hi - lo, 0).astype(jnp.int64)
-        total = int(counts.sum())
-        left_rows = jnp.repeat(
-            jnp.arange(n, dtype=jnp.int64), counts, total_repeat_length=total
+        # validity masks beyond the probe key's own (extra key columns must
+        # be non-null to match) — folded on device inside the jitted phases
+        l_extra_valid = tuple(
+            c.valid
+            for c in (self._cols[l] for l, _ in join_cols[1:])
+            if c.valid is not None and c.kind != OBJ
         )
-        starts = jnp.repeat(lo.astype(jnp.int64), counts, total_repeat_length=total)
-        excl = jnp.concatenate([jnp.zeros(1, jnp.int64), jnp.cumsum(counts)])[:-1]
-        offsets = jnp.arange(total, dtype=jnp.int64) - jnp.repeat(
-            excl, counts, total_repeat_length=total
+        r_extra_valid = tuple(
+            c.valid
+            for c in (other._cols[r] for _, r in join_cols[1:])
+            if c.valid is not None and c.kind != OBJ
         )
-        right_rows = (
-            r_idx_valid[starts + offsets]
-            if total
-            else jnp.zeros(0, jnp.int64)
+        lvalids = l_extra_valid + ((lk.valid,) if lk.valid is not None else ())
+        rvalids = r_extra_valid + ((rk.valid,) if rk.valid is not None else ())
+        is_f64 = lk.kind == F64
+        is_bool = lk.kind == BOOL
+        # phase 1: build side sorted valid-first (one jitted dispatch, one
+        # scalar sync for the valid count)
+        rd_s, r_order, nvalid_dev = J.join_build(rk.data, rvalids, is_f64=is_f64, is_bool=is_bool)
+        nvalid = int(nvalid_dev)
+        # phase 2: probe by binary search (one dispatch, one sync for total)
+        r_idx_valid, lo, counts, total_dev = J.join_probe(
+            rd_s, r_order, lk.data, lvalids, nvalid=nvalid, is_f64=is_f64, is_bool=is_bool
         )
+        total = int(total_dev)
+        # phase 3: materialize match row pairs (one dispatch, static total)
+        left_rows, right_rows = J.join_materialize(r_idx_valid, lo, counts, total=total)
         if len(join_cols) > 1 and total:
-            keep = jnp.ones(total, bool)
+            never_match = False
+            l_datas, l_valids2, r_datas, r_valids2, kinds = [], [], [], [], []
             for (lcn, rcn) in join_cols[1:]:
                 lc, rc = self._cols[lcn], other._cols[rcn]
                 if lc.kind == STR or rc.kind == STR:
                     if lc.kind != STR or rc.kind != STR:
-                        keep = jnp.zeros(total, bool)
+                        never_match = True
                         continue
                     from .column import _unify_vocab
 
                     lc, rc = _unify_vocab(lc, rc)
                 elif {lc.kind, rc.kind} == {I64, F64}:
-                    # same exact mixed numeric equality as the probe key
+                    # same exact mixed numeric equality as the probe key;
+                    # recast keys carry match-eligibility in their validity
+                    # mask (fractional/NaN floats -> invalid, data 0)
                     if lc.kind == F64:
                         lc = _float_as_exact_int(lc)
                     else:
                         rc = _float_as_exact_int(rc)
                 elif lc.kind != rc.kind:
-                    keep = jnp.zeros(total, bool)
+                    never_match = True
                     continue
-                lv = jnp.take(lc.data, left_rows)
-                rv = jnp.take(rc.data, right_rows)
-                eq = lv == rv
-                if lc.kind == F64:
-                    eq = eq & ~jnp.isnan(lv)
-                # recast mixed-kind keys carry match-eligibility in their
-                # validity mask (fractional/NaN floats -> invalid, data 0);
-                # without this AND they would spuriously equal integer 0
-                eq = eq & jnp.take(lc.valid_mask(), left_rows)
-                eq = eq & jnp.take(rc.valid_mask(), right_rows)
-                keep = keep & eq
-            idx, total = self._mask_to_idx(keep)
-            left_rows = left_rows[idx]
-            right_rows = right_rows[idx]
+                l_datas.append(lc.data)
+                l_valids2.append(lc.valid)
+                r_datas.append(rc.data)
+                r_valids2.append(rc.valid)
+                kinds.append(lc.kind)
+            if never_match:
+                left_rows = jnp.zeros(0, jnp.int64)
+                right_rows = jnp.zeros(0, jnp.int64)
+            elif kinds:
+                keep = J.extra_keys_keep(
+                    tuple(l_datas), tuple(l_valids2), tuple(r_datas),
+                    tuple(r_valids2), left_rows, right_rows, kinds=tuple(kinds),
+                )
+                idx, _ = self._mask_to_idx(keep)
+                left_rows, right_rows = J.tree_take((left_rows, right_rows), idx)
+        nmatched = int(left_rows.shape[0])
         left_matched = None
         right_matched = None
+        matched_right = right_rows
         if kind in ("left_outer", "full_outer"):
-            have = jnp.zeros(n, bool).at[left_rows].set(True)
-            miss_idx, nmiss = self._mask_to_idx(~have)
-            left_rows = jnp.concatenate([left_rows, miss_idx])
-            right_rows = jnp.concatenate(
-                [right_rows, jnp.zeros(nmiss, jnp.int64)]
-            )
-            right_matched = jnp.concatenate(
-                [jnp.ones(total, bool), jnp.zeros(nmiss, bool)]
+            miss = J.unmatched_mask(left_rows, n=self._nrows)
+            miss_idx, nmiss = self._mask_to_idx(miss)
+            left_rows, right_rows, right_matched = J.outer_pad_left(
+                left_rows, right_rows, miss_idx, nmiss=nmiss, nmatched=nmatched
             )
         if kind == "full_outer":
-            rhave = jnp.zeros(other._nrows, bool).at[
-                right_rows[: total]
-            ].set(True)
-            rmiss_idx, rnmiss = self._mask_to_idx(~rhave)
-            cur = int(left_rows.shape[0])
-            left_rows = jnp.concatenate([left_rows, jnp.zeros(rnmiss, jnp.int64)])
-            right_rows = jnp.concatenate([right_rows, rmiss_idx])
-            left_matched = jnp.concatenate(
-                [jnp.ones(cur, bool), jnp.zeros(rnmiss, bool)]
-            )
-            right_matched = jnp.concatenate(
-                [right_matched, jnp.ones(rnmiss, bool)]
+            rmiss = J.unmatched_mask(matched_right, n=other._nrows)
+            rmiss_idx, rnmiss = self._mask_to_idx(rmiss)
+            left_rows, right_rows, left_matched, right_matched = J.outer_pad_right(
+                left_rows, right_rows, right_matched, rmiss_idx,
+                nmiss=rnmiss, ncur=int(left_rows.shape[0]),
             )
         return self._combine(
             other, left_rows, right_rows, right_matched, left_matched
@@ -429,18 +408,35 @@ class TpuTable(Table):
         left_in_bounds=None,
     ) -> "TpuTable":
         out: Dict[str, Column] = {}
-        for c, col in self._cols.items():
-            if left_in_bounds is None:
-                out[c] = col.take(li)
-            else:
-                out[c] = col.take_or_null(li, left_in_bounds)
-        for c, col in other._cols.items():
-            if c in out:
+        for c in other._cols:
+            if c in self._cols:
                 raise TpuBackendError(f"Join column collision: {c}")
-            if right_in_bounds is None:
-                out[c] = col.take(ri)
+        for cols, idx, in_bounds in (
+            (self._cols, li, left_in_bounds),
+            (other._cols, ri, right_in_bounds),
+        ):
+            # one jitted dispatch per side for all device columns
+            dev = {
+                c: (col.data, col.valid, col.int_flag)
+                for c, col in cols.items()
+                if col.kind != OBJ and (in_bounds is None or len(col) > 0)
+            }
+            if dev:
+                taken = (
+                    J.cols_take(dev, idx)
+                    if in_bounds is None
+                    else J.cols_take_or_null(dev, idx, in_bounds)
+                )
             else:
-                out[c] = col.take_or_null(ri, right_in_bounds)
+                taken = {}
+            for c, col in cols.items():
+                if c in taken:
+                    d, v, i = taken[c]
+                    out[c] = Column(col.kind, d, v, col.vocab, int_flag=i)
+                elif in_bounds is None:
+                    out[c] = col.take(idx)
+                else:
+                    out[c] = col.take_or_null(idx, in_bounds)
         n = int(li.shape[0])
         return TpuTable(out, n)
 
@@ -457,72 +453,59 @@ class TpuTable(Table):
     # -- ordering ----------------------------------------------------------
 
     def order_by(self, items: Sequence[Tuple[str, bool]]) -> "TpuTable":
+        """ORDER BY: one jitted stable lexsort under Cypher orderability
+        (``jit_ops.order_permutation``) + one batched gather."""
         if any(self._cols[c].kind == OBJ for c, _ in items):
             return self._from_local(self._to_local('order_by:obj-keys').order_by(items))
-        keys = []
-        for colname, asc in reversed(list(items)):
-            col = self._cols[colname]
-            data, null = col.sort_key()
-            if col.kind == BOOL:
-                data = data.astype(jnp.int8)
-            if col.kind == F64:
-                nan = jnp.isnan(data)
-                data = jnp.where(nan, 0.0, data)  # NaN rank lives in the flag
-            else:
-                nan = None
-            # ascending Cypher order: numbers < NaN < null; DESC is the exact
-            # reverse, so every subkey is negated
-            if asc:
-                keys.append(data)
-                if nan is not None:
-                    keys.append(nan.astype(jnp.int8))
-                keys.append(null.astype(jnp.int8))
-            else:
-                keys.append(-data)
-                if nan is not None:
-                    keys.append(-nan.astype(jnp.int8))
-                keys.append(-null.astype(jnp.int8))
-        # device lexsort (stable): last key is primary — pairs were appended
-        # in reverse item order, null flag after data, so priority is item0
-        # null, item0 nan, item0 data, item1 null, ...
-        if not keys:
+        if not items:
             return self
-        idx = jnp.lexsort(tuple(keys))
-        return self._take(idx.astype(jnp.int64))
+        datas = tuple(self._cols[c].data for c, _ in items)
+        valids = tuple(self._cols[c].valid for c, _ in items)
+        kinds = tuple(self._cols[c].kind for c, _ in items)
+        ascs = tuple(bool(asc) for _, asc in items)
+        idx = J.order_permutation(datas, valids, kinds, ascs)
+        return self._take(idx)
 
     # -- distinct / group factorization ------------------------------------
 
-    def _equivalence_keys(self, on: Sequence[str]) -> List[Any]:
-        """Device key arrays over ``on`` whose row equality == Cypher
-        equivalence (see ``Column.equivalence_keys``)."""
-        keys: List[Any] = []
-        for c in on:
-            keys.extend(self._cols[c].equivalence_keys())
-        return keys
-
     def _first_occurrence_index(
         self, on: Sequence[str], extra_keys: Sequence[Any] = ()
-    ) -> Tuple[Any, Any]:
-        """Stable device lexsort over equivalence keys -> (sorted row order,
-        first-of-group flags over the sorted order). The stable sort makes
-        the first row of each equal-key run the earliest original row of
-        that group. ``extra_keys`` prepend higher-priority key arrays (e.g.
-        a group index for DISTINCT aggregates). All-integer key sets whose
-        ranges fit 63 bits are PACKED into one key — one sort instead of k
-        (group order is irrelevant here: callers renumber by first
-        occurrence)."""
-        keys = _pack_int_keys(list(extra_keys) + self._equivalence_keys(on))
-        n = int(keys[0].shape[0]) if keys else self._nrows
-        order = jnp.lexsort(tuple(reversed(keys)))
-        if n > 1:
-            diff = jnp.zeros(n - 1, bool)
-            for k in keys:
-                ks = jnp.take(k, order)
-                diff = diff | (ks[1:] != ks[:-1])
-            flags = jnp.concatenate([jnp.ones(1, bool), diff])
-        else:
-            flags = jnp.ones(n, bool)
-        return order, flags
+    ) -> Tuple[Any, Any, Any]:
+        """Stable device lexsort over Cypher-equivalence keys -> (sorted row
+        order, first-of-group flags over the sorted order, device group
+        count). The stable sort makes the first row of each equal-key run
+        the earliest original row of that group. ``extra_keys`` prepend
+        higher-priority key arrays (e.g. a group index for DISTINCT
+        aggregates). All-integer key sets whose ranges fit 63 bits are
+        PACKED into one key — one sort instead of k (group order is
+        irrelevant here: callers renumber by first occurrence). Two cached
+        jitted dispatches: a min/max probe (host decides packing) + the
+        sort itself (``jit_ops.equivalence_sort``)."""
+        datas = tuple(self._cols[c].data for c in on)
+        valids = tuple(self._cols[c].valid for c in on)
+        kinds = tuple(self._cols[c].kind for c in on)
+        extras = tuple(extra_keys)
+        pack = None
+        packable = (
+            self._nrows > 0
+            and all(k in (I64, BOOL, STR) for k in kinds)
+            and all(jnp.issubdtype(e.dtype, jnp.integer) or e.dtype == jnp.bool_
+                    for e in extras)
+        )
+        if packable:
+            mins, maxs = J.equivalence_minmax(datas, valids, extras, kinds)
+            mins = np.asarray(mins)
+            maxs = np.asarray(maxs)
+            if len(mins) > 1:
+                bits = [
+                    (int(hi) - int(lo)).bit_length()
+                    for lo, hi in zip(mins, maxs)
+                ]
+                if sum(bits) <= 63:
+                    pack = tuple(
+                        (int(lo), b) for lo, b in zip(mins, bits)
+                    )
+        return J.equivalence_sort(datas, valids, extras, kinds, pack=pack)
 
     def distinct_count(self, cols: Sequence[str]) -> Optional[int]:
         """Number of distinct rows over ``cols`` WITHOUT materializing them
@@ -531,8 +514,8 @@ class TpuTable(Table):
             return None
         if self._nrows == 0:
             return 0
-        _, flags = self._first_occurrence_index(list(cols))
-        return int(flags.sum())
+        _, _, cnt = self._first_occurrence_index(list(cols))
+        return int(cnt)
 
     def distinct(self, cols: Optional[Sequence[str]] = None) -> "TpuTable":
         on = list(cols) if cols is not None else self.physical_columns
@@ -542,9 +525,8 @@ class TpuTable(Table):
             return self.limit(1) if self._nrows > 1 else self
         if self._nrows == 0:
             return self
-        order, flags = self._first_occurrence_index(on)
-        idx, _ = self._mask_to_idx(flags)
-        first = jnp.sort(jnp.take(order, idx))  # keep original row order
+        order, flags, cnt = self._first_occurrence_index(on)
+        first = J.first_occurrence_rows(order, flags, k=int(cnt))
         return self._take(first)
 
     # -- aggregation / projection / explode --------------------------------
@@ -595,22 +577,36 @@ class TpuTable(Table):
 
         n = self._nrows
         out_cols: Dict[str, Column] = {}
-        if by and n > 0:
-            order, flags = self._first_occurrence_index(by)
-            flag_idx, k = self._mask_to_idx(flags)
-            # group id per sorted position, scattered back to row order
-            seg_sorted = jnp.cumsum(flags.astype(jnp.int64)) - 1
-            seg_rows = jnp.zeros(n, jnp.int64).at[order].set(seg_sorted)
-            # renumber groups in first-occurrence order (= the local oracle)
-            first_rows_keyorder = jnp.take(order, flag_idx)
-            rank_order = jnp.argsort(first_rows_keyorder)
-            rank = jnp.zeros(k, jnp.int64).at[rank_order].set(
-                jnp.arange(k, dtype=jnp.int64)
+        if not by and all(
+            isinstance(agg, E.Agg)
+            and agg.name.lower() == "count"
+            and agg.expr is None
+            for _, agg in aggregations
+        ):
+            # global count(*): the row count is already host-known — no
+            # device work at all (the fused count-only expand path ends here)
+            return TpuTable(
+                {
+                    out_col: Column.from_numpy(np.array([n], np.int64))
+                    for out_col, _ in aggregations
+                },
+                1,
             )
-            seg_j = jnp.take(rank, seg_rows)
-            first_rows = jnp.sort(first_rows_keyorder)
+        if by and n > 0:
+            order, flags, cnt = self._first_occurrence_index(by)
+            k = int(cnt)
+            # group ids renumbered in first-occurrence order (= the local
+            # oracle), one jitted dispatch
+            seg_j, first_rows = J.group_index(order, flags, k=k)
+            by_dev = {
+                c: (self._cols[c].data, self._cols[c].valid, self._cols[c].int_flag)
+                for c in by
+            }
+            taken = J.cols_take(by_dev, first_rows)
             for c in by:
-                out_cols[c] = self._cols[c].take(first_rows)
+                col = self._cols[c]
+                d, v, i = taken[c]
+                out_cols[c] = Column(col.kind, d, v, col.vocab, int_flag=i)
         elif by:  # zero rows with keys: no groups at all
             return self._from_local(
                 self._to_local('group:zero-rows').group(by, aggregations, header, parameters)
@@ -650,10 +646,9 @@ class TpuTable(Table):
         preserved (collect DISTINCT emits values in first-appearance order,
         like the oracle)."""
         tmp = TpuTable({"__v": col}, int(seg_j.shape[0]))
-        order, flags = tmp._first_occurrence_index(["__v"], extra_keys=[seg_j])
-        idx, _ = self._mask_to_idx(flags)
-        rows = jnp.sort(jnp.take(order, idx))
-        return jnp.take(seg_j, rows), col.take(rows), int(rows.shape[0])
+        order, flags, cnt = tmp._first_occurrence_index(["__v"], extra_keys=[seg_j])
+        rows = J.first_occurrence_rows(order, flags, k=int(cnt))
+        return J.tree_take(seg_j, rows), col.take(rows), int(rows.shape[0])
 
     def _segment_agg(
         self, name: str, agg, seg_j, col: Column, n: int, k: int, parameters=None

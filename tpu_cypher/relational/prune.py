@@ -243,8 +243,25 @@ def prune_fused_columns(root: O.RelationalOperator) -> O.RelationalOperator:
     req = flow_requirements(root)
     for f in fused:
         f.required_exprs = frozenset(req[id(f)])
-    # invalidate cached headers/tables so narrowed headers propagate lazily
-    for op in ops:
+    # invalidate cached headers/tables so narrowed headers propagate lazily.
+    # The walk here includes the classic SHADOW subtrees (children[1] of
+    # fused ops, excluded from requirement flow): a shadow cascade shares
+    # the pruned fused op as its input, so its cached plan-time headers
+    # would otherwise go stale and break the fallback path with a
+    # header/table column mismatch.
+    all_ops: List[O.RelationalOperator] = []
+    seen_all: Set[int] = set()
+
+    def walk_all(op):
+        if id(op) in seen_all:
+            return
+        seen_all.add(id(op))
+        all_ops.append(op)
+        for c in op.children:
+            walk_all(c)
+
+    walk_all(root)
+    for op in all_ops:
         op._header = None
         op._table = None
         if isinstance(op, O.JoinOp):
