@@ -238,6 +238,57 @@ def test_pallas_frontier_degree_sum_matches_jnp():
     )
 
 
+def test_distinct_endpoints_count_fused_matches_oracle(monkeypatch):
+    """count(DISTINCT chain endpoints) runs through the fused no-materialize
+    path and matches the oracle across directions, labels, and field subsets."""
+    import numpy as np
+
+    from tpu_cypher import CypherSession
+    from tpu_cypher.backend.tpu import jit_ops
+
+    calls = {"n": 0}
+    orig = jit_ops.distinct_pairs_count_final
+
+    def spy(*a, **kw):
+        calls["n"] += 1
+        return orig(*a, **kw)
+
+    monkeypatch.setattr(jit_ops, "distinct_pairs_count_final", spy)
+
+    rng = np.random.default_rng(11)
+    n, e = 30, 120
+    src = rng.integers(0, n, e)
+    dst = rng.integers(0, n, e)
+    keep = src != dst
+    src, dst = src[keep], dst[keep]
+    parts = [
+        f"(n{i}:P {{i:{i}}})" if i % 3 else f"(n{i}:P:Q {{i:{i}}})"
+        for i in range(n)
+    ]
+    parts += [f"(n{s})-[:K]->(n{d})" for s, d in zip(src, dst)]
+    create = "CREATE " + ", ".join(parts)
+
+    fused_queries = [
+        "MATCH (a:P)-[:K]->(b)-[:K]->(c) WITH DISTINCT a, c RETURN count(*) AS x",
+        "MATCH (a:P)-[:K]->(b)-[:K]->(c) WITH DISTINCT c RETURN count(*) AS x",
+        "MATCH (a:P)-[:K]->(b)-[:K]->(c) WITH DISTINCT a RETURN count(*) AS x",
+        "MATCH (a)<-[:K]-(b)<-[:K]-(c:Q) WITH DISTINCT a, c RETURN count(*) AS x",
+        "MATCH (a)-[:K]->(b)-[:K]->(c)-[:K]->(d:P) WITH DISTINCT a, d RETURN count(*) AS x",
+    ]
+    # plans as a STAR from the labeled middle node (two expands sharing
+    # frontier b) — not a linear chain, must fall back and stay correct
+    unfused_queries = [
+        "MATCH (a)-[:K]->(b:Q)-[:K]->(c) WITH DISTINCT a, c RETURN count(*) AS x",
+    ]
+    gl = CypherSession.local().create_graph_from_create_query(create)
+    gt = CypherSession.tpu().create_graph_from_create_query(create)
+    for q in fused_queries + unfused_queries:
+        want = gl.cypher(q).records.collect()
+        got = gt.cypher(q).records.collect()
+        assert got == want, f"{q}: {got} != {want}"
+    assert calls["n"] >= len(fused_queries), "fused distinct-endpoints path not used"
+
+
 def test_branching_pattern_counts_match_oracle():
     """Branching MATCH patterns stack CsrExpandOps whose frontier is NOT the
     child's far node; the fused count chain must NOT compose them (regression

@@ -72,10 +72,11 @@ class Column:
     # computed); computed at most once per column instance so f64-promotion
     # guards don't sync repeatedly
     _beyond_f64: Optional[bool] = None
-    # host mirror of ``data`` when the column was BUILT from host data
-    # (``from_numpy``): decoding such a column costs zero device round
-    # trips (a D2H fetch is ~73ms over a tunneled TPU even for one scalar)
+    # host mirrors of ``data``/``valid`` when the column was BUILT from
+    # host data (``from_numpy``): decoding such a column costs zero device
+    # round trips (a D2H fetch is ~73ms over a tunneled TPU per array)
     _np_cache: Optional[np.ndarray] = None
+    _np_valid: Optional[np.ndarray] = None
 
     def ints_beyond_f64(self) -> bool:
         """True when a VALID int64 payload exceeds f64 exactness (2**53)."""
@@ -156,17 +157,23 @@ class Column:
         fast path — ``from_values`` walks Python objects, O(n) interpreter
         work; this is one H2D transfer)."""
         arr = np.asarray(arr)
-        v = shard_rows(jnp.asarray(valid)) if valid is not None else None
+        hv = np.asarray(valid, dtype=bool).copy() if valid is not None else None
+        v = shard_rows(jnp.asarray(hv)) if hv is not None else None
         if arr.dtype == np.bool_:
             host = arr.copy()
-            return Column(BOOL, shard_rows(jnp.asarray(host)), v, _np_cache=host)
-        if np.issubdtype(arr.dtype, np.integer):
+            kind = BOOL
+        elif np.issubdtype(arr.dtype, np.integer):
             host = arr.astype(np.int64, copy=True)
-            return Column(I64, shard_rows(jnp.asarray(host)), v, _np_cache=host)
-        if np.issubdtype(arr.dtype, np.floating):
+            kind = I64
+        elif np.issubdtype(arr.dtype, np.floating):
             host = arr.astype(np.float64, copy=True)
-            return Column(F64, shard_rows(jnp.asarray(host)), v, _np_cache=host)
-        raise TpuBackendError(f"from_numpy: unsupported dtype {arr.dtype}")
+            kind = F64
+        else:
+            raise TpuBackendError(f"from_numpy: unsupported dtype {arr.dtype}")
+        return Column(
+            kind, shard_rows(jnp.asarray(host)), v,
+            _np_cache=host, _np_valid=hv,
+        )
 
     def to_values(self, row_mask: Optional[np.ndarray] = None) -> List[Any]:
         """Decode to Python values (respecting validity)."""
@@ -174,7 +181,12 @@ class Column:
             vals = list(self.data)
         else:
             data = self._np_cache if self._np_cache is not None else np.asarray(self.data)
-            valid = np.asarray(self.valid) if self.valid is not None else None
+            if self.valid is None:
+                valid = None
+            elif self._np_valid is not None:
+                valid = self._np_valid
+            else:
+                valid = np.asarray(self.valid)
             if self.kind == I64:
                 vals = [
                     int(v) if (valid is None or valid[i]) else None

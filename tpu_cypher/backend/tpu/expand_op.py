@@ -286,6 +286,67 @@ class CsrExpandOp(_FusedExpandBase):
             )
         )
 
+    def distinct_endpoints_count(self, fields) -> Optional[int]:
+        """count(DISTINCT endpoints) over a fused expand chain WITHOUT
+        materializing any row set: per hop one size sync + one (base-key,
+        position) materialize program; the final hop fuses into a packed
+        values-only sort count (``jit_ops.distinct_pairs_count_final``).
+        Returns None when the pattern doesn't fit (fields beyond the chain
+        endpoints, undirected hops, paths) — callers fall back to the
+        materialized distinct. The relational pushdown hook is
+        ``AggregateOp._compute_table``."""
+        try:
+            hops = self._chain_hops()
+            base = hops[-1]
+            want = set(fields)
+            if not want or not want <= {base.frontier_fld, self.far_fld}:
+                return None
+            if base.frontier_fld == self.far_fld:
+                return None  # ambiguous binding; keep the generic path
+            if any(h.undirected for h in hops):
+                return None
+            # named paths make the var's identity more than its id column
+            if any(self.header.has_path(f) for f in want):
+                return None
+            use_a = base.frontier_fld in want
+            use_c = self.far_fld in want
+            gi = GraphIndex.of(self.graph)
+            ctx = self.context
+            in_op = base.children[0]
+            in_t = in_op.table
+            frontier_var = in_op.header.var(base.frontier_fld)
+            id_col = in_t._cols[
+                in_op.header.column(in_op.header.id_expr(frontier_var))
+            ]
+            gi.node_ids(ctx)
+            if gi.num_nodes == 0:
+                return 0
+            if use_a and use_c and gi.num_nodes >= (1 << 30):
+                return None  # pos*V+pos pair key must stay below the sentinel
+            pos, present = gi.compact_of(id_col, ctx)
+            akey = pos  # base endpoint key = its compact position
+            for hop in reversed(hops):
+                rp, ci, _ = gi.csr(hop.types_key, hop.backwards, ctx)
+                mask = gi.label_mask(hop.far_labels, ctx)
+                deg, t_dev = J.expand_degrees_total(rp, pos, present)
+                total = int(t_dev)
+                if total == 0:
+                    return 0
+                if hop is self:  # final hop: fused materialize+sort+count
+                    return int(
+                        J.distinct_pairs_count_final(
+                            rp, ci, pos, deg, akey, mask,
+                            total=total, use_a=use_a, use_c=use_c,
+                            num_nodes=gi.num_nodes,
+                        )
+                    )
+                akey, pos, present = J.distinct_hop_materialize(
+                    rp, ci, pos, deg, akey, mask, total=total
+                )
+            return None  # pragma: no cover - loop always hits `hop is self`
+        except (GraphIndexError, TpuBackendError):
+            return None
+
     def _fused_table(self):
         gi = GraphIndex.of(self.graph)
         ctx = self.context
@@ -322,8 +383,9 @@ class CsrExpandOp(_FusedExpandBase):
             n_out = int(row.shape[0])
         elif gi.num_nodes:
             far_rows, keep = J.far_lookup(row_map, nbr)
-            idx, n_out = _mask_to_idx(keep)
-            if n_out != int(row.shape[0]):  # skip the no-op gather when all match
+            n_out = int(J.mask_sum(keep))
+            if n_out != int(row.shape[0]):  # skip nonzero+gather when all match
+                idx = J.mask_nonzero(keep, size=n_out)
                 if swapped is not None:
                     row, orig, far_rows, swapped = J.tree_take(
                         (row, orig, far_rows, swapped), idx

@@ -382,6 +382,22 @@ class AggregateOp(RelationalOperator):
                 for _, agg in self.aggregations
             )
         ):
+            # deepest pushdown first: a fused expand chain can count its
+            # DISTINCT endpoints without materializing ANY row set (the
+            # backend op advertises `distinct_endpoints_count`). Column
+            # projections keep the row multiset, so peel SelectOps as long
+            # as the distinct fields survive them.
+            inner = in_op.children[0]
+            while isinstance(inner, SelectOp) and set(in_op.fields) <= set(
+                inner.fields
+            ):
+                inner = inner.children[0]
+            fused = getattr(inner, "distinct_endpoints_count", None)
+            if fused is not None:
+                n = fused(in_op.fields)
+                if n is not None:
+                    cols = {out_col: [n] for out_col, _ in aggs}
+                    return self.context.table_cls.from_columns(cols)
             src = in_op.children[0].table
             n = src.distinct_count(in_op.distinct_columns())
             if n is not None:
