@@ -289,6 +289,18 @@ def test_distinct_endpoints_count_fused_matches_oracle(monkeypatch):
     assert calls["n"] >= len(fused_queries), "fused distinct-endpoints path not used"
 
 
+def test_jitted_eval_param_type_not_conflated():
+    """1 == True == 1.0 in Python, but the jitted-eval cache must not replay
+    a program traced for one param type when called with another."""
+    from tpu_cypher import CypherSession
+
+    g = CypherSession.tpu().create_graph_from_create_query("CREATE (:V {i:1})")
+    q = "MATCH (n:V) RETURN $p AS y"
+    for p in (True, 1, 1.0, True):
+        got = g.cypher(q, parameters={"p": p}).records.collect()
+        assert got[0]["y"] == p and type(got[0]["y"]) is type(p), (p, got)
+
+
 def test_branching_pattern_counts_match_oracle():
     """Branching MATCH patterns stack CsrExpandOps whose frontier is NOT the
     child's far node; the fused count chain must NOT compose them (regression

@@ -49,6 +49,42 @@ class TpuUnsupportedExpr(TpuBackendError):
 _NONDETERMINISTIC = frozenset({"rand", "randomuuid"})
 
 
+# ---------------------------------------------------------------------------
+# jitted-evaluation cache
+#
+# Eager per-primitive dispatch costs a full round trip on a tunneled TPU
+# (~0.3-1s each — see jit_ops), so a WHERE predicate of 20 primitives was
+# latency-bound. The whole expression evaluation is instead TRACED into one
+# cached jitted program keyed by (expression, header mapping, column
+# layouts, params, row count). Tracing reuses ``_eval_device`` verbatim —
+# identical semantics by construction; anything that needs host data during
+# evaluation (object columns, data-dependent probes, nondeterministic
+# functions) raises at trace time and the key is marked failed, so those
+# expressions permanently take the eager/host-island path.
+# ---------------------------------------------------------------------------
+
+_EVAL_JIT_CACHE: Dict[Any, Any] = {}
+_EVAL_JIT_FAILED = object()
+_EVAL_JIT_CACHE_MAX = 4096
+# vocab contents are part of the trace (string literals resolve to codes,
+# vocab maps bake LUT constants), so they must be part of the key — bounded
+# to keep key hashing O(small)
+_EVAL_JIT_MAX_VOCAB = 1024
+
+
+class _ShimTable:
+    """Minimal table stand-in holding traced Columns during jit tracing.
+    Deliberately EXCLUDES object columns: any access raises KeyError at
+    trace time, failing the cache entry (their host content would
+    otherwise be baked into the program as a stale constant)."""
+
+    __slots__ = ("_cols", "size")
+
+    def __init__(self, cols, size):
+        self._cols = cols
+        self.size = size
+
+
 class TpuEvaluator:
     def __init__(self, table, header, parameters: Dict[str, Any]):
         self.table = table
@@ -59,10 +95,130 @@ class TpuEvaluator:
     # ------------------------------------------------------------------
 
     def eval(self, expr: E.Expr) -> Column:
+        if isinstance(self.table, _ShimTable):
+            # inside a trace: no nested jit, no host islands — any failure
+            # must escape so the cache entry is marked failed and the
+            # expression re-runs on the real eager path
+            return self._eval_device(expr)
+        got = self._eval_jitted(expr)
+        if got is not None:
+            return got
         try:
             return self._eval_device(expr)
         except (TpuUnsupportedExpr, InexactPromotionError):
             return self._host_island(expr)
+
+    # -- jit cache -----------------------------------------------------
+
+    def _jit_cache_key(self, expr: E.Expr):
+        """(key, device column dict) or (None, None) when not cacheable."""
+        if isinstance(self.table, _ShimTable):
+            return None, None  # already tracing
+        param_names: List[str] = []
+
+        def walk(e):
+            if isinstance(e, E.Param):
+                param_names.append(e.name)
+            for c in getattr(e, "children", ()) or ():
+                walk(c)
+
+        walk(expr)
+        pkey = []
+        for name in sorted(set(param_names)):
+            v = self.params.get(name)
+            try:
+                hash(v)
+            except TypeError:
+                return None, None  # unhashable param (list/map): stay eager
+            # type tag: 1 == True == 1.0 under Python equality, but the
+            # traced constant bakes the Cypher value's type (same reason
+            # Lit has a custom __eq__/__hash__)
+            pkey.append((name, type(v).__name__, v))
+        # only the expression's dependency columns feed the trace: unrelated
+        # columns changing layout must not recompile it, and their vocabs
+        # must not be hashed per eval. A dependency the walk missed shows up
+        # as a KeyError at trace time -> entry marked failed -> eager path.
+        deps = set(self._dependency_columns(expr))
+        dep_cols = {
+            c: col
+            for c, col in self.table._cols.items()
+            if c in deps and col.kind != OBJ
+        }
+        ckey = []
+        for c, col in sorted(dep_cols.items()):
+            if col.vocab is not None and len(col.vocab) > _EVAL_JIT_MAX_VOCAB:
+                return None, None
+            ckey.append(
+                (
+                    c,
+                    col.kind,
+                    str(col.data.dtype),
+                    tuple(col.data.shape),
+                    col.valid is None,
+                    col.int_flag is None,
+                    tuple(col.vocab) if col.vocab is not None else None,
+                )
+            )
+        hkey = ()
+        if self.header is not None:
+            hkey = frozenset(
+                (e, self.header.column(e)) for e in self.header.expressions
+            )
+        key = (expr, self.n, tuple(ckey), tuple(pkey), hkey)
+        try:
+            hash(key)
+        except TypeError:  # pragma: no cover - unhashable literal payloads
+            return None, None
+        return key, dep_cols
+
+    def _eval_jitted(self, expr: E.Expr) -> Optional[Column]:
+        key, dep_cols = self._jit_cache_key(expr)
+        if key is None:
+            return None
+        entry = _EVAL_JIT_CACHE.get(key)
+        if entry is _EVAL_JIT_FAILED:
+            return None
+        cols_in = {
+            c: (col.data, col.valid, col.int_flag)
+            for c, col in dep_cols.items()
+        }
+        if entry is None:
+            import jax
+
+            kinds = {c: (col.kind, col.vocab) for c, col in dep_cols.items()}
+            header, params, n = self.header, self.params, self.n
+            meta: Dict[str, Any] = {}
+
+            @jax.jit
+            def fn(ci):
+                cols = {
+                    c: Column(
+                        kinds[c][0], d, v, kinds[c][1], int_flag=i
+                    )
+                    for c, (d, v, i) in ci.items()
+                }
+                ev = TpuEvaluator(_ShimTable(cols, n), header, params)
+                out = ev._eval_device(expr)
+                meta["kind"] = out.kind
+                meta["vocab"] = out.vocab
+                return out.data, out.valid, out.int_flag
+
+            if len(_EVAL_JIT_CACHE) >= _EVAL_JIT_CACHE_MAX:
+                _EVAL_JIT_CACHE.clear()
+            try:
+                data, valid, iflag = fn(cols_in)
+            except Exception:
+                _EVAL_JIT_CACHE[key] = _EVAL_JIT_FAILED
+                return None
+            _EVAL_JIT_CACHE[key] = (fn, meta)
+            return Column(meta["kind"], data, valid, meta["vocab"], int_flag=iflag)
+        fn, meta = entry
+        try:
+            data, valid, iflag = fn(cols_in)
+        except Exception:  # pragma: no cover - late trace failure
+            _EVAL_JIT_CACHE[key] = _EVAL_JIT_FAILED
+            return None
+        return Column(meta["kind"], data, valid, meta["vocab"], int_flag=iflag)
 
     def _host_island(self, expr: E.Expr) -> Column:
         """Evaluate ONE expression via the local oracle over only its

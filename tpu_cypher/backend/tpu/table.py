@@ -531,7 +531,9 @@ class TpuTable(Table):
         pack = self._equiv_pack(datas, valids, kinds, (), min_keys=1)
         if pack is not None:
             return int(J.distinct_count_packed(datas, valids, (), kinds, pack))
-        _, _, cnt = self._first_occurrence_index(on)
+        # unpackable keys: sort unpacked directly — re-probing min/max via
+        # _first_occurrence_index would repeat the device round trip
+        _, _, cnt = J.equivalence_sort(datas, valids, (), kinds, pack=None)
         return int(cnt)
 
     def distinct(self, cols: Optional[Sequence[str]] = None) -> "TpuTable":
