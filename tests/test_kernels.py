@@ -289,6 +289,55 @@ def test_distinct_endpoints_count_fused_matches_oracle(monkeypatch):
     assert calls["n"] >= len(fused_queries), "fused distinct-endpoints path not used"
 
 
+def test_fused_var_length_expand_matches_oracle(monkeypatch):
+    """Var-length MATCH through the fused CSR frontier loop is differential-
+    equal to the oracle (edge-distinctness, bounds, labels, cycles, parallel
+    edges) and genuinely routes through CsrVarExpandOp."""
+    import numpy as np
+
+    from tpu_cypher import CypherSession
+    from tpu_cypher.backend.tpu import jit_ops
+
+    calls = {"n": 0}
+    orig = jit_ops.varlen_hop
+
+    def spy(*a, **kw):
+        calls["n"] += 1
+        return orig(*a, **kw)
+
+    monkeypatch.setattr(jit_ops, "varlen_hop", spy)
+
+    rng = np.random.default_rng(3)
+    n, e = 14, 40
+    src = rng.integers(0, n, e)
+    dst = rng.integers(0, n, e)
+    parts = [f"(n{i}:V {{i:{i}}})" if i % 2 else f"(n{i}:V:W {{i:{i}}})" for i in range(n)]
+    # includes self-loops, cycles, and duplicated (parallel) edges
+    parts += [f"(n{s})-[:E]->(n{d})" for s, d in zip(src, dst)]
+    parts += ["(n0)-[:E]->(n1)", "(n0)-[:E]->(n1)", "(n1)-[:E]->(n0)", "(n2)-[:E]->(n2)"]
+    create = "CREATE " + ", ".join(parts)
+
+    fused_queries = [
+        "MATCH (x:V)-[:E*1..3]->(y) RETURN count(*) AS c",
+        "MATCH (x:V)-[:E*2..2]->(y:W) RETURN count(*) AS c",
+        "MATCH (x:W)-[:E*1..2]->(y) RETURN x.i, y.i, count(*) AS c ORDER BY x.i, y.i",
+        "MATCH (x:V)-[:E*2..4]->(y) WITH DISTINCT x, y RETURN count(*) AS c",
+    ]
+    # rel list required / zero lower bound / undirected: classic cascade
+    classic_queries = [
+        "MATCH (x:V)-[r:E*1..2]->(y) RETURN x.i, size(r) AS s, count(*) AS c ORDER BY x.i, s",
+        "MATCH (x:V)-[:E*0..2]->(y) RETURN count(*) AS c",
+        "MATCH (x:V)-[:E*1..2]-(y) RETURN count(*) AS c",
+    ]
+    gl = CypherSession.local().create_graph_from_create_query(create)
+    gt = CypherSession.tpu().create_graph_from_create_query(create)
+    for q in fused_queries + classic_queries:
+        want = gl.cypher(q).records.collect()
+        got = gt.cypher(q).records.collect()
+        assert got == want, f"{q}: {got} != {want}"
+    assert calls["n"] >= len(fused_queries), "var-length queries bypassed the fused loop"
+
+
 def test_jitted_eval_param_type_not_conflated():
     """1 == True == 1.0 in Python, but the jitted-eval cache must not replay
     a program traced for one param type when called with another."""

@@ -111,29 +111,40 @@ class TpuEvaluator:
     # -- jit cache -----------------------------------------------------
 
     def _jit_cache_key(self, expr: E.Expr):
-        """(key, device column dict) or (None, None) when not cacheable."""
+        """(key, device column dict, referenced params) or Nones when not
+        cacheable."""
         if isinstance(self.table, _ShimTable):
-            return None, None  # already tracing
+            return None, None, None  # already tracing
         param_names: List[str] = []
+        sub_vars: List[E.Expr] = []
+        subs: List[E.Expr] = []
 
         def walk(e):
+            subs.append(e)
             if isinstance(e, E.Param):
                 param_names.append(e.name)
+            if isinstance(e, E.Var):
+                sub_vars.append(e)
             for c in getattr(e, "children", ()) or ():
                 walk(c)
 
         walk(expr)
+        # only the REFERENCED params feed the key and the closure (a cached
+        # entry must not pin an unrelated 100MB parameter for the process
+        # lifetime)
+        used_params = {}
         pkey = []
         for name in sorted(set(param_names)):
             v = self.params.get(name)
             try:
                 hash(v)
             except TypeError:
-                return None, None  # unhashable param (list/map): stay eager
+                return None, None, None  # unhashable param: stay eager
             # type tag: 1 == True == 1.0 under Python equality, but the
             # traced constant bakes the Cypher value's type (same reason
             # Lit has a custom __eq__/__hash__)
             pkey.append((name, type(v).__name__, v))
+            used_params[name] = v
         # only the expression's dependency columns feed the trace: unrelated
         # columns changing layout must not recompile it, and their vocabs
         # must not be hashed per eval. A dependency the walk missed shows up
@@ -147,7 +158,7 @@ class TpuEvaluator:
         ckey = []
         for c, col in sorted(dep_cols.items()):
             if col.vocab is not None and len(col.vocab) > _EVAL_JIT_MAX_VOCAB:
-                return None, None
+                return None, None, None
             ckey.append(
                 (
                     c,
@@ -159,20 +170,33 @@ class TpuEvaluator:
                     tuple(col.vocab) if col.vocab is not None else None,
                 )
             )
-        hkey = ()
+        # header slice relevant to THIS expression: its subexpressions plus
+        # every header expr of any mentioned variable (the same closure
+        # _dependency_columns uses — covers derived probes like id(v)).
+        # Unrelated header growth must not miss the cache.
+        hset = set()
         if self.header is not None:
-            hkey = frozenset(
-                (e, self.header.column(e)) for e in self.header.expressions
-            )
-        key = (expr, self.n, tuple(ckey), tuple(pkey), hkey)
+            for s in subs:
+                col = self.header.get(s)
+                if col is not None:
+                    hset.add((s, col))
+            for v in sub_vars:
+                try:
+                    for e in self.header.expressions_for(v):
+                        c = self.header.get(e)
+                        if c is not None:
+                            hset.add((e, c))
+                except Exception:
+                    pass
+        key = (expr, self.n, tuple(ckey), tuple(pkey), frozenset(hset))
         try:
             hash(key)
         except TypeError:  # pragma: no cover - unhashable literal payloads
-            return None, None
-        return key, dep_cols
+            return None, None, None
+        return key, dep_cols, used_params
 
     def _eval_jitted(self, expr: E.Expr) -> Optional[Column]:
-        key, dep_cols = self._jit_cache_key(expr)
+        key, dep_cols, used_params = self._jit_cache_key(expr)
         if key is None:
             return None
         entry = _EVAL_JIT_CACHE.get(key)
@@ -186,7 +210,7 @@ class TpuEvaluator:
             import jax
 
             kinds = {c: (col.kind, col.vocab) for c, col in dep_cols.items()}
-            header, params, n = self.header, self.params, self.n
+            header, params, n = self.header, used_params, self.n
             meta: Dict[str, Any] = {}
 
             @jax.jit

@@ -74,6 +74,31 @@ class _FusedExpandBase(RelationalOperator):
 
     # -- column assembly ---------------------------------------------------
 
+    def _gather_plan(
+        self, plan: Dict[str, Tuple[Column, str]], idx_by_tag: Dict[str, Any]
+    ) -> Dict[str, Column]:
+        """Execute a tagged gather plan: ONE jitted dispatch per index
+        source for all device columns, host path for OBJ columns."""
+        out: Dict[str, Column] = {}
+        for tag, idx in idx_by_tag.items():
+            group = {c: s for c, (s, t) in plan.items() if t == tag}
+            if not group:
+                continue
+            dev = {
+                c: (s.data, s.valid, s.int_flag)
+                for c, s in group.items()
+                if s.kind != OBJ
+            }
+            if dev:
+                taken = J.cols_take(dev, idx)
+                for c, (d, v, i) in taken.items():
+                    s = group[c]
+                    out[c] = Column(s.kind, d, v, s.vocab, int_flag=i)
+            for c, s in group.items():
+                if s.kind == OBJ:
+                    out[c] = s.take(idx)
+        return out
+
     def _assemble(
         self,
         gi: GraphIndex,
@@ -139,25 +164,7 @@ class _FusedExpandBase(RelationalOperator):
                 plan[col] = (node_cols[node_header.column(key)], "far")
                 continue
             raise GraphIndexError(f"unmapped expr {e!r}")
-        idx_by_tag = {"row": row, "orig": orig, "far": far_rows}
-        out: Dict[str, Column] = {}
-        for tag, idx in idx_by_tag.items():
-            group = {c: src for c, (src, t) in plan.items() if t == tag}
-            if not group:
-                continue
-            obj_cols = {c: s for c, s in group.items() if s.kind == OBJ}
-            dev = {
-                c: (s.data, s.valid, s.int_flag)
-                for c, s in group.items()
-                if s.kind != OBJ
-            }
-            if dev:
-                taken = J.cols_take(dev, idx)
-                for c, (d, v, i) in taken.items():
-                    s = group[c]
-                    out[c] = Column(s.kind, d, v, s.vocab, int_flag=i)
-            for c, s in obj_cols.items():
-                out[c] = s.take(idx)
+        out = self._gather_plan(plan, {"row": row, "orig": orig, "far": far_rows})
         for c, (a, b) in swap_plan.items():
             data, valid = J.gather_swapped(
                 a.data, b.data, a.valid, b.valid, orig, swapped
@@ -465,6 +472,136 @@ class CsrExpandIntoOp(_FusedExpandBase):
         )
 
 
+class CsrVarExpandOp(_FusedExpandBase):
+    """Fused bounded var-length expand: the frontier-loop replacement for
+    the unrolled join cascade (reference ``VarLengthExpandPlanner.scala:45-330``,
+    SURVEY §5's "frontier SpMM loop"). Each hop is one sized CSR materialize
+    program carrying (origin row, current node, walked edge ids); edge
+    reuse kills a path via a mask (no compaction mid-chain); every length
+    in [lower, upper] emits its surviving rows, which are compacted and
+    concatenated once at the end.
+
+    The fused path can assemble input pass-through columns and target-node
+    columns. A required relationship-LIST column (or named path) falls back
+    to the classic shadow cascade at runtime."""
+
+    def __init__(
+        self,
+        in_plan: RelationalOperator,
+        classic: RelationalOperator,
+        graph_obj,
+        *,
+        source_fld: str,
+        rel_fld: str,
+        target_fld: str,
+        types_key: Tuple[str, ...],
+        lower: int,
+        upper: int,
+        far_labels: Tuple[str, ...],
+    ):
+        super().__init__(in_plan, classic, graph_obj)
+        self.source_fld = source_fld
+        self.rel_fld = rel_fld
+        self.target_fld = target_fld
+        self.types_key = types_key
+        self.lower = lower
+        self.upper = upper
+        self.far_labels = far_labels
+
+    def _show_inner(self) -> str:
+        t = "|".join(self.types_key) or "*"
+        return (
+            f"({self.source_fld})-[{self.rel_fld}:{t}*{self.lower}.."
+            f"{self.upper}]->({self.target_fld})"
+        )
+
+    def _fused_table(self):
+        from .table import TpuTable
+
+        in_op = self.children[0]
+        header = self.header
+        # the rel var materializes as a host LIST column — fused assembly
+        # cannot produce it; let the classic cascade answer
+        for e in header.expressions:
+            if _owner_name(e) == self.rel_fld:
+                raise GraphIndexError("var-length rel list required")
+        count_only = not header.expressions
+        gi = GraphIndex.of(self.graph)
+        ctx = self.context
+        in_t = in_op.table
+        frontier_var = in_op.header.var(self.source_fld)
+        id_col = in_t._cols[in_op.header.column(in_op.header.id_expr(frontier_var))]
+        gi.node_ids(ctx)
+        if gi.num_nodes == 0:
+            return TpuTable({}, 0) if count_only else self._assemble_levels(gi, [])
+        pos, present = gi.compact_of(id_col, ctx)
+        rp, ci, eo = gi.csr(self.types_key, False, ctx)
+        _, _, row_map = gi.node_scan(self.far_labels, ctx)
+        row0 = None
+        prev_edges: Tuple[Any, ...] = ()
+        total_count = 0
+        levels: List[Tuple[Any, Any]] = []
+        for level in range(1, self.upper + 1):
+            deg, t_dev = J.expand_degrees_total(rp, pos, present)
+            total = int(t_dev)
+            if total == 0:
+                break
+            row0, nbr, orig, prev_edges, iso = J.varlen_hop(
+                rp, ci, eo, pos, deg, row0, prev_edges, total=total
+            )
+            if level >= self.lower:
+                far, keep, k_dev = J.varlen_emit(nbr, iso, row_map)
+                if count_only:
+                    total_count += int(k_dev)
+                else:
+                    k = int(k_dev)
+                    if k:
+                        idx = J.mask_nonzero(keep, size=k)
+                        levels.append(J.tree_take((row0, far), idx))
+            pos, present = nbr, iso
+        if count_only:
+            return TpuTable({}, total_count)
+        return self._assemble_levels(gi, levels)
+
+    def _assemble_levels(self, gi: GraphIndex, levels):
+        """Concat per-level (origin row, far row) frames and gather output
+        columns: input pass-throughs by origin row, target-var columns from
+        the far-label canonical node scan."""
+        from .table import TpuTable
+
+        ctx = self.context
+        in_op = self.children[0]
+        in_t = in_op.table
+        header = self.header
+        if not levels:
+            row0 = jnp.zeros(0, jnp.int64)
+            far = jnp.zeros(0, jnp.int64)
+        elif len(levels) == 1:
+            row0, far = levels[0]
+        else:
+            row0, far = J.concat_rows(tuple(levels))
+        n_out = int(row0.shape[0])
+        node_cols, node_header, _ = gi.node_scan(self.far_labels, ctx)
+        canon_node = E.Var(CANON_NODE)
+        plan: Dict[str, Tuple[Column, str]] = {}
+        for e in header.expressions:
+            col = header.column(e)
+            if col in plan:
+                continue
+            if e in in_op.header:
+                plan[col] = (in_t._cols[in_op.header.column(e)], "row")
+                continue
+            if _owner_name(e) == self.target_fld:
+                key = rekey_element_expr(e, canon_node)
+                if key is None or key not in node_header:
+                    raise GraphIndexError(f"unmapped var-expand target expr {e!r}")
+                plan[col] = (node_cols[node_header.column(key)], "far")
+                continue
+            raise GraphIndexError(f"unmapped var-expand expr {e!r}")
+        out = self._gather_plan(plan, {"row": row0, "far": far})
+        return TpuTable(out, n_out)
+
+
 # ---------------------------------------------------------------------------
 # Planner hooks (installed via TpuTable.plan_expand_fastpath/_into)
 # ---------------------------------------------------------------------------
@@ -502,6 +639,39 @@ def plan_expand_fastpath(planner, op, lhs, rhs, classic) -> Optional[RelationalO
         types_key=GraphIndex.types_key(types),
         undirected=op.direction == "-",
         backwards=backwards,
+        far_labels=far_labels,
+    )
+
+
+def plan_var_expand_fastpath(planner, op, lhs, rhs, classic) -> Optional[RelationalOperator]:
+    """Swap the unrolled var-length join cascade for ``CsrVarExpandOp`` when
+    statically safe; None keeps the classic plan. Zero-length branches,
+    undirected steps, named-path capture, and pre-bound endpoints keep the
+    general machinery."""
+    from ...logical import ops as L
+
+    if op.direction != ">" or op.lower < 1 or getattr(op, "capture_path_nodes", False):
+        return None
+    lhs_vars = {v.name for v in lhs.header.vars}
+    if op.rel in lhs_vars or op.source not in lhs_vars or op.target in lhs_vars:
+        return None
+    if {v.name for v in rhs.header.vars} != {op.target}:
+        return None
+    if not isinstance(op.rhs, L.NodeScan):
+        return None
+    m = op.rhs.node_type.material
+    far_labels = tuple(sorted(getattr(m, "labels", ()) or ()))
+    types = getattr(op.rel_type.material, "types", frozenset()) or frozenset()
+    return CsrVarExpandOp(
+        lhs,
+        classic,
+        rhs.graph,
+        source_fld=op.source,
+        rel_fld=op.rel,
+        target_fld=op.target,
+        types_key=GraphIndex.types_key(types),
+        lower=op.lower,
+        upper=op.upper,
         far_labels=far_labels,
     )
 

@@ -296,6 +296,50 @@ def path_count_chain(dev_ids, ids, valid, hops, num_nodes: int):
 
 
 # ---------------------------------------------------------------------------
+# fused var-length expand: per-hop frontier materialize with edge-distinct
+# (isomorphism) masks — SURVEY §5's frontier loop, engine-integrated
+# ---------------------------------------------------------------------------
+
+
+@partial(jax.jit, static_argnames=("total",))
+def varlen_hop(rp, ci, eo, pos, deg, row0, prev_edges, total: int):
+    """One hop of a var-length expansion. State per partial path: origin
+    input row ``row0`` (None on the first hop — the expansion row IS the
+    origin), current node ``pos``, and the edge ids walked so far
+    (``prev_edges``). Paths that would reuse an edge get ``iso=False`` and
+    are dead: they emit nothing and expand no further (their next-hop
+    degrees are masked to zero), exactly the unrolled planner's
+    ``id(step_i) <> id(step_j)`` filters."""
+    row, edge = _expand_rows(jnp.take(rp, pos), deg, total)
+    nbr = jnp.take(ci, edge).astype(jnp.int64)
+    orig = jnp.take(eo, edge)
+    new_row0 = jnp.take(row0, row) if row0 is not None else row
+    new_prev = tuple(jnp.take(pe, row) for pe in prev_edges)
+    iso = jnp.ones(total, bool)
+    for pe in new_prev:
+        iso = iso & (orig != pe)
+    return new_row0, nbr, orig, new_prev + (orig,), iso
+
+
+@jax.jit
+def varlen_emit(nbr, iso, row_map):
+    """Emission at one path length: far-node scan row (-1 = target labels
+    missing), surviving-row mask, surviving count."""
+    far = jnp.take(row_map, nbr)
+    keep = iso & (far >= 0)
+    return far, keep, jnp.sum(keep)
+
+
+@jax.jit
+def concat_rows(parts):
+    """Concatenate per-level (row0, far) pairs into one output frame."""
+    return (
+        jnp.concatenate([p[0] for p in parts]),
+        jnp.concatenate([p[1] for p in parts]),
+    )
+
+
+# ---------------------------------------------------------------------------
 # fused distinct-endpoints count: scan -> expand^k -> DISTINCT a,c -> count
 # ---------------------------------------------------------------------------
 

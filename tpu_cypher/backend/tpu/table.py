@@ -928,6 +928,12 @@ class TpuTable(Table):
 
         return plan_expand_into_fastpath(planner, op, in_plan, classic)
 
+    @staticmethod
+    def plan_var_expand_fastpath(planner, op, lhs, rhs, classic):
+        from .expand_op import plan_var_expand_fastpath
+
+        return plan_var_expand_fastpath(planner, op, lhs, rhs, classic)
+
 
 def _float_as_exact_int(c: Column) -> Column:
     """An F64 key column recast for EXACT equality against int64 keys:

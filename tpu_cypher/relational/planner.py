@@ -366,7 +366,17 @@ class RelationalPlanner:
     ) -> RelationalOperator:
         """Reference ``VarLengthExpandPlanner.scala:45-330``: unrolled iterated
         join with per-step edge-distinctness (isomorphism) filters; union of
-        per-length results."""
+        per-length results — or the fused CSR frontier loop when the backend
+        offers one (classic cascade kept as the same-header shadow plan)."""
+        classic = self._plan_var_expand_classic(op)
+        fast = getattr(self.ctx.table_cls, "plan_var_expand_fastpath", None)
+        if fast is not None:
+            out = fast(self, op, self.process(op.lhs), self.process(op.rhs), classic)
+            if out is not None:
+                return out
+        return classic
+
+    def _plan_var_expand_classic(self, op: L.BoundedVarLengthExpand) -> RelationalOperator:
         lhs = self.process(op.lhs)
         rhs = self.process(op.rhs)
         graph = rhs.graph

@@ -76,7 +76,7 @@ def _mention_tree(m: Set[E.Expr], e: E.Expr, h) -> None:
 
 def _local_mentions(op: O.RelationalOperator) -> Set[E.Expr]:
     """What this operator itself reads from its children's tables."""
-    from ..backend.tpu.expand_op import CsrExpandIntoOp, CsrExpandOp
+    from ..backend.tpu.expand_op import CsrExpandIntoOp, CsrExpandOp, CsrVarExpandOp
 
     m: Set[E.Expr] = set()
     if isinstance(op, O.FilterOp):
@@ -147,6 +147,12 @@ def _local_mentions(op: O.RelationalOperator) -> Set[E.Expr]:
                 m.add(h.id_expr(h.var(f)))
             except Exception:
                 m.update(h.expressions)
+    elif isinstance(op, CsrVarExpandOp):
+        h = op.children[0].header
+        try:
+            m.add(h.id_expr(h.var(op.source_fld)))
+        except Exception:
+            m.update(h.expressions)
     return m
 
 
