@@ -1,0 +1,65 @@
+Feature: EqualsAcceptance
+
+  Scenario: number equality across integer and float
+    Given an empty graph
+    When executing query:
+      """
+      RETURN 1 = 1.0 AS a, 1 = 1.5 AS b, 0.0 = -0.0 AS c
+      """
+    Then the result should be, in any order:
+      | a    | b     | c    |
+      | true | false | true |
+
+  Scenario: equality involving null is null
+    Given an empty graph
+    When executing query:
+      """
+      RETURN 1 = null AS a, null = null AS b, null <> null AS c
+      """
+    Then the result should be, in any order:
+      | a    | b    | c    |
+      | null | null | null |
+
+  Scenario: cross type equality is false
+    Given an empty graph
+    When executing query:
+      """
+      RETURN 1 = '1' AS a, true = 1 AS b, 'a' = ['a'] AS c
+      """
+    Then the result should be, in any order:
+      | a     | b     | c     |
+      | false | false | false |
+
+  Scenario: list equality is elementwise
+    Given an empty graph
+    When executing query:
+      """
+      RETURN [1, 2] = [1, 2] AS a, [1, 2] = [1, 2.0] AS b, [1, 2] = [1, 3] AS c, [1] = [1, 2] AS d
+      """
+    Then the result should be, in any order:
+      | a    | b    | c     | d     |
+      | true | true | false | false |
+
+  Scenario: node equality is identity
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:X {v: 1}), (:X {v: 1})
+      """
+    When executing query:
+      """
+      MATCH (a:X), (b:X) WHERE a = b RETURN count(*) AS c
+      """
+    Then the result should be, in any order:
+      | c |
+      | 2 |
+
+  Scenario: IN handles nulls per three valued logic
+    Given an empty graph
+    When executing query:
+      """
+      RETURN 1 IN [1, 2] AS a, 3 IN [1, null] AS b, null IN [] AS c, 1 IN [null, 1] AS d
+      """
+    Then the result should be, in any order:
+      | a    | b    | c     | d    |
+      | true | null | false | true |

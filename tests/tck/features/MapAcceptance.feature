@@ -1,0 +1,61 @@
+Feature: MapAcceptance
+
+  Scenario: map literal access and keys
+    Given an empty graph
+    When executing query:
+      """
+      WITH {a: 1, b: 'two'} AS m
+      RETURN m.a AS a, m['b'] AS b, keys(m) AS ks
+      """
+    Then the result should be, in any order:
+      | a | b     | ks         |
+      | 1 | 'two' | ['a', 'b'] |
+
+  Scenario: missing map key yields null
+    Given an empty graph
+    When executing query:
+      """
+      WITH {a: 1} AS m RETURN m.missing AS x
+      """
+    Then the result should be, in any order:
+      | x    |
+      | null |
+
+  Scenario: nested map and list values
+    Given an empty graph
+    When executing query:
+      """
+      WITH {inner: {xs: [1, 2]}} AS m
+      RETURN m.inner.xs[1] AS x
+      """
+    Then the result should be, in any order:
+      | x |
+      | 2 |
+
+  Scenario: properties function on nodes and relationships
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:P {name: 'Ann', age: 30})-[:R {w: 2}]->(:P {name: 'Bo'})
+      """
+    When executing query:
+      """
+      MATCH (p:P {name: 'Ann'})-[r:R]->() RETURN properties(p) AS pp, properties(r) AS rp
+      """
+    Then the result should be, in any order:
+      | pp                      | rp     |
+      | {age: 30, name: 'Ann'} | {w: 2} |
+
+  Scenario: keys on a node
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:K {b: 1, a: 2})
+      """
+    When executing query:
+      """
+      MATCH (k:K) RETURN keys(k) AS ks
+      """
+    Then the result should be, in any order:
+      | ks         |
+      | ['a', 'b'] |

@@ -1,0 +1,84 @@
+Feature: MatchShapes
+
+  Background:
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (a:P {n: 'a'}), (b:P {n: 'b'}), (c:P {n: 'c'}), (d:P {n: 'd'}),
+             (a)-[:L]->(b), (b)-[:L]->(c), (a)-[:L]->(c), (c)-[:L]->(d),
+             (a)-[:F]->(d)
+      """
+
+  Scenario: triangle via expand into
+    When executing query:
+      """
+      MATCH (x:P)-[:L]->(y:P)-[:L]->(z:P), (x)-[:L]->(z)
+      RETURN x.n AS x, y.n AS y, z.n AS z
+      """
+    Then the result should be, in any order:
+      | x   | y   | z   |
+      | 'a' | 'b' | 'c' |
+
+  Scenario: diamond shaped pattern
+    When executing query:
+      """
+      MATCH (s:P)-[:L]->(m1:P)-[:L]->(t:P)
+      WHERE s.n = 'a' AND t.n = 'c'
+      RETURN m1.n AS mid
+      """
+    Then the result should be, in any order:
+      | mid |
+      | 'b' |
+
+  Scenario: disconnected patterns build a cartesian product
+    When executing query:
+      """
+      MATCH (x:P {n: 'a'}), (y:P {n: 'd'})
+      RETURN x.n AS x, y.n AS y
+      """
+    Then the result should be, in any order:
+      | x   | y   |
+      | 'a' | 'd' |
+
+  Scenario: two relationship types from the same node
+    When executing query:
+      """
+      MATCH (d:P)<-[:F]-(a:P)-[:L]->(b:P {n: 'b'})
+      RETURN a.n AS a, d.n AS d
+      """
+    Then the result should be, in any order:
+      | a   | d   |
+      | 'a' | 'd' |
+
+  Scenario: relationship uniqueness within a match
+    When executing query:
+      """
+      MATCH (x)-[r1:L]->(y)-[r2:L]->(x)
+      RETURN count(*) AS c
+      """
+    Then the result should be, in any order:
+      | c |
+      | 0 |
+
+  Scenario: type alternation in a relationship pattern
+    When executing query:
+      """
+      MATCH (a:P {n: 'a'})-[r:L|F]->(x)
+      RETURN x.n AS x
+      """
+    Then the result should be, in any order:
+      | x   |
+      | 'b' |
+      | 'c' |
+      | 'd' |
+
+  Scenario: undirected match sees both orientations once
+    When executing query:
+      """
+      MATCH (b:P {n: 'b'})-[:L]-(x)
+      RETURN x.n AS x
+      """
+    Then the result should be, in any order:
+      | x   |
+      | 'a' |
+      | 'c' |

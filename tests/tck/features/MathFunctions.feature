@@ -1,0 +1,86 @@
+Feature: MathFunctions
+
+  Scenario: abs sign ceil floor round on integers and floats
+    Given an empty graph
+    When executing query:
+      """
+      RETURN abs(-7) AS a, sign(-3) AS s, ceil(3.2) AS c, floor(3.8) AS f, round(2.5) AS r
+      """
+    Then the result should be, in any order:
+      | a | s  | c   | f   | r   |
+      | 7 | -1 | 4.0 | 3.0 | 3.0 |
+
+  Scenario: sqrt exp log log10
+    Given an empty graph
+    When executing query:
+      """
+      RETURN sqrt(16) AS q, exp(0) AS e, log(1) AS l, log10(1000) AS t
+      """
+    Then the result should be, in any order:
+      | q   | e   | l   | t   |
+      | 4.0 | 1.0 | 0.0 | 3.0 |
+
+  Scenario: pi and e constants are floats
+    Given an empty graph
+    When executing query:
+      """
+      RETURN floor(pi() * 100) AS p, floor(e() * 100) AS ee
+      """
+    Then the result should be, in any order:
+      | p     | ee    |
+      | 314.0 | 271.0 |
+
+  Scenario: trigonometry round trip
+    Given an empty graph
+    When executing query:
+      """
+      RETURN sin(0) AS s, cos(0) AS c, round(degrees(radians(180))) AS d
+      """
+    Then the result should be, in any order:
+      | s   | c   | d     |
+      | 0.0 | 1.0 | 180.0 |
+
+  Scenario: math functions propagate null
+    Given an empty graph
+    When executing query:
+      """
+      RETURN abs(null) AS a, sqrt(null) AS q, round(null) AS r
+      """
+    Then the result should be, in any order:
+      | a    | q    | r    |
+      | null | null | null |
+
+  Scenario: integer division and modulo
+    Given an empty graph
+    When executing query:
+      """
+      RETURN 7 / 2 AS d, 7 % 2 AS m, 7.0 / 2 AS f, -7 % 2 AS nm
+      """
+    Then the result should be, in any order:
+      | d | m | f   | nm |
+      | 3 | 1 | 3.5 | -1 |
+
+  Scenario: exponentiation operator
+    Given an empty graph
+    When executing query:
+      """
+      RETURN 2 ^ 10 AS p, 2.0 ^ 2 AS f
+      """
+    Then the result should be, in any order:
+      | p      | f   |
+      | 1024.0 | 4.0 |
+
+  Scenario: unary minus over properties
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:N {x: 5}), (:N {x: -3})
+      """
+    When executing query:
+      """
+      MATCH (n:N) RETURN -n.x AS neg
+      """
+    Then the result should be, in any order:
+      | neg |
+      | -5  |
+      | 3   |

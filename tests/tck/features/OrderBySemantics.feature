@@ -1,0 +1,99 @@
+Feature: OrderBySemantics
+
+  Scenario: ascending order puts nulls last
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:N {v: 3}), (:N {v: 1}), (:N), (:N {v: 2})
+      """
+    When executing query:
+      """
+      MATCH (n:N) RETURN n.v AS v ORDER BY v
+      """
+    Then the result should be, in order:
+      | v    |
+      | 1    |
+      | 2    |
+      | 3    |
+      | null |
+
+  Scenario: descending order puts nulls first
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:N {v: 3}), (:N {v: 1}), (:N)
+      """
+    When executing query:
+      """
+      MATCH (n:N) RETURN n.v AS v ORDER BY v DESC
+      """
+    Then the result should be, in order:
+      | v    |
+      | null |
+      | 3    |
+      | 1    |
+
+  Scenario: multi key sort with mixed directions
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:R {g: 1, v: 'b'}), (:R {g: 1, v: 'a'}), (:R {g: 2, v: 'c'}), (:R {g: 2, v: 'd'})
+      """
+    When executing query:
+      """
+      MATCH (r:R) RETURN r.g AS g, r.v AS v ORDER BY g DESC, v ASC
+      """
+    Then the result should be, in order:
+      | g | v   |
+      | 2 | 'c' |
+      | 2 | 'd' |
+      | 1 | 'a' |
+      | 1 | 'b' |
+
+  Scenario: order by expression not in the projection
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:S {a: 5, b: 1}), (:S {a: 3, b: 9})
+      """
+    When executing query:
+      """
+      MATCH (s:S) RETURN s.a AS a ORDER BY s.b
+      """
+    Then the result should be, in order:
+      | a |
+      | 5 |
+      | 3 |
+
+  Scenario: order by aggregate result
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:G {k: 'x'}), (:G {k: 'x'}), (:G {k: 'y'}), (:G {k: 'y'}), (:G {k: 'y'}), (:G {k: 'z'})
+      """
+    When executing query:
+      """
+      MATCH (g:G) RETURN g.k AS k, count(*) AS c ORDER BY c DESC, k
+      """
+    Then the result should be, in order:
+      | k   | c |
+      | 'y' | 3 |
+      | 'x' | 2 |
+      | 'z' | 1 |
+
+  Scenario: integers and floats interleave by numeric value
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:M {v: 2}), (:M {v: 1.5}), (:M {v: 1}), (:M {v: 2.5})
+      """
+    When executing query:
+      """
+      MATCH (m:M) RETURN m.v AS v ORDER BY v
+      """
+    Then the result should be, in order:
+      | v   |
+      | 1   |
+      | 1.5 |
+      | 2   |
+      | 2.5 |

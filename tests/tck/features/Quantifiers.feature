@@ -1,0 +1,51 @@
+Feature: Quantifiers
+
+  Scenario: all any none single over literal lists
+    Given an empty graph
+    When executing query:
+      """
+      RETURN all(x IN [1, 2, 3] WHERE x > 0) AS a,
+             any(x IN [1, 2, 3] WHERE x > 2) AS b,
+             none(x IN [1, 2, 3] WHERE x > 3) AS c,
+             single(x IN [1, 2, 3] WHERE x = 2) AS d
+      """
+    Then the result should be, in any order:
+      | a    | b    | c    | d    |
+      | true | true | true | true |
+
+  Scenario: quantifiers over the empty list
+    Given an empty graph
+    When executing query:
+      """
+      RETURN all(x IN [] WHERE x > 0) AS a,
+             any(x IN [] WHERE x > 0) AS b,
+             none(x IN [] WHERE x > 0) AS c,
+             single(x IN [] WHERE x > 0) AS d
+      """
+    Then the result should be, in any order:
+      | a    | b     | c    | d     |
+      | true | false | true | false |
+
+  Scenario: single is false when more than one matches
+    Given an empty graph
+    When executing query:
+      """
+      RETURN single(x IN [1, 2, 2] WHERE x = 2) AS s
+      """
+    Then the result should be, in any order:
+      | s     |
+      | false |
+
+  Scenario: quantifiers filter rows in WHERE
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:T {xs: [1, 2, 3]}), (:T {xs: [4, 5]}), (:T {xs: []})
+      """
+    When executing query:
+      """
+      MATCH (t:T) WHERE any(x IN t.xs WHERE x >= 4) RETURN t.xs AS xs
+      """
+    Then the result should be, in any order:
+      | xs     |
+      | [4, 5] |

@@ -1,0 +1,83 @@
+Feature: StringFunctions
+
+  Scenario: case conversion and trim family
+    Given an empty graph
+    When executing query:
+      """
+      RETURN toUpper('aBc') AS u, toLower('aBc') AS l, trim('  x  ') AS t,
+             lTrim('  x') AS lt, rTrim('x  ') AS rt
+      """
+    Then the result should be, in any order:
+      | u     | l     | t   | lt  | rt  |
+      | 'ABC' | 'abc' | 'x' | 'x' | 'x' |
+
+  Scenario: substring left right
+    Given an empty graph
+    When executing query:
+      """
+      RETURN substring('hello', 1, 3) AS s, left('hello', 2) AS l, right('hello', 2) AS r
+      """
+    Then the result should be, in any order:
+      | s     | l    | r    |
+      | 'ell' | 'he' | 'lo' |
+
+  Scenario: replace split reverse size
+    Given an empty graph
+    When executing query:
+      """
+      RETURN replace('one,two', ',', '-') AS rep, split('a,b,c', ',') AS sp,
+             reverse('abc') AS rev, size('hello') AS n
+      """
+    Then the result should be, in any order:
+      | rep       | sp              | rev   | n |
+      | 'one-two' | ['a', 'b', 'c'] | 'cba' | 5 |
+
+  Scenario: string concatenation with plus
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:P {first: 'Ada', last: 'Lovelace'})
+      """
+    When executing query:
+      """
+      MATCH (p:P) RETURN p.first + ' ' + p.last AS full
+      """
+    Then the result should be, in any order:
+      | full           |
+      | 'Ada Lovelace' |
+
+  Scenario: toString on numbers and booleans
+    Given an empty graph
+    When executing query:
+      """
+      RETURN toString(42) AS i, toString(true) AS b, toString('s') AS s
+      """
+    Then the result should be, in any order:
+      | i    | b      | s   |
+      | '42' | 'true' | 's' |
+
+  Scenario: string predicates on stored properties
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:W {s: 'apple'}), (:W {s: 'banana'}), (:W {s: 'apricot'}), (:W {s: null})
+      """
+    When executing query:
+      """
+      MATCH (w:W)
+      WHERE w.s STARTS WITH 'ap' AND w.s CONTAINS 'p' AND NOT w.s ENDS WITH 'le'
+      RETURN w.s AS s
+      """
+    Then the result should be, in any order:
+      | s         |
+      | 'apricot' |
+
+  Scenario: string functions propagate null
+    Given an empty graph
+    When executing query:
+      """
+      RETURN toUpper(null) AS u, substring(null, 1) AS s, size(null) AS n
+      """
+    Then the result should be, in any order:
+      | u    | s    | n    |
+      | null | null | null |

@@ -1,0 +1,71 @@
+Feature: TernaryLogic
+
+  Scenario: AND truth table with null
+    Given an empty graph
+    When executing query:
+      """
+      RETURN (true AND null) AS a, (false AND null) AS b, (null AND null) AS c
+      """
+    Then the result should be, in any order:
+      | a    | b     | c    |
+      | null | false | null |
+
+  Scenario: OR truth table with null
+    Given an empty graph
+    When executing query:
+      """
+      RETURN (true OR null) AS a, (false OR null) AS b, (null OR null) AS c
+      """
+    Then the result should be, in any order:
+      | a    | b    | c    |
+      | true | null | null |
+
+  Scenario: NOT and XOR with null
+    Given an empty graph
+    When executing query:
+      """
+      RETURN (NOT null) AS a, (true XOR null) AS b, (true XOR true) AS c, (true XOR false) AS d
+      """
+    Then the result should be, in any order:
+      | a    | b    | c     | d    |
+      | null | null | false | true |
+
+  Scenario: WHERE keeps only true rows
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:B {v: true}), (:B {v: false}), (:B {v: null})
+      """
+    When executing query:
+      """
+      MATCH (b:B) WHERE b.v RETURN count(*) AS kept
+      """
+    Then the result should be, in any order:
+      | kept |
+      | 1    |
+
+  Scenario: IS NULL and IS NOT NULL are two valued
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:B {v: 1}), (:B)
+      """
+    When executing query:
+      """
+      MATCH (b:B)
+      RETURN b.v IS NULL AS isn, b.v IS NOT NULL AS nn
+      """
+    Then the result should be, in any order:
+      | isn   | nn    |
+      | false | true  |
+      | true  | false |
+
+  Scenario: comparison with null inside CASE
+    Given an empty graph
+    When executing query:
+      """
+      RETURN CASE WHEN null > 1 THEN 'yes' ELSE 'no' END AS r
+      """
+    Then the result should be, in any order:
+      | r    |
+      | 'no' |

@@ -1,0 +1,49 @@
+Feature: UnionSemantics
+
+  Scenario: UNION ALL keeps duplicates
+    Given an empty graph
+    When executing query:
+      """
+      RETURN 1 AS x UNION ALL RETURN 1 AS x
+      """
+    Then the result should be, in any order:
+      | x |
+      | 1 |
+      | 1 |
+
+  Scenario: UNION removes duplicates
+    Given an empty graph
+    When executing query:
+      """
+      RETURN 1 AS x UNION RETURN 1 AS x
+      """
+    Then the result should be, in any order:
+      | x |
+      | 1 |
+
+  Scenario: UNION over matches with shared column names
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:A {v: 1}), (:B {v: 2}), (:B {v: 1})
+      """
+    When executing query:
+      """
+      MATCH (a:A) RETURN a.v AS v UNION MATCH (b:B) RETURN b.v AS v
+      """
+    Then the result should be, in any order:
+      | v |
+      | 1 |
+      | 2 |
+
+  Scenario: three way UNION ALL
+    Given an empty graph
+    When executing query:
+      """
+      RETURN 'a' AS s UNION ALL RETURN 'b' AS s UNION ALL RETURN 'a' AS s
+      """
+    Then the result should be, in any order:
+      | s   |
+      | 'a' |
+      | 'b' |
+      | 'a' |

@@ -338,6 +338,29 @@ def test_fused_var_length_expand_matches_oracle(monkeypatch):
     assert calls["n"] >= len(fused_queries), "var-length queries bypassed the fused loop"
 
 
+def test_var_length_after_other_expands_matches_oracle():
+    """A fixed or var-length hop FEEDING a var-length hop must survive
+    pruning (regression: the var-length classic shadow's static select list
+    broke when upstream fused expands pruned pass-through columns)."""
+    from tpu_cypher import CypherSession
+
+    create = (
+        "CREATE (a:P {i:0})-[:E]->(b:P {i:1})-[:E]->(c:P {i:2}),"
+        "(a)-[:E]->(c), (c)-[:E]->(a)"
+    )
+    queries = [
+        "MATCH (a:P)-[r:E]->(b)-[:E*1..2]->(d) RETURN count(*) AS k",
+        "MATCH (a)-[:E*1..2]->(b)-[:E*1..2]->(d) RETURN count(*) AS k",
+        "MATCH (a:P)-[:E]->(b)-[:E*1..2]->(d) RETURN a.i, count(*) AS k ORDER BY a.i",
+    ]
+    gl = CypherSession.local().create_graph_from_create_query(create)
+    gt = CypherSession.tpu().create_graph_from_create_query(create)
+    for q in queries:
+        want = gl.cypher(q).records.collect()
+        got = gt.cypher(q).records.collect()
+        assert got == want, f"{q}: {got} != {want}"
+
+
 def test_jitted_eval_param_type_not_conflated():
     """1 == True == 1.0 in Python, but the jitted-eval cache must not replay
     a program traced for one param type when called with another."""

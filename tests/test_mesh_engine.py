@@ -121,6 +121,52 @@ def test_csr_edge_arrays_sharded(meshed):
     assert tuple(edge_orig.sharding.spec) == (ROW_AXIS,)
 
 
+_COLLECTIVES = (
+    "all-reduce",
+    "all-gather",
+    "all-to-all",
+    "collective-permute",
+    "reduce-scatter",
+)
+
+
+def test_sharded_programs_emit_xla_collectives(meshed):
+    """The sharded engine's distribution story is GSPMD inserting ICI
+    collectives into the compiled programs (SURVEY §2.3's replacement for
+    the engines' shuffle exchange) — assert they are really in the HLO, not
+    just implied by the sharding annotations."""
+    import jax.numpy as jnp
+
+    import tpu_cypher.backend.tpu.jit_ops as J
+
+    mesh, _, _ = meshed
+    n, e = N_NODES, N_EDGES
+    rng = np.random.default_rng(0)
+    src = np.sort(rng.integers(0, n, e))
+    dst = rng.integers(0, n, e)
+    rp = jnp.asarray(np.searchsorted(src, np.arange(n + 1)).astype(np.int32))
+    with use_mesh(mesh):
+        ci = shard_rows(jnp.asarray(dst.astype(np.int32)))
+        ids = shard_rows(jnp.asarray(np.arange(n, dtype=np.int64)))
+        rd = shard_rows(jnp.asarray(rng.integers(0, 50, e).astype(np.int64)))
+    dev_ids = jnp.asarray(np.arange(n, dtype=np.int64))
+    # fused count chain over a sharded CSR + sharded frontier ids
+    hops = ((rp, ci, None, None, None, None),)
+    txt = (
+        J.path_count_chain.lower(dev_ids, ids, None, hops, num_nodes=n)
+        .compile()
+        .as_text()
+    )
+    assert any(k in txt for k in _COLLECTIVES), "no collective in count chain HLO"
+    # sort-probe join build phase over a sharded key column
+    txt2 = (
+        J.join_build.lower(rd, (), is_f64=False, is_bool=False)
+        .compile()
+        .as_text()
+    )
+    assert any(k in txt2 for k in _COLLECTIVES), "no collective in join HLO"
+
+
 def test_mesh_context_restores():
     assert current_mesh() is None
     import jax

@@ -187,7 +187,10 @@ class TpuEvaluator:
                         if c is not None:
                             hset.add((e, c))
                 except Exception:
-                    pass
+                    # an unresolvable variable must DISABLE caching, not
+                    # silently narrow the key (a narrower key could replay
+                    # a program traced under a different header mapping)
+                    return None, None, None
         key = (expr, self.n, tuple(ckey), tuple(pkey), frozenset(hset))
         try:
             hash(key)
@@ -489,6 +492,10 @@ class TpuEvaluator:
         ):
             raise TpuUnsupportedExpr("IN on non-literal list")
         values = [i.value for i in expr.rhs.items]
+        if not values:
+            # x IN [] is the empty disjunction: false for EVERY x, null
+            # included (the null-propagation below must not see this case)
+            return Column(BOOL, jnp.zeros(self.n, bool), None)
         l = self.eval(expr.lhs)
         if l.kind == I64 and any(isinstance(v, float) for v in values):
             # cross-type numeric equality: 23 IN [23.0] is true

@@ -148,11 +148,12 @@ def _local_mentions(op: O.RelationalOperator) -> Set[E.Expr]:
             except Exception:
                 m.update(h.expressions)
     elif isinstance(op, CsrVarExpandOp):
-        h = op.children[0].header
-        try:
-            m.add(h.id_expr(h.var(op.source_fld)))
-        except Exception:
-            m.update(h.expressions)
+        # the fused path reads only the source id, but the classic SHADOW
+        # cascade ends in a SelectOp whose plan-time field list names every
+        # lhs var — pruning them away upstream would break the shadow's
+        # header (and the fallback). Var-length therefore pins its whole
+        # input header; fixed-hop expands upstream stay un-pruned.
+        m.update(op.children[0].header.expressions)
     return m
 
 
