@@ -338,6 +338,69 @@ def test_fused_var_length_expand_matches_oracle(monkeypatch):
     assert calls["n"] >= len(fused_queries), "var-length queries bypassed the fused loop"
 
 
+def test_cse_shares_identical_union_branches():
+    """Structurally identical subplans merge into ONE shared operator whose
+    table computes once, wrapped in a shared CacheOp (the reference's
+    InsertCachingOperators analog, RelationalOptimizer.scala:41-90)."""
+    from tpu_cypher import CypherSession
+    from tpu_cypher.relational.ops import CacheOp, UnionAllOp
+
+    g = CypherSession.local().create_graph_from_create_query(
+        "CREATE (:V {i:1}), (:V {i:2})"
+    )
+    q = (
+        "MATCH (a:V) WHERE a.i > 0 RETURN a.i AS x "
+        "UNION ALL MATCH (a:V) WHERE a.i > 0 RETURN a.i AS x"
+    )
+    res = g.cypher(q)
+    rows = [dict(r) for r in res.records.collect()]
+    assert sorted(r["x"] for r in rows) == [1, 1, 2, 2]
+    op = res.relational_plan
+    while op.children and not isinstance(op, UnionAllOp):
+        op = op.children[0]
+    assert isinstance(op, UnionAllOp)
+    left, right = op.children
+    assert left is right, "identical UNION branches were not merged"
+    assert isinstance(left, CacheOp), "shared subtree not wrapped in CacheOp"
+
+
+def test_cse_never_merges_nondeterministic_branches():
+    """Two syntactic rand() occurrences are independent evaluations — CSE
+    must not collapse them (UNION would then wrongly dedup to one row)."""
+    from tpu_cypher import CypherSession
+    from tpu_cypher.relational.ops import UnionAllOp
+
+    g = CypherSession.local().create_graph_from_create_query("CREATE (:V)")
+    q = "MATCH (a:V) RETURN rand() AS x UNION ALL MATCH (a:V) RETURN rand() AS x"
+    res = g.cypher(q)
+    rows = [dict(r)["x"] for r in res.records.collect()]
+    assert len(rows) == 2 and all(0 <= v < 1 for v in rows)
+    op = res.relational_plan
+    while op.children and not isinstance(op, UnionAllOp):
+        op = op.children[0]
+    assert op.children[0] is not op.children[1], "rand() branches merged"
+
+
+def test_cse_does_not_merge_different_branches():
+    from tpu_cypher import CypherSession
+    from tpu_cypher.relational.ops import UnionAllOp
+
+    g = CypherSession.local().create_graph_from_create_query(
+        "CREATE (:V {i:1}), (:V {i:2})"
+    )
+    q = (
+        "MATCH (a:V) WHERE a.i > 0 RETURN a.i AS x "
+        "UNION ALL MATCH (a:V) WHERE a.i > 1 RETURN a.i AS x"
+    )
+    res = g.cypher(q)
+    rows = sorted(dict(r)["x"] for r in res.records.collect())
+    assert rows == [1, 2, 2]
+    op = res.relational_plan
+    while op.children and not isinstance(op, UnionAllOp):
+        op = op.children[0]
+    assert op.children[0] is not op.children[1]
+
+
 def test_var_length_after_other_expands_matches_oracle():
     """A fixed or var-length hop FEEDING a var-length hop must survive
     pruning (regression: the var-length classic shadow's static select list

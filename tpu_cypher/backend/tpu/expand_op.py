@@ -227,10 +227,14 @@ class CsrExpandOp(_FusedExpandBase):
         irrelevant for counting: each op's row MULTISET is exactly its
         child's multiset expanded, so a per-node multiplicity vector carries
         complete information down the chain."""
+        from ...relational.ops import CacheOp
+
         hops: List[CsrExpandOp] = [self]
         node = self
         while True:
             child = node.children[0]
+            while isinstance(child, CacheOp):  # cache wraps are identity
+                child = child.children[0]
             if (
                 isinstance(child, CsrExpandOp)
                 and child._graph_obj is self._graph_obj

@@ -205,11 +205,32 @@ class ScanGraph(RelationalCypherGraph):
     # ------------------------------------------------------------------
 
     def scan_operator(self, var_name, ct, ctx) -> RelationalOperator:
+        # per-CONTEXT scan cache: repeated scans of the same var/type in one
+        # query (UNION branches, EXISTS stems, var-length steps) share ONE
+        # operator object, which the CSE pass then merges parents over. The
+        # cache deliberately lives on the runtime context, NOT the graph:
+        # leaf operators pin their ctx (parameters flow up from leaves), so
+        # a graph-level cache would leak the first query's parameters into
+        # later queries.
+        cache = getattr(ctx, "_scan_op_cache", None)
+        if cache is None:
+            cache = {}
+            try:
+                object.__setattr__(ctx, "_scan_op_cache", cache)
+            except Exception:  # pragma: no cover - exotic frozen context
+                cache = None
+        key = (id(self), var_name, ct)
+        if cache is not None and key in cache:
+            return cache[key]
         if isinstance(ct, T.CTNodeType):
-            return self._node_scan_op(var_name, ct, ctx)
-        if isinstance(ct, T.CTRelationshipType):
-            return self._rel_scan_op(var_name, ct, ctx)
-        raise TypeError(f"Cannot scan for {ct!r}")
+            op = self._node_scan_op(var_name, ct, ctx)
+        elif isinstance(ct, T.CTRelationshipType):
+            op = self._rel_scan_op(var_name, ct, ctx)
+        else:
+            raise TypeError(f"Cannot scan for {ct!r}")
+        if cache is not None:
+            cache[key] = op
+        return op
 
     def _node_scan_op(self, var_name, ct: T.CTNodeType, ctx) -> RelationalOperator:
         target = header_for_node(var_name, ct, self.schema)

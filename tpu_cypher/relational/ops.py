@@ -388,9 +388,10 @@ class AggregateOp(RelationalOperator):
             # projections keep the row multiset, so peel SelectOps as long
             # as the distinct fields survive them.
             inner = in_op.children[0]
-            while isinstance(inner, SelectOp) and set(in_op.fields) <= set(
-                inner.fields
-            ):
+            while (
+                isinstance(inner, SelectOp)
+                and set(in_op.fields) <= set(inner.fields)
+            ) or isinstance(inner, CacheOp):
                 inner = inner.children[0]
             fused = getattr(inner, "distinct_endpoints_count", None)
             if fused is not None:

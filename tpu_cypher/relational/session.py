@@ -538,5 +538,8 @@ class CypherSession:
             from .prune import prune_fused_columns
 
             relational = time_stage("prune", prune_fused_columns, relational)
+        from .cse import share_common_subplans
+
+        relational = time_stage("cse", share_common_subplans, relational)
         returns = getattr(ir, "returns", None)
         return CypherResult(self, logical, relational, returns)
