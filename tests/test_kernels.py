@@ -338,6 +338,49 @@ def test_fused_var_length_expand_matches_oracle(monkeypatch):
     assert calls["n"] >= len(fused_queries), "var-length queries bypassed the fused loop"
 
 
+def test_order_by_limit_topk_matches_oracle(monkeypatch):
+    """ORDER BY ... [SKIP s] LIMIT k through the packed top-k path is
+    row-identical to the oracle's stable full sort (ties break by original
+    row order), and genuinely routes through order_topk."""
+    import numpy as np
+
+    from tpu_cypher import CypherSession
+    from tpu_cypher.backend.tpu import jit_ops
+
+    calls = {"n": 0}
+    orig = jit_ops.order_topk
+
+    def spy(*a, **kw):
+        calls["n"] += 1
+        return orig(*a, **kw)
+
+    monkeypatch.setattr(jit_ops, "order_topk", spy)
+
+    rng = np.random.default_rng(9)
+    parts = []
+    for i in range(40):
+        v = int(rng.integers(0, 8))  # many ties
+        s = ["'x'", "'y'", "'z'", "null"][int(rng.integers(0, 4))]
+        nullv = "null" if rng.random() < 0.2 else v
+        parts.append(f"(:N {{v: {nullv}, s: {s}, i: {i}}})")
+    create = "CREATE " + ", ".join(parts)
+
+    fused = [
+        "MATCH (n:N) RETURN n.v AS v, n.i AS i ORDER BY v LIMIT 7",
+        "MATCH (n:N) RETURN n.v AS v, n.i AS i ORDER BY v DESC LIMIT 5",
+        "MATCH (n:N) RETURN n.s AS s, n.v AS v, n.i AS i ORDER BY s, v DESC LIMIT 9",
+        "MATCH (n:N) RETURN n.v AS v, n.i AS i ORDER BY v SKIP 4 LIMIT 6",
+        "MATCH (n:N) RETURN n.i AS i ORDER BY n.v LIMIT 100",
+    ]
+    gl = CypherSession.local().create_graph_from_create_query(create)
+    gt = CypherSession.tpu().create_graph_from_create_query(create)
+    for q in fused:
+        want = [dict(r) for r in gl.cypher(q).records.collect()]
+        got = [dict(r) for r in gt.cypher(q).records.collect()]
+        assert got == want, f"{q}: {got[:4]}... != {want[:4]}..."
+    assert calls["n"] >= len(fused), "ORDER BY LIMIT bypassed the top-k path"
+
+
 def test_cse_shares_identical_union_branches():
     """Structurally identical subplans merge into ONE shared operator whose
     table computes once, wrapped in a shared CacheOp (the reference's

@@ -452,6 +452,43 @@ class TpuTable(Table):
 
     # -- ordering ----------------------------------------------------------
 
+    def order_by_limit(
+        self, items: Sequence[Tuple[str, bool]], k: int
+    ) -> Optional["TpuTable"]:
+        """First ``k`` rows under ORDER BY as ONE top-k over a packed int64
+        rank — O(n log k) instead of the full device sort. Returns None
+        (caller falls back to sort+limit) unless every sort key is integral
+        (ints, bools, dictionary-coded strings) and the ranges fit the bit
+        budget."""
+        n = self._nrows
+        if not items or n == 0 or k == 0:
+            return None
+        cols = [self._cols[c] for c, _ in items]
+        if any(c.kind not in (I64, BOOL, STR) for c in cols):
+            return None
+        k = min(k, n)
+        datas = tuple(c.data for c in cols)
+        valids = tuple(c.valid for c in cols)
+        mins, maxs = J.order_minmax(datas, valids)
+        mins = np.asarray(mins)
+        maxs = np.asarray(maxs)
+        pack = []
+        total_bits = 0
+        for lo, hi in zip(mins, maxs):
+            lo, hi = int(lo), int(hi)
+            if lo > hi:  # all-null key: zero data bits
+                lo, hi = 0, 0
+            span = hi - lo
+            bits = span.bit_length()
+            total_bits += bits + 1  # +1 null bit per key
+            pack.append((lo, span, bits))
+        total_bits += max(n - 1, 0).bit_length()  # stable row-index tiebreak
+        if total_bits > 62:
+            return None
+        ascs = tuple(bool(a) for _, a in items)
+        idx = J.order_topk(datas, valids, ascs, tuple(pack), k=k)
+        return self._take(idx)
+
     def order_by(self, items: Sequence[Tuple[str, bool]]) -> "TpuTable":
         """ORDER BY: one jitted stable lexsort under Cypher orderability
         (``jit_ops.order_permutation``) + one batched gather."""

@@ -415,13 +415,18 @@ class OrderByOp(RelationalOperator):
         super().__init__(in_op)
         self.items = list(items)  # (field, ascending)
 
-    def _compute_table(self) -> Table:
+    def sort_cols(self) -> List[Tuple[str, bool]]:
+        """(physical column, ascending) sort keys — shared with LimitOp's
+        top-k fusion so key resolution cannot diverge between paths."""
         h = self.header
         cols = []
         for f, asc in self.items:
             v = h.var(f)
             cols.append((h.column(h.id_expr(v)), asc))
-        return self.children[0].table.order_by(cols)
+        return cols
+
+    def _compute_table(self) -> Table:
+        return self.children[0].table.order_by(self.sort_cols())
 
 
 class SkipOp(RelationalOperator):
@@ -444,10 +449,41 @@ class LimitOp(RelationalOperator):
         super().__init__(in_op)
         self.expr = expr
 
+    @staticmethod
+    def _peel_cache(op: "RelationalOperator") -> "RelationalOperator":
+        while isinstance(op, CacheOp):
+            op = op.children[0]
+        return op
+
     def _compute_table(self) -> Table:
         v = _static_value(self.expr, self.context.parameters)
         if not isinstance(v, int) or isinstance(v, bool) or v < 0:
             raise RelationalError(f"LIMIT requires a non-negative integer, got {v!r}")
+        # top-k fusion: LIMIT k (with optional SKIP s) directly over ORDER BY
+        # asks the backend for the first s+k sorted rows instead of a full
+        # sort (TpuTable answers with one lax.top_k when the keys allow it)
+        node = self._peel_cache(self.children[0])
+        skip = 0
+        ob = None
+        if isinstance(node, SkipOp):
+            try:
+                skip = node._count()
+                inner = self._peel_cache(node.children[0])
+                if isinstance(inner, OrderByOp):
+                    ob = inner
+            except RelationalError:
+                ob = None
+        elif isinstance(node, OrderByOp):
+            ob = node
+        # skip the fusion when the sorted table is already materialized
+        # (a CSE-shared sibling computed it): slicing it is free
+        if ob is not None and ob._table is None:
+            in_t = ob.children[0].table
+            hook = getattr(in_t, "order_by_limit", None)
+            if hook is not None:
+                t = hook(ob.sort_cols(), skip + v)
+                if t is not None:
+                    return t.skip(skip) if skip else t
         return self.children[0].table.limit(v)
 
 
