@@ -1,0 +1,73 @@
+Feature: OptionalMatchSemantics
+
+  Background:
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (a:P {n: 'a'})-[:L {w: 1}]->(b:P {n: 'b'}), (c:P {n: 'c'})
+      """
+
+  Scenario: unmatched optional rows carry nulls
+    When executing query:
+      """
+      MATCH (x:P) OPTIONAL MATCH (x)-[r:L]->(y) RETURN x.n AS n, y.n AS yn, r.w AS w
+      """
+    Then the result should be, in any order:
+      | n   | yn   | w    |
+      | 'a' | 'b'  | 1    |
+      | 'b' | null | null |
+      | 'c' | null | null |
+
+  Scenario: optional match with WHERE keeps unmatched rows
+    When executing query:
+      """
+      MATCH (x:P) OPTIONAL MATCH (x)-[:L]->(y) WHERE y.n = 'zzz' RETURN x.n AS n, y AS y
+      """
+    Then the result should be, in any order:
+      | n   | y    |
+      | 'a' | null |
+      | 'b' | null |
+      | 'c' | null |
+
+  Scenario: chained optional matches
+    When executing query:
+      """
+      MATCH (x:P {n: 'a'})
+      OPTIONAL MATCH (x)-[:L]->(y)
+      OPTIONAL MATCH (y)-[:L]->(z)
+      RETURN x.n AS xn, y.n AS yn, z AS z
+      """
+    Then the result should be, in any order:
+      | xn  | yn  | z    |
+      | 'a' | 'b' | null |
+
+  Scenario: aggregating over optional nulls
+    When executing query:
+      """
+      MATCH (x:P) OPTIONAL MATCH (x)-[:L]->(y)
+      RETURN count(*) AS rows, count(y) AS matched
+      """
+    Then the result should be, in any order:
+      | rows | matched |
+      | 3    | 1       |
+
+  Scenario: optional match starting from nothing
+    When executing query:
+      """
+      OPTIONAL MATCH (q:NoSuchLabel) RETURN q
+      """
+    Then the result should be, in any order:
+      | q    |
+      | null |
+
+  Scenario: coalesce over optional values
+    When executing query:
+      """
+      MATCH (x:P) OPTIONAL MATCH (x)-[:L]->(y)
+      RETURN x.n AS n, coalesce(y.n, '-') AS yn
+      """
+    Then the result should be, in any order:
+      | n   | yn  |
+      | 'a' | 'b' |
+      | 'b' | '-' |
+      | 'c' | '-' |

@@ -1,0 +1,109 @@
+Feature: PatternPredicates
+
+  Background:
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (a:P {n: 'a'})-[:L]->(b:P {n: 'b'}), (b)-[:L]->(c:P {n: 'c'}),
+             (d:P {n: 'd'})
+      """
+
+  Scenario: pattern predicate in WHERE
+    When executing query:
+      """
+      MATCH (x:P) WHERE (x)-[:L]->() RETURN x.n AS n
+      """
+    Then the result should be, in any order:
+      | n   |
+      | 'a' |
+      | 'b' |
+
+  Scenario: negated pattern predicate
+    When executing query:
+      """
+      MATCH (x:P) WHERE NOT (x)-[:L]->() RETURN x.n AS n
+      """
+    Then the result should be, in any order:
+      | n   |
+      | 'c' |
+      | 'd' |
+
+  Scenario: exists function in WHERE
+    When executing query:
+      """
+      MATCH (x:P) WHERE exists((x)<-[:L]-()) RETURN x.n AS n
+      """
+    Then the result should be, in any order:
+      | n   |
+      | 'b' |
+      | 'c' |
+
+  Scenario: exists projected as a value
+    When executing query:
+      """
+      MATCH (x:P) RETURN x.n AS n, exists((x)-[:L]->()) AS has
+      """
+    Then the result should be, in any order:
+      | n   | has   |
+      | 'a' | true  |
+      | 'b' | true  |
+      | 'c' | false |
+      | 'd' | false |
+
+  Scenario: exists inside CASE in a projection
+    When executing query:
+      """
+      MATCH (x:P)
+      RETURN x.n AS n,
+             CASE WHEN exists((x)-[:L]->()) THEN 'src' ELSE 'sink' END AS role
+      """
+    Then the result should be, in any order:
+      | n   | role   |
+      | 'a' | 'src'  |
+      | 'b' | 'src'  |
+      | 'c' | 'sink' |
+      | 'd' | 'sink' |
+
+  Scenario: exists carried through WITH
+    When executing query:
+      """
+      MATCH (x:P)
+      WITH x, exists((x)<-[:L]-()) AS pointed
+      WHERE NOT pointed
+      RETURN x.n AS n
+      """
+    Then the result should be, in any order:
+      | n   |
+      | 'a' |
+      | 'd' |
+
+  Scenario: exists as an aggregation group key
+    When executing query:
+      """
+      MATCH (x:P) RETURN exists((x)-[:L]->()) AS e, count(*) AS c
+      """
+    Then the result should be, in any order:
+      | e     | c |
+      | true  | 2 |
+      | false | 2 |
+
+  Scenario: exists inside an ORDER BY expression
+    When executing query:
+      """
+      MATCH (x:P) RETURN x.n AS n ORDER BY exists((x)<-[:L]-()) DESC, x.n
+      """
+    Then the result should be, in order:
+      | n   |
+      | 'b' |
+      | 'c' |
+      | 'a' |
+      | 'd' |
+
+  Scenario: pattern predicate with a property condition on the far node
+    When executing query:
+      """
+      MATCH (x:P) WHERE (x)-[:L]->({n: 'c'}) RETURN x.n AS n
+      """
+    Then the result should be, in any order:
+      | n   |
+      | 'b' |

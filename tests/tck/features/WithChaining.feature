@@ -1,0 +1,102 @@
+Feature: WithChaining
+
+  Scenario: WITH narrows the scope
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:A {x: 1, y: 10}), (:A {x: 2, y: 20})
+      """
+    When executing query:
+      """
+      MATCH (a:A) WITH a.x AS x MATCH (b:A) WHERE b.x = x RETURN x, b.y AS y
+      """
+    Then the result should be, in any order:
+      | x | y  |
+      | 1 | 10 |
+      | 2 | 20 |
+
+  Scenario: WITH aggregation then further matching
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (a:U {n: 'u1'}), (b:U {n: 'u2'}),
+             (a)-[:F]->(:I {k: 1}), (a)-[:F]->(:I {k: 2}), (b)-[:F]->(:I {k: 3})
+      """
+    When executing query:
+      """
+      MATCH (u:U)-[:F]->(i:I)
+      WITH u, count(i) AS cnt
+      WHERE cnt > 1
+      RETURN u.n AS n, cnt
+      """
+    Then the result should be, in any order:
+      | n    | cnt |
+      | 'u1' | 2   |
+
+  Scenario: simultaneous reassignment in WITH
+    Given an empty graph
+    When executing query:
+      """
+      WITH 1 AS a, 2 AS b WITH b AS a, a AS b RETURN a, b
+      """
+    Then the result should be, in any order:
+      | a | b |
+      | 2 | 1 |
+
+  Scenario: WITH ORDER BY LIMIT then expand
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (a:V {r: 3})-[:T]->(:W {m: 'a'}), (b:V {r: 1})-[:T]->(:W {m: 'b'}),
+             (c:V {r: 2})-[:T]->(:W {m: 'c'})
+      """
+    When executing query:
+      """
+      MATCH (v:V)
+      WITH v ORDER BY v.r LIMIT 2
+      MATCH (v)-[:T]->(w:W)
+      RETURN w.m AS m
+      """
+    Then the result should be, in any order:
+      | m   |
+      | 'b' |
+      | 'c' |
+
+  Scenario: DISTINCT in WITH dedups before the next clause
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:D {g: 1}), (:D {g: 1}), (:D {g: 2})
+      """
+    When executing query:
+      """
+      MATCH (d:D) WITH DISTINCT d.g AS g RETURN count(*) AS c
+      """
+    Then the result should be, in any order:
+      | c |
+      | 2 |
+
+  Scenario: aggregates skip nulls but count star keeps rows
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:Z {v: 1}), (:Z {v: 3}), (:Z)
+      """
+    When executing query:
+      """
+      MATCH (z:Z)
+      RETURN count(*) AS rows, count(z.v) AS vals, sum(z.v) AS s, avg(z.v) AS a
+      """
+    Then the result should be, in any order:
+      | rows | vals | s | a   |
+      | 3    | 2    | 4 | 2.0 |
+
+  Scenario: min max over empty input are null
+    Given an empty graph
+    When executing query:
+      """
+      MATCH (q:NoSuchLabel) RETURN count(q) AS c, min(q.v) AS mn, max(q.v) AS mx
+      """
+    Then the result should be, in any order:
+      | c | mn   | mx   |
+      | 0 | null | null |

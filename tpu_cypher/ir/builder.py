@@ -305,6 +305,10 @@ class IRBuilder:
                 seen.add(name)
         for it in c.items:
             converted = self.convert_expr(it.expr, env)
+            # exists((a)-->(b)) projected as a VALUE gets the same subquery
+            # machinery as in WHERE (reference extracts pattern expressions
+            # from any clause: extractSubqueryFromPatternExpression.scala)
+            converted = self._assign_exists_targets(converted, env)
             name = it.alias or it.name
             if name in seen:
                 raise IRBuildError(f"Duplicate return column {name!r}")
@@ -362,7 +366,8 @@ class IRBuilder:
 
         sort_items = []
         for s in c.order_by:
-            sort_items.append(A.SortItem(convert_rest(s.expr), s.ascending))
+            se = self._assign_exists_targets(convert_rest(s.expr), env)
+            sort_items.append(A.SortItem(se, s.ascending))
         skip = self.convert_expr(c.skip, rest_env) if c.skip is not None else None
         limit = self.convert_expr(c.limit, rest_env) if c.limit is not None else None
 

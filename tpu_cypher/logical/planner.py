@@ -85,6 +85,7 @@ class LogicalPlanner:
                 for name, ex in blk.items:
                     if isinstance(ex, E.Var) and ex.name == name:
                         continue
+                    ex, plan = self._extract_exists(ex, plan)
                     tmp = self.fresh("proj")
                     plan = L.Project(plan, ex, tmp)
                     renames.append((name, E.Var(tmp).with_type(ex.cypher_type)))
@@ -94,11 +95,13 @@ class LogicalPlanner:
                 for name, ex in blk.items:
                     if isinstance(ex, E.Var) and ex.name == name:
                         continue
+                    ex, plan = self._extract_exists(ex, plan)
                     plan = L.Project(plan, ex, name)
             return plan
         if isinstance(blk, B.AggregationBlock):
             for name, ex in blk.group:
                 if not (isinstance(ex, E.Var) and ex.name == name):
+                    ex, plan = self._extract_exists(ex, plan)
                     plan = L.Project(plan, ex, name)
             d = dict(plan.fields)
             group = tuple((n, d[n]) for n, _ in blk.group)
@@ -114,10 +117,11 @@ class LogicalPlanner:
                     if isinstance(s.expr, E.Var):
                         items.append(s)
                     else:
+                        ex, plan = self._extract_exists(s.expr, plan)
                         f = self.fresh("sort")
-                        plan = L.Project(plan, s.expr, f)
+                        plan = L.Project(plan, ex, f)
                         items.append(
-                            SortItem(E.Var(f).with_type(s.expr.cypher_type), s.ascending)
+                            SortItem(E.Var(f).with_type(ex.cypher_type), s.ascending)
                         )
                 plan = L.OrderBy(plan, tuple(items))
             if blk.skip is not None:
@@ -281,8 +285,14 @@ class LogicalPlanner:
     # predicates (incl. exists subqueries)
     # ------------------------------------------------------------------
 
-    def _plan_predicate(self, pred: E.Expr, plan: L.LogicalOperator) -> L.LogicalOperator:
-        exists = [n for n in pred.iter_nodes() if isinstance(n, E.ExistsPattern)]
+    def _extract_exists(
+        self, expr: E.Expr, plan: L.LogicalOperator
+    ) -> Tuple[E.Expr, L.LogicalOperator]:
+        """Replace every exists-pattern inside ``expr`` with the boolean
+        flag var of a planned ``ExistsSubQuery`` (works in WHERE and in
+        projections alike — reference
+        ``extractSubqueryFromPatternExpression``)."""
+        exists = [n for n in expr.iter_nodes() if isinstance(n, E.ExistsPattern)]
         mapping: Dict[E.Expr, E.Expr] = {}
         for ep in exists:
             target = ep.target_field or self.fresh("exists")
@@ -295,7 +305,11 @@ class LogicalPlanner:
             plan = L.ExistsSubQuery(plan, rhs, target)
             mapping[ep] = E.Var(target).with_type(T.CTBoolean)
         if mapping:
-            pred = E.substitute(pred, mapping)
+            expr = E.substitute(expr, mapping)
+        return expr, plan
+
+    def _plan_predicate(self, pred: E.Expr, plan: L.LogicalOperator) -> L.LogicalOperator:
+        pred, plan = self._extract_exists(pred, plan)
         return L.Filter(plan, pred)
 
 
