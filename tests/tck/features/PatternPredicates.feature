@@ -77,6 +77,17 @@ Feature: PatternPredicates
       | 'a' |
       | 'd' |
 
+  Scenario: exists inside an aggregation input
+    When executing query:
+      """
+      MATCH (x:P)
+      RETURN count(exists((x)-[:L]->())) AS c,
+             sum(CASE WHEN exists((x)-[:L]->()) THEN 1 ELSE 0 END) AS s
+      """
+    Then the result should be, in any order:
+      | c | s |
+      | 4 | 2 |
+
   Scenario: exists as an aggregation group key
     When executing query:
       """

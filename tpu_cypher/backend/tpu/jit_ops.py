@@ -94,6 +94,17 @@ def any_true(mask):
     return jnp.any(mask)
 
 
+@jax.jit
+def any_nan_valid(data, valid):
+    nan = jnp.isnan(data)
+    return jnp.any(nan & valid if valid is not None else nan)
+
+
+@jax.jit
+def take_take(a, idx_outer, idx_inner):
+    return jnp.take(a, jnp.take(idx_outer, idx_inner))
+
+
 # ---------------------------------------------------------------------------
 # batched column gathers (one dispatch per table op, not per column)
 # ---------------------------------------------------------------------------
@@ -135,6 +146,33 @@ def cols_take_or_null(cols: Dict[str, Tuple[Any, Any, Any]], idx, in_bounds):
 def tree_take(arrays, idx):
     """Gather a pytree of same-length arrays by one index array."""
     return jax.tree.map(lambda a: jnp.take(a, idx, axis=0), arrays)
+
+
+@jax.jit
+def cols_concat(a_cols, b_cols):
+    """UNION ALL for structurally simple columns: same kind/dtype/vocab on
+    both sides — one dispatch for the whole table. Mixed valid/iflag
+    presence is harmonized inside (None = all-valid / no-int-rows)."""
+    out = {}
+    for c, (ad, av, ai) in a_cols.items():
+        bd, bv, bi = b_cols[c]
+        data = jnp.concatenate([ad, bd])
+        if av is None and bv is None:
+            valid = None
+        else:
+            valid = jnp.concatenate([
+                av if av is not None else jnp.ones(ad.shape[0], bool),
+                bv if bv is not None else jnp.ones(bd.shape[0], bool),
+            ])
+        if ai is None and bi is None:
+            iflag = None
+        else:
+            iflag = jnp.concatenate([
+                ai if ai is not None else jnp.zeros(ad.shape[0], bool),
+                bi if bi is not None else jnp.zeros(bd.shape[0], bool),
+            ])
+        out[c] = (data, valid, iflag)
+    return out
 
 
 # ---------------------------------------------------------------------------
@@ -530,6 +568,154 @@ def order_permutation(datas, valids, kinds, ascs):
                 keys.append(-nan.astype(jnp.int8))
             keys.append(-null.astype(jnp.int8))
     return jnp.lexsort(tuple(keys)).astype(jnp.int64)
+
+
+# ---------------------------------------------------------------------------
+# grouped aggregation (count/sum/avg/stdev/min/max) as one program per agg
+# ---------------------------------------------------------------------------
+
+
+@partial(jax.jit, static_argnames=("name", "kind", "k"))
+def segment_aggregate(data, valid, iflag, seg_j, name: str, kind: str, k: int):
+    """One aggregator over (value column, group index): the whole segment
+    computation — null masking, NaN orderability, Cypher intness tracking —
+    as ONE cached program. Returns (out_data, out_valid_or_None,
+    out_iflag_or_None, iflag_any_or_None); the host drops an all-false
+    int_flag using the scalar so column metadata stays canonical."""
+    n = data.shape[0]
+    v = valid if valid is not None else jnp.ones(n, bool)
+    cnt = jax.ops.segment_sum(v.astype(jnp.int64), seg_j, num_segments=k)
+    if name == "count":
+        return cnt, None, None, None
+    if name in ("sum", "avg", "stdev", "stdevp"):
+        zero = jnp.zeros((), data.dtype)
+        ssum = jax.ops.segment_sum(
+            jnp.where(v, data, zero), seg_j, num_segments=k
+        )
+        if name == "sum":
+            if kind == F64:
+                # Cypher sum over no values is the INTEGER 0, and the sum
+                # of an all-integer group is an INTEGER — int_flag lets
+                # the float column carry both exactly (ints < 2**53)
+                empty = cnt == 0
+                if iflag is not None:
+                    int_if_valid = jnp.where(v, iflag, True)
+                    all_int = (
+                        jax.ops.segment_min(
+                            int_if_valid.astype(jnp.int8), seg_j, num_segments=k
+                        )
+                        == 1
+                    )
+                    out_iflag = all_int | empty
+                else:
+                    out_iflag = empty
+                return (
+                    jnp.where(empty, 0.0, ssum), None, out_iflag,
+                    jnp.any(out_iflag),
+                )
+            return ssum, None, None, None
+        if name == "avg":
+            avg = ssum.astype(jnp.float64) / jnp.maximum(cnt, 1)
+            return avg, cnt > 0, None, None
+        # stdev (sample) / stdevp (population): two-pass for stability;
+        # empty and single-value groups are 0.0 like the oracle
+        x = data.astype(jnp.float64)
+        mean = ssum.astype(jnp.float64) / jnp.maximum(cnt, 1)
+        diff = jnp.where(v, x - jnp.take(mean, seg_j), 0.0)
+        ssq = jax.ops.segment_sum(diff * diff, seg_j, num_segments=k)
+        denom = jnp.maximum(cnt - (1 if name == "stdev" else 0), 1)
+        out = jnp.sqrt(ssq / denom)
+        return jnp.where(cnt >= 2, out, 0.0), None, None, None
+    # min / max with Cypher orderability: numbers < NaN; nulls skipped
+    d = data.astype(jnp.int8) if kind == BOOL else data
+    if kind == F64:
+        isnan = jnp.isnan(d) & v
+        nn_valid = v & ~isnan
+        nan_cnt = jax.ops.segment_sum(
+            isnan.astype(jnp.int64), seg_j, num_segments=k
+        )
+    else:
+        nn_valid = v
+        nan_cnt = None
+    big = (
+        jnp.asarray(jnp.inf, d.dtype)
+        if kind == F64
+        else jnp.asarray(jnp.iinfo(d.dtype).max, d.dtype)
+    )
+    if name == "min":
+        agged = jax.ops.segment_min(
+            jnp.where(nn_valid, d, big), seg_j, num_segments=k
+        )
+        if nan_cnt is not None:
+            # all-NaN group: min is NaN (NaN sorts above numbers)
+            agged = jnp.where((cnt - nan_cnt == 0) & (nan_cnt > 0), jnp.nan, agged)
+    else:
+        low = -big if kind != STR else -jnp.ones((), d.dtype)
+        agged = jax.ops.segment_max(
+            jnp.where(nn_valid, d, low), seg_j, num_segments=k
+        )
+        if nan_cnt is not None:
+            # any NaN: NaN is the maximum under Cypher orderability
+            agged = jnp.where(nan_cnt > 0, jnp.nan, agged)
+    if kind == BOOL:
+        agged = agged.astype(bool)
+    out_iflag = None
+    iflag_any = None
+    if kind == F64 and iflag is not None and n:
+        # Cypher intness of the winning value: the oracle's min/max keeps
+        # the FIRST minimal/maximal element in row order, so take the
+        # int_flag of the first row matching the aggregate
+        cand = nn_valid & (d == jnp.take(agged, seg_j))
+        first_row = jax.ops.segment_min(
+            jnp.where(cand, jnp.arange(n, dtype=jnp.int64), n),
+            seg_j,
+            num_segments=k,
+        )
+        safe_row = jnp.clip(first_row, 0, max(n - 1, 0))
+        out_iflag = jnp.take(iflag, safe_row) & (first_row < n)
+        iflag_any = jnp.any(out_iflag)
+    return agged, cnt > 0, out_iflag, iflag_any
+
+
+@partial(jax.jit, static_argnames=("name", "k"))
+def segment_percentile(data, valid, seg_j, p, name: str, k: int):
+    """percentileCont/Disc core: one segment-sorted gather program.
+    Returns (out_data, out_valid, order, positions) — the caller maps
+    gathered rows back for int_flag bookkeeping on the disc variant."""
+    n = data.shape[0]
+    v = valid if valid is not None else jnp.ones(n, bool)
+    cnt = jax.ops.segment_sum(v.astype(jnp.int64), seg_j, num_segments=k)
+    # explicit invalid flag as the secondary sort key — a value sentinel
+    # (+inf / int max) could tie with legitimate data and let a null
+    # row's payload be gathered as the percentile
+    order = jnp.lexsort((data, (~v).astype(jnp.int8), seg_j))
+    sorted_val = jnp.take(data, order)
+    sizes = jax.ops.segment_sum(jnp.ones(n, jnp.int64), seg_j, num_segments=k)
+    starts = jnp.concatenate([jnp.zeros(1, jnp.int64), jnp.cumsum(sizes)])[:-1]
+    safe_cnt = jnp.maximum(cnt, 1)
+    if name == "percentiledisc":
+        idx = jnp.where(
+            p > 0,
+            jnp.ceil(p * safe_cnt.astype(jnp.float64)).astype(jnp.int64) - 1,
+            0,
+        )
+        idx = jnp.clip(idx, 0, safe_cnt - 1)
+        pos = jnp.clip(starts + idx, 0, max(n - 1, 0))
+        out = jnp.take(sorted_val, pos) if n else jnp.zeros(k, data.dtype)
+        return out, cnt > 0, order, pos
+    fidx = p * (safe_cnt.astype(jnp.float64) - 1)
+    lo = jnp.floor(fidx).astype(jnp.int64)
+    hi = jnp.ceil(fidx).astype(jnp.int64)
+    frac = fidx - lo.astype(jnp.float64)
+    pos_lo = jnp.clip(starts + lo, 0, max(n - 1, 0))
+    pos_hi = jnp.clip(starts + hi, 0, max(n - 1, 0))
+    if n:
+        vlo = jnp.take(sorted_val, pos_lo).astype(jnp.float64)
+        vhi = jnp.take(sorted_val, pos_hi).astype(jnp.float64)
+        out = vlo * (1 - frac) + vhi * frac
+    else:
+        out = jnp.zeros(k, jnp.float64)
+    return out, cnt > 0, order, pos_lo
 
 
 # ---------------------------------------------------------------------------

@@ -445,10 +445,33 @@ class TpuTable(Table):
     def union_all(self, other: "TpuTable") -> "TpuTable":
         if set(self._cols) != set(other._cols):
             raise TpuBackendError("unionAll column mismatch")
-        return TpuTable(
-            {c: self._cols[c].concat(other._cols[c]) for c in self._cols},
-            self._nrows + other._nrows,
-        )
+        # structurally simple columns (same kind/dtype, shared vocab) concat
+        # in ONE jitted dispatch; kind promotion / vocab unification /
+        # object columns keep the per-column host path
+        simple = {}
+        for c, a in self._cols.items():
+            b = other._cols[c]
+            if (
+                a.kind != OBJ
+                and a.kind == b.kind
+                and a.vocab is b.vocab
+                and a.data.dtype == b.data.dtype
+            ):
+                simple[c] = (a, b)
+        out: Dict[str, Column] = {}
+        if simple:
+            merged = J.cols_concat(
+                {c: (a.data, a.valid, a.int_flag) for c, (a, b) in simple.items()},
+                {c: (b.data, b.valid, b.int_flag) for c, (a, b) in simple.items()},
+            )
+            for c, (d, v, i) in merged.items():
+                a = self._cols[c]
+                out[c] = Column(a.kind, d, v, a.vocab, int_flag=i)
+        for c in self._cols:
+            if c not in out:
+                out[c] = self._cols[c].concat(other._cols[c])
+        ordered = {c: out[c] for c in self._cols}
+        return TpuTable(ordered, self._nrows + other._nrows)
 
     # -- ordering ----------------------------------------------------------
 
@@ -709,136 +732,47 @@ class TpuTable(Table):
     def _segment_agg(
         self, name: str, agg, seg_j, col: Column, n: int, k: int, parameters=None
     ) -> Column:
-        """One aggregator over (value column, group index) as device segment
-        ops — the TPU analog of the engines' shuffle aggregate plus the
-        codegen UDAFs (reference ``PercentileUdafs.scala``,
-        ``TemporalUdafs.scala`` play this role on Spark)."""
-        import jax
-
+        """One aggregator over (value column, group index) as ONE jitted
+        segment program (``jit_ops.segment_aggregate``) — the TPU analog of
+        the engines' shuffle aggregate plus the codegen UDAFs (reference
+        ``PercentileUdafs.scala``, ``TemporalUdafs.scala``)."""
         data, kind, vocab = col.data, col.kind, col.vocab
-        valid = col.valid_mask()
-        cnt = jax.ops.segment_sum(valid.astype(jnp.int64), seg_j, num_segments=k)
-        if name == "count":
-            return Column(I64, cnt, None)
         if name == "collect":
             # output is host lists by definition; only this column decodes
+            valid_np = np.asarray(col.valid) if col.valid is not None else None
             vals = col.to_values()
             seg_np = np.asarray(seg_j)
-            valid_np = np.asarray(valid)
             lists: List[List[Any]] = [[] for _ in range(k)]
             for i in range(n):
-                if valid_np[i]:
+                if valid_np is None or valid_np[i]:
                     lists[int(seg_np[i])].append(vals[i])
             from .column import _obj_array
 
             return Column(OBJ, _obj_array(lists), None)
-        if name in ("sum", "avg", "stdev", "stdevp"):
-            if kind not in (I64, F64):
-                raise TpuUnsupportedExpr(f"{name} over {kind}")
-            zero = jnp.zeros((), data.dtype)
-            ssum = jax.ops.segment_sum(
-                jnp.where(valid, data, zero), seg_j, num_segments=k
-            )
-            if name == "sum":
-                if kind == F64:
-                    # Cypher sum over no values is the INTEGER 0, and the sum
-                    # of an all-integer group is an INTEGER — int_flag lets
-                    # the float column carry both exactly (ints < 2**53)
-                    empty = cnt == 0
-                    if col.int_flag is not None:
-                        int_if_valid = jnp.where(valid, col.int_flag, True)
-                        all_int = (
-                            jax.ops.segment_min(
-                                int_if_valid.astype(jnp.int8),
-                                seg_j,
-                                num_segments=k,
-                            )
-                            == 1
-                        )
-                        iflag = all_int | empty
-                    else:
-                        iflag = empty
-                    if not bool(jnp.any(iflag)):
-                        iflag = None
-                    return Column(
-                        F64, jnp.where(empty, 0.0, ssum), None, int_flag=iflag
-                    )
-                return Column(kind, ssum, None)
-            if name == "avg":
-                avg = ssum.astype(jnp.float64) / jnp.maximum(cnt, 1)
-                return Column(F64, avg, cnt > 0)
-            # stdev (sample) / stdevp (population): two-pass for stability;
-            # empty and single-value groups are 0.0 like the oracle
-            x = data.astype(jnp.float64)
-            mean = ssum.astype(jnp.float64) / jnp.maximum(cnt, 1)
-            diff = jnp.where(valid, x - jnp.take(mean, seg_j), 0.0)
-            ssq = jax.ops.segment_sum(diff * diff, seg_j, num_segments=k)
-            denom = jnp.maximum(cnt - (1 if name == "stdev" else 0), 1)
-            out = jnp.sqrt(ssq / denom)
-            return Column(F64, jnp.where(cnt >= 2, out, 0.0), None)
+        if name in ("sum", "avg", "stdev", "stdevp") and kind not in (I64, F64):
+            raise TpuUnsupportedExpr(f"{name} over {kind}")
         if name in ("percentilecont", "percentiledisc"):
-            return self._segment_percentile(
-                name, agg, seg_j, col, n, k, cnt, parameters
-            )
-        # min / max with Cypher orderability: numbers < NaN; nulls skipped
-        d = data.astype(jnp.int8) if kind == BOOL else data
-        if kind == F64:
-            isnan = jnp.isnan(d) & valid
-            nn_valid = valid & ~isnan
-            nan_cnt = jax.ops.segment_sum(
-                isnan.astype(jnp.int64), seg_j, num_segments=k
-            )
-        else:
-            nn_valid = valid
-            nan_cnt = None
-        big = jnp.asarray(
-            np.inf if kind == F64 else np.iinfo(np.dtype(d.dtype)).max,
-            d.dtype,
+            return self._segment_percentile(name, agg, seg_j, col, n, k, parameters)
+        out_data, out_valid, out_iflag, iflag_any = J.segment_aggregate(
+            data, col.valid, col.int_flag, seg_j, name=name, kind=kind, k=k
         )
-        if name == "min":
-            agged = jax.ops.segment_min(
-                jnp.where(nn_valid, d, big), seg_j, num_segments=k
-            )
-            if nan_cnt is not None:
-                # all-NaN group: min is NaN (NaN sorts above numbers)
-                nn_cnt = cnt - nan_cnt
-                agged = jnp.where((nn_cnt == 0) & (nan_cnt > 0), jnp.nan, agged)
+        if name == "count":
+            return Column(I64, out_data, None)
+        if out_iflag is not None and not bool(iflag_any):
+            out_iflag = None  # canonical metadata: no integer rows at all
+        if name == "sum":
+            out_kind = kind
+        elif name in ("avg", "stdev", "stdevp"):
+            out_kind = F64
         else:
-            agged = jax.ops.segment_max(
-                jnp.where(nn_valid, d, -big if kind != STR else -jnp.ones((), d.dtype)),
-                seg_j,
-                num_segments=k,
-            )
-            if nan_cnt is not None:
-                # any NaN: NaN is the maximum under Cypher orderability
-                agged = jnp.where(nan_cnt > 0, jnp.nan, agged)
-        if kind == BOOL:
-            agged = agged.astype(bool)
-        iflag = None
-        if kind == F64 and col.int_flag is not None:
-            # Cypher intness of the winning value: the oracle's min/max keeps
-            # the FIRST minimal/maximal element in row order, so take the
-            # int_flag of the first row matching the aggregate
-            cand = nn_valid & (d == jnp.take(agged, seg_j))
-            first_row = jax.ops.segment_min(
-                jnp.where(cand, jnp.arange(n, dtype=jnp.int64), n),
-                seg_j,
-                num_segments=k,
-            )
-            safe_row = jnp.clip(first_row, 0, max(n - 1, 0))
-            if n:
-                iflag = jnp.take(col.int_flag, safe_row) & (first_row < n)
-        return Column(kind, agged, cnt > 0, vocab, int_flag=iflag)
+            out_kind = kind
+        return Column(out_kind, out_data, out_valid, vocab, int_flag=out_iflag)
 
     def _segment_percentile(
-        self, name: str, agg, seg_j, col: Column, n: int, k: int, cnt, parameters=None
+        self, name: str, agg, seg_j, col: Column, n: int, k: int, parameters=None
     ) -> Column:
-        """percentileCont/Disc as a segment-sorted gather: one device
-        lexsort groups each segment's valid values contiguously, then the
-        target rank is a direct index off the segment's start offset
-        (reference ``PercentileUdafs.scala`` sorts per group on the JVM)."""
-        import jax
-
+        """percentileCont/Disc as a jitted segment-sorted gather (reference
+        ``PercentileUdafs.scala`` sorts per group on the JVM)."""
         from ...ir import expr as E
 
         if not agg.extra:
@@ -855,57 +789,21 @@ class TpuTable(Table):
             raise TpuUnsupportedExpr("percentile fraction out of range")
         p = float(p)
         data, kind, vocab = col.data, col.kind, col.vocab
-        valid = col.valid_mask()
         if kind == OBJ or kind == BOOL:
             raise TpuUnsupportedExpr(f"percentile over {kind}")
         if name == "percentilecont" and kind not in (I64, F64):
             raise TpuUnsupportedExpr("percentileCont over non-numeric")
-        if kind == F64 and bool(jnp.any(jnp.isnan(data) & valid)):
+        if kind == F64 and bool(J.any_nan_valid(data, col.valid)):
             raise TpuUnsupportedExpr("percentile over NaN values")
-        # explicit invalid flag as the secondary sort key — a value sentinel
-        # (+inf / int max) could tie with legitimate data and let a null
-        # row's payload be gathered as the percentile
-        order = jnp.lexsort((data, (~valid).astype(jnp.int8), seg_j))
-        sorted_val = jnp.take(data, order)
-        sizes = jax.ops.segment_sum(
-            jnp.ones(n, jnp.int64), seg_j, num_segments=k
+        out, out_valid, order, pos = J.segment_percentile(
+            data, col.valid, seg_j, p, name=name, k=k
         )
-        starts = jnp.concatenate(
-            [jnp.zeros(1, jnp.int64), jnp.cumsum(sizes)]
-        )[:-1]
-        safe_cnt = jnp.maximum(cnt, 1)
         if name == "percentiledisc":
-            idx = jnp.where(
-                p > 0,
-                jnp.ceil(p * safe_cnt.astype(jnp.float64)).astype(jnp.int64) - 1,
-                0,
-            )
-            idx = jnp.clip(idx, 0, safe_cnt - 1)
-            pos = jnp.clip(starts + idx, 0, max(n - 1, 0))
-            if n:
-                out = jnp.take(sorted_val, pos)
-                iflag = (
-                    jnp.take(col.int_flag, jnp.take(order, pos))
-                    if kind == F64 and col.int_flag is not None
-                    else None
-                )
-            else:
-                out = jnp.zeros(k, data.dtype)
-                iflag = None
-            return Column(kind, out, cnt > 0, vocab, int_flag=iflag)
-        fidx = p * (safe_cnt.astype(jnp.float64) - 1)
-        lo = jnp.floor(fidx).astype(jnp.int64)
-        hi = jnp.ceil(fidx).astype(jnp.int64)
-        frac = fidx - lo.astype(jnp.float64)
-        pos_lo = jnp.clip(starts + lo, 0, max(n - 1, 0))
-        pos_hi = jnp.clip(starts + hi, 0, max(n - 1, 0))
-        if n:
-            vlo = jnp.take(sorted_val, pos_lo).astype(jnp.float64)
-            vhi = jnp.take(sorted_val, pos_hi).astype(jnp.float64)
-            out = vlo * (1 - frac) + vhi * frac
-        else:
-            out = jnp.zeros(k, jnp.float64)
-        return Column(F64, out, cnt > 0)
+            iflag = None
+            if n and kind == F64 and col.int_flag is not None:
+                iflag = J.take_take(col.int_flag, order, pos)
+            return Column(kind, out, out_valid, vocab, int_flag=iflag)
+        return Column(F64, out, out_valid)
 
     def with_columns(self, items, header, parameters) -> "TpuTable":
         out = dict(self._cols)

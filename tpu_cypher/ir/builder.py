@@ -304,11 +304,10 @@ class IRBuilder:
                 items.append((name, E.Var(name).with_type(t)))
                 seen.add(name)
         for it in c.items:
+            # convert_expr assigns exists-pattern targets inline, so the
+            # projected expression is subquery-ready for the planner's
+            # _extract_exists (the reference's pattern-expression rewriter)
             converted = self.convert_expr(it.expr, env)
-            # exists((a)-->(b)) projected as a VALUE gets the same subquery
-            # machinery as in WHERE (reference extracts pattern expressions
-            # from any clause: extractSubqueryFromPatternExpression.scala)
-            converted = self._assign_exists_targets(converted, env)
             name = it.alias or it.name
             if name in seen:
                 raise IRBuildError(f"Duplicate return column {name!r}")
@@ -366,8 +365,7 @@ class IRBuilder:
 
         sort_items = []
         for s in c.order_by:
-            se = self._assign_exists_targets(convert_rest(s.expr), env)
-            sort_items.append(A.SortItem(se, s.ascending))
+            sort_items.append(A.SortItem(convert_rest(s.expr), s.ascending))
         skip = self.convert_expr(c.skip, rest_env) if c.skip is not None else None
         limit = self.convert_expr(c.limit, rest_env) if c.limit is not None else None
 

@@ -11,7 +11,7 @@ optional matches become ``Optional``; pattern predicates become
 from __future__ import annotations
 
 import itertools
-from dataclasses import dataclass, field as dc_field
+from dataclasses import dataclass, field as dc_field, replace as dc_replace
 from typing import Dict, List, Optional as Opt, Set, Tuple
 
 from ..api import types as T
@@ -103,9 +103,20 @@ class LogicalPlanner:
                 if not (isinstance(ex, E.Var) and ex.name == name):
                     ex, plan = self._extract_exists(ex, plan)
                     plan = L.Project(plan, ex, name)
+            # aggregation INPUTS can hold exists patterns too:
+            # count(exists((a)-->())) / sum(CASE WHEN exists(...) ...)
+            aggs = []
+            for name, agg in blk.aggregations:
+                inner = getattr(agg, "expr", None)
+                if inner is not None and any(
+                    isinstance(nd, E.ExistsPattern) for nd in inner.iter_nodes()
+                ):
+                    inner, plan = self._extract_exists(inner, plan)
+                    agg = dc_replace(agg, expr=inner)
+                aggs.append((name, agg))
             d = dict(plan.fields)
             group = tuple((n, d[n]) for n, _ in blk.group)
-            return L.Aggregate(plan, group, blk.aggregations)
+            return L.Aggregate(plan, group, tuple(aggs))
         if isinstance(blk, B.FilterBlock):
             return self._plan_predicate(blk.predicate, plan)
         if isinstance(blk, B.DistinctBlock):
