@@ -1,0 +1,21 @@
+"""Every example under examples/ runs end-to-end (reference analog:
+``morpheus-examples`` are compiled and exercised by the build)."""
+
+import os
+import runpy
+import sys
+
+import pytest
+
+HERE = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+EXAMPLES = sorted(
+    f for f in os.listdir(os.path.join(HERE, "examples")) if f.endswith(".py")
+)
+
+
+@pytest.mark.parametrize("name", EXAMPLES)
+def test_example_runs(name, capsys, monkeypatch):
+    monkeypatch.setattr(sys, "argv", [name])
+    runpy.run_path(os.path.join(HERE, "examples", name), run_name="__main__")
+    out = capsys.readouterr().out
+    assert out.strip(), f"{name} produced no output"

@@ -138,6 +138,22 @@ QUERIES = [
     "RETURN percentileDisc(a.num, 0.5) AS med, collect(DISTINCT a.num) AS xs",
     # union + distinct across vocabs
     "MATCH (a:N) RETURN a.s AS x UNION MATCH (a:N) RETURN toUpper(a.s) AS x",
+    # fused count chains (SpMV path) incl. labels and backwards hops
+    "MATCH (a:N)-[:R]->(b)-[:R]->(c) RETURN count(*) AS c2",
+    "MATCH (a:N)-[:R]->(b)-[:R]->(c)-[:R]->(d) RETURN count(*) AS c3",
+    "MATCH (a)<-[:R]-(b)<-[:R]-(c) RETURN count(*) AS back",
+    # fused distinct-endpoints counts
+    "MATCH (a:N)-[:R]->(b)-[:R]->(c) WITH DISTINCT a, c RETURN count(*) AS p",
+    "MATCH (a:N)-[:R]->(b)-[:R]->(c) WITH DISTINCT c RETURN count(*) AS t",
+    # packed top-k with ties, nulls, DESC, SKIP
+    "MATCH (a:N) RETURN a.s AS s, id(a) AS i ORDER BY s DESC, i SKIP 5 LIMIT 9",
+    "MATCH (a:N)-[r:R]->(b) RETURN r.since AS y, id(r) AS i ORDER BY y, i LIMIT 11",
+    # exists() as value / in aggregates at cardinality
+    "MATCH (a:N) RETURN exists((a)-[:R]->()) AS e, count(*) AS c ORDER BY e",
+    "MATCH (a:N) RETURN sum(CASE WHEN exists((a)<-[:R]-()) THEN 1 ELSE 0 END) AS s",
+    # identical UNION ALL branches (CSE shares + caches the stem)
+    "MATCH (a:N) WHERE a.num > 0 RETURN count(*) AS c "
+    "UNION ALL MATCH (a:N) WHERE a.num > 0 RETURN count(*) AS c",
 ]
 
 

@@ -760,12 +760,7 @@ class TpuTable(Table):
             return Column(I64, out_data, None)
         if out_iflag is not None and not bool(iflag_any):
             out_iflag = None  # canonical metadata: no integer rows at all
-        if name == "sum":
-            out_kind = kind
-        elif name in ("avg", "stdev", "stdevp"):
-            out_kind = F64
-        else:
-            out_kind = kind
+        out_kind = F64 if name in ("avg", "stdev", "stdevp") else kind
         return Column(out_kind, out_data, out_valid, vocab, int_flag=out_iflag)
 
     def _segment_percentile(

@@ -112,7 +112,12 @@ class LogicalPlanner:
                     isinstance(nd, E.ExistsPattern) for nd in inner.iter_nodes()
                 ):
                     inner, plan = self._extract_exists(inner, plan)
-                    agg = dc_replace(agg, expr=inner)
+                    rebuilt = dc_replace(agg, expr=inner)
+                    # dataclasses.replace drops the typer's non-field _typ —
+                    # restore it or the output column degrades to ANY?
+                    if agg.typ is not None:
+                        rebuilt = rebuilt.with_type(agg.typ)
+                    agg = rebuilt
                 aggs.append((name, agg))
             d = dict(plan.fields)
             group = tuple((n, d[n]) for n, _ in blk.group)
