@@ -381,6 +381,49 @@ def test_order_by_limit_topk_matches_oracle(monkeypatch):
     assert calls["n"] >= len(fused), "ORDER BY LIMIT bypassed the top-k path"
 
 
+def test_plan_cache_reuses_plans_and_rebinds_params():
+    """Repeated query text on the same graph reuses the planned operator
+    tree (no re-parse/re-plan); parameter VALUES rebind per execution, and
+    catalog-touching queries stay uncached."""
+    from tpu_cypher import CypherSession
+
+    session_graph = CypherSession.local().create_graph_from_create_query(
+        "CREATE (:V {i:1}), (:V {i:2}), (:V {i:3})"
+    )
+    sess = session_graph.session
+    q = "MATCH (n:V) WHERE n.i < $p RETURN count(*) AS c"
+    r1 = session_graph.cypher(q, parameters={"p": 2})
+    assert [dict(r) for r in r1.records.collect()] == [{"c": 1}]
+    r2 = session_graph.cypher(q, parameters={"p": 10})
+    assert [dict(r) for r in r2.records.collect()] == [{"c": 3}]
+    # the cache holds r1's plan; r2 executed a per-call CLONE of it
+    entry = next(
+        v for k, v in sess._plan_cache.items() if k[0] == q and k[2] == (("p", "int"),)
+    )
+    assert entry[2] is r1.relational_plan
+    assert r2.relational_plan is not r1.relational_plan
+    # param TYPE change produces a separate entry (no wrongly-typed replay)
+    r3 = session_graph.cypher(q, parameters={"p": 2.5})
+    assert [dict(r) for r in r3.records.collect()] == [{"c": 2}]
+    # a different graph with the same text must not collide
+    g2 = sess.create_graph_from_create_query("CREATE (:V {i:1})")
+    assert [dict(r) for r in g2.cypher(q, parameters={"p": 10}).records.collect()] == [
+        {"c": 1}
+    ]
+    # lazy results handed out earlier must KEEP their own bindings after
+    # later cache hits (each hit executes a per-call plan clone)
+    r_old = session_graph.cypher(q, parameters={"p": 2})
+    session_graph.cypher(q, parameters={"p": 10}).records.collect()
+    assert [dict(r) for r in r_old.records.collect()] == [{"c": 1}]
+    # catalog-flavored text is never cached
+    before = len(sess._plan_cache)
+    try:
+        session_graph.cypher("MATCH (n:V) RETURN count(*) AS c // CATALOG")
+    except Exception:
+        pass
+    assert len(sess._plan_cache) == before
+
+
 def test_cse_shares_identical_union_branches():
     """Structurally identical subplans merge into ONE shared operator whose
     table computes once, wrapped in a shared CacheOp (the reference's

@@ -200,6 +200,14 @@ class CypherSession:
         self._views_expanding: set = set()  # cycle guard
         self._sources: Dict[str, "PropertyGraphDataSource"] = {}
         self._counter = itertools.count()
+        # (query text, ambient graph id, param type sig) -> (graph object,
+        # logical, relational, returns), LRU-ordered. The stored graph
+        # reference keeps the id from being recycled; lookups re-check
+        # identity anyway. Hits CLONE the plan per execution — the cached
+        # tree is never mutated.
+        from collections import OrderedDict
+
+        self._plan_cache: "OrderedDict[Tuple, Tuple]" = OrderedDict()
 
     # -- data source namespaces (reference PropertyGraphCatalog.register) --
 
@@ -446,6 +454,66 @@ class CypherSession:
 
     # -- the pipeline ------------------------------------------------------
 
+    # keywords that make a plan depend on catalog / graph-creation state
+    # beyond the ambient graph — such queries are never plan-cached. FROM
+    # alone covers the keyword-optional `FROM <name>` form; a false match
+    # (e.g. a property named `from`) only skips caching, never corrupts.
+    _PLAN_CACHE_EXCLUDES = ("FROM", "CATALOG", "CONSTRUCT", "GRAPH")
+    _PLAN_CACHE_MAX = 256
+
+    def _plan_cache_key(self, query, graph, parameters, driving_table):
+        """Hashable key for reusing a fully-planned query, or None when the
+        query is ineligible (catalog interaction, driving tables, non-scalar
+        parameters). Parameter VALUES stay out of the key — plans reference
+        them symbolically and resolve at table-compute time — but their
+        TYPES are in it (typing may specialize on them)."""
+        if driving_table is not None or graph is None:
+            return None
+        up = query.upper()
+        if any(
+            re.search(rf"\b{s}\b", up) is not None
+            for s in self._PLAN_CACHE_EXCLUDES
+        ):
+            return None
+        psig = []
+        for k in sorted(parameters):
+            v = parameters[k]
+            if v is not None and not isinstance(v, (bool, int, float, str)):
+                return None
+            psig.append((k, type(v).__name__))
+        return (query, id(graph._graph), tuple(psig))
+
+    @staticmethod
+    def _clone_plan(root, parameters):
+        """Per-execution copy of a cached operator tree: fresh lazy-table
+        slots and a fresh runtime context carrying THIS call's parameters,
+        sharing the immutable pieces (headers, expressions, source tables,
+        graph indexes). The cached plan itself is never mutated, so lazy
+        CypherResults handed out earlier keep their own state."""
+        import copy
+
+        old_ctx = root.context
+        new_ctx = RelationalRuntimeContext(
+            old_ctx.resolve_graph, dict(parameters), old_ctx.table_cls
+        )
+        memo: Dict[int, Any] = {}
+
+        def walk(op):
+            got = memo.get(id(op))
+            if got is not None:
+                return got
+            new = copy.copy(op)
+            memo[id(op)] = new  # before children: DAG sharing preserved
+            new.children = tuple(walk(c) for c in op.children)
+            new._table = None
+            if hasattr(new, "_plan"):
+                new._plan = None
+            if getattr(new, "_ctx", None) is not None:
+                new._ctx = new_ctx
+            return new
+
+        return walk(root)
+
     def cypher(
         self,
         query: str,
@@ -454,6 +522,16 @@ class CypherSession:
         driving_table=None,
     ) -> CypherResult:
         parameters = dict(parameters or {})
+        cache_key = self._plan_cache_key(query, graph, parameters, driving_table)
+        if cache_key is not None:
+            hit = self._plan_cache.get(cache_key)
+            if hit is not None and hit[0] is graph._graph:
+                self._plan_cache.move_to_end(cache_key)
+                _, logical, relational, returns = hit
+                return CypherResult(
+                    self, logical,
+                    self._clone_plan(relational, parameters), returns,
+                )
         ambient = graph._graph if graph is not None else EmptyGraph()
         ambient_qgn = f"{AMBIENT_NS}.q{next(self._counter)}"
         self._catalog[ambient_qgn] = ambient  # mountAmbientGraph (reference :117)
@@ -513,7 +591,18 @@ class CypherSession:
                 self.drop_graph(ir.qgn)
             return CypherResult(self, None, None, None)
 
-        return self._plan_and_run(ir, parameters, input_fields, driving_table, driving_header, ambient_qgn, schemas)
+        result = self._plan_and_run(
+            ir, parameters, input_fields, driving_table, driving_header,
+            ambient_qgn, schemas,
+        )
+        if cache_key is not None and result.relational_plan is not None:
+            while len(self._plan_cache) >= self._PLAN_CACHE_MAX:
+                self._plan_cache.popitem(last=False)  # LRU victim
+            self._plan_cache[cache_key] = (
+                graph._graph, result.logical_plan, result.relational_plan,
+                result._returns,
+            )
+        return result
 
     def _plan_and_run(
         self, ir, parameters, input_fields, driving_table, driving_header, ambient_qgn,
