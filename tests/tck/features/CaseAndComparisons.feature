@@ -1,0 +1,97 @@
+Feature: CaseAndComparisons
+
+  Scenario: simple CASE with operand
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:S {v: 1}), (:S {v: 2}), (:S {v: 3}), (:S)
+      """
+    When executing query:
+      """
+      MATCH (s:S)
+      RETURN CASE s.v WHEN 1 THEN 'one' WHEN 2 THEN 'two' ELSE 'other' END AS w
+      """
+    Then the result should be, in any order:
+      | w       |
+      | 'one'   |
+      | 'two'   |
+      | 'other' |
+      | 'other' |
+
+  Scenario: searched CASE without ELSE yields null
+    Given an empty graph
+    When executing query:
+      """
+      RETURN CASE WHEN 1 > 2 THEN 'x' END AS r
+      """
+    Then the result should be, in any order:
+      | r    |
+      | null |
+
+  Scenario: string comparison operators
+    Given an empty graph
+    When executing query:
+      """
+      RETURN 'abc' < 'abd' AS a, 'abc' <= 'abc' AS b, 'b' > 'a' AS c, 'a' < null AS d
+      """
+    Then the result should be, in any order:
+      | a    | b    | c    | d    |
+      | true | true | true | null |
+
+  Scenario: mixed numeric comparison
+    Given an empty graph
+    When executing query:
+      """
+      RETURN 1 < 1.5 AS a, 2 >= 2.0 AS b, -0.0 < 0 AS c
+      """
+    Then the result should be, in any order:
+      | a    | b    | c     |
+      | true | true | false |
+
+  Scenario: chained boolean conditions over stored values
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:T {a: 1, b: 2}), (:T {a: 5, b: 1}), (:T {a: 3})
+      """
+    When executing query:
+      """
+      MATCH (t:T) WHERE t.a < 4 AND (t.b IS NULL OR t.b > 1) RETURN t.a AS a
+      """
+    Then the result should be, in any order:
+      | a |
+      | 1 |
+      | 3 |
+
+  Scenario: count distinct and sum distinct
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:D {v: 1}), (:D {v: 1}), (:D {v: 2}), (:D {v: null})
+      """
+    When executing query:
+      """
+      MATCH (d:D)
+      RETURN count(DISTINCT d.v) AS cd, sum(DISTINCT d.v) AS sd, collect(DISTINCT d.v) AS xs
+      """
+    Then the result should be, in any order:
+      | cd | sd | xs     |
+      | 2  | 3  | [1, 2] |
+
+  Scenario: SKIP and LIMIT from parameters
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:P {i: 1}), (:P {i: 2}), (:P {i: 3}), (:P {i: 4})
+      """
+    And parameters are:
+      | s | 1 |
+      | l | 2 |
+    When executing query:
+      """
+      MATCH (p:P) RETURN p.i AS i ORDER BY i SKIP $s LIMIT $l
+      """
+    Then the result should be, in order:
+      | i |
+      | 2 |
+      | 3 |

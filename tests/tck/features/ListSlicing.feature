@@ -1,0 +1,62 @@
+Feature: ListSlicing
+
+  Scenario: negative indexes and slices
+    Given an empty graph
+    When executing query:
+      """
+      WITH [10, 20, 30, 40] AS l
+      RETURN l[-2] AS a, l[1..3] AS b, l[..2] AS c, l[-2..] AS d
+      """
+    Then the result should be, in any order:
+      | a  | b        | c        | d        |
+      | 30 | [20, 30] | [10, 20] | [30, 40] |
+
+  Scenario: out of range access yields null or clamps
+    Given an empty graph
+    When executing query:
+      """
+      WITH [1, 2] AS l RETURN l[9] AS a, l[0..9] AS b, l[3..9] AS c
+      """
+    Then the result should be, in any order:
+      | a    | b      | c  |
+      | null | [1, 2] | [] |
+
+  Scenario: null index or bound propagates
+    Given an empty graph
+    When executing query:
+      """
+      WITH [1, 2, 3] AS l RETURN l[null] AS a, l[null..2] AS b
+      """
+    Then the result should be, in any order:
+      | a    | b    |
+      | null | null |
+
+  Scenario: list concatenation with plus
+    Given an empty graph
+    When executing query:
+      """
+      RETURN [1, 2] + [3] AS a, [] + [1] AS b
+      """
+    Then the result should be, in any order:
+      | a         | b   |
+      | [1, 2, 3] | [1] |
+
+  Scenario: range function boundaries
+    Given an empty graph
+    When executing query:
+      """
+      RETURN range(1, 3) AS a, range(3, 1) AS b, range(3, 1, -1) AS c
+      """
+    Then the result should be, in any order:
+      | a         | b  | c         |
+      | [1, 2, 3] | [] | [3, 2, 1] |
+
+  Scenario: IN over list of lists
+    Given an empty graph
+    When executing query:
+      """
+      RETURN [1, 2] IN [[1, 2], [3]] AS a, [1] IN [[1, 2]] AS b
+      """
+    Then the result should be, in any order:
+      | a    | b     |
+      | true | false |

@@ -1,0 +1,59 @@
+Feature: TypeConversions2
+
+  Scenario: toInteger edge cases
+    Given an empty graph
+    When executing query:
+      """
+      RETURN toInteger('42') AS a, toInteger('abc') AS b, toInteger('4.9') AS c,
+             toInteger(4.9) AS d, toInteger(null) AS f
+      """
+    Then the result should be, in any order:
+      | a  | b    | c | d | f    |
+      | 42 | null | 4 | 4 | null |
+
+  Scenario: toFloat edge cases
+    Given an empty graph
+    When executing query:
+      """
+      RETURN toFloat('3.5') AS a, toFloat('x') AS b, toFloat(2) AS c, toFloat(null) AS d
+      """
+    Then the result should be, in any order:
+      | a   | b    | c   | d    |
+      | 3.5 | null | 2.0 | null |
+
+  Scenario: toBoolean edge cases
+    Given an empty graph
+    When executing query:
+      """
+      RETURN toBoolean('true') AS a, toBoolean('FALSE') AS b, toBoolean('nope') AS c,
+             toBoolean(true) AS d, toBoolean(null) AS e
+      """
+    Then the result should be, in any order:
+      | a    | b     | c    | d    | e    |
+      | true | false | null | true | null |
+
+  Scenario: toString round trips
+    Given an empty graph
+    When executing query:
+      """
+      RETURN toString(1.5) AS f, toString(-3) AS i, toString(false) AS b
+      """
+    Then the result should be, in any order:
+      | f     | i    | b       |
+      | '1.5' | '-3' | 'false' |
+
+  Scenario: conversions applied to stored properties
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:C {s: '7'}), (:C {s: 'oops'}), (:C {s: null})
+      """
+    When executing query:
+      """
+      MATCH (c:C) RETURN toInteger(c.s) AS v
+      """
+    Then the result should be, in any order:
+      | v    |
+      | 7    |
+      | null |
+      | null |

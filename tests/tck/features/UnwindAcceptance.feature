@@ -1,0 +1,89 @@
+Feature: UnwindAcceptance
+
+  Scenario: unwind a literal list
+    Given an empty graph
+    When executing query:
+      """
+      UNWIND [1, 2, 3] AS x RETURN x
+      """
+    Then the result should be, in any order:
+      | x |
+      | 1 |
+      | 2 |
+      | 3 |
+
+  Scenario: unwind null and empty produce no rows
+    Given an empty graph
+    When executing query:
+      """
+      UNWIND [] AS x RETURN x
+      """
+    Then the result should be, in any order:
+      | x |
+
+  Scenario: unwind a range with step
+    Given an empty graph
+    When executing query:
+      """
+      UNWIND range(0, 10, 5) AS x RETURN x
+      """
+    Then the result should be, in any order:
+      | x  |
+      | 0  |
+      | 5  |
+      | 10 |
+
+  Scenario: nested unwind builds a cross product
+    Given an empty graph
+    When executing query:
+      """
+      UNWIND [1, 2] AS x UNWIND ['a', 'b'] AS y RETURN x, y
+      """
+    Then the result should be, in any order:
+      | x | y   |
+      | 1 | 'a' |
+      | 1 | 'b' |
+      | 2 | 'a' |
+      | 2 | 'b' |
+
+  Scenario: unwind of a nested list yields the inner lists
+    Given an empty graph
+    When executing query:
+      """
+      UNWIND [[1, 2], [3]] AS l RETURN l, size(l) AS s
+      """
+    Then the result should be, in any order:
+      | l      | s |
+      | [1, 2] | 2 |
+      | [3]    | 1 |
+
+  Scenario: unwind collected values after aggregation
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:U {v: 2}), (:U {v: 1}), (:U {v: 2})
+      """
+    When executing query:
+      """
+      MATCH (u:U) WITH collect(DISTINCT u.v) AS vs
+      UNWIND vs AS v RETURN v
+      """
+    Then the result should be, in any order:
+      | v |
+      | 2 |
+      | 1 |
+
+  Scenario: unwind feeding a match
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:W {k: 1, n: 'one'}), (:W {k: 2, n: 'two'}), (:W {k: 3, n: 'three'})
+      """
+    When executing query:
+      """
+      UNWIND [1, 3] AS want MATCH (w:W {k: want}) RETURN w.n AS n
+      """
+    Then the result should be, in any order:
+      | n       |
+      | 'one'   |
+      | 'three' |

@@ -396,12 +396,14 @@ def test_plan_cache_reuses_plans_and_rebinds_params():
     assert [dict(r) for r in r1.records.collect()] == [{"c": 1}]
     r2 = session_graph.cypher(q, parameters={"p": 10})
     assert [dict(r) for r in r2.records.collect()] == [{"c": 3}]
-    # the cache holds r1's plan; r2 executed a per-call CLONE of it
+    # the cache holds a TABLE-FREE clone; every execution (including the
+    # first) keeps its own plan instance
     entry = next(
         v for k, v in sess._plan_cache.items() if k[0] == q and k[2] == (("p", "int"),)
     )
-    assert entry[2] is r1.relational_plan
+    assert entry[2] is not r1.relational_plan
     assert r2.relational_plan is not r1.relational_plan
+    assert entry[2]._table is None, "cached plan pinned a materialized table"
     # param TYPE change produces a separate entry (no wrongly-typed replay)
     r3 = session_graph.cypher(q, parameters={"p": 2.5})
     assert [dict(r) for r in r3.records.collect()] == [{"c": 2}]

@@ -598,8 +598,12 @@ class CypherSession:
         if cache_key is not None and result.relational_plan is not None:
             while len(self._plan_cache) >= self._PLAN_CACHE_MAX:
                 self._plan_cache.popitem(last=False)  # LRU victim
+            # store a TABLE-FREE clone: the first caller's live plan will
+            # memoize materialized (device-resident) tables as it executes,
+            # and the cache must not pin those for the session lifetime
             self._plan_cache[cache_key] = (
-                graph._graph, result.logical_plan, result.relational_plan,
+                graph._graph, result.logical_plan,
+                self._clone_plan(result.relational_plan, {}),
                 result._returns,
             )
         return result
