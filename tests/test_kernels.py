@@ -381,6 +381,58 @@ def test_order_by_limit_topk_matches_oracle(monkeypatch):
     assert calls["n"] >= len(fused), "ORDER BY LIMIT bypassed the top-k path"
 
 
+def test_fused_optional_expand_matches_oracle(monkeypatch):
+    """OPTIONAL MATCH of a single unlabeled directed expand runs the fused
+    left-outer CSR program; results differential-equal to the oracle,
+    including all-unmatched, duplicated frontiers, and null propagation."""
+    import numpy as np
+
+    from tpu_cypher import CypherSession
+    from tpu_cypher.backend.tpu import jit_ops
+
+    calls = {"n": 0}
+    orig = jit_ops.optional_expand_materialize
+
+    def spy(*a, **kw):
+        calls["n"] += 1
+        return orig(*a, **kw)
+
+    monkeypatch.setattr(jit_ops, "optional_expand_materialize", spy)
+
+    rng = np.random.default_rng(17)
+    n, e = 25, 50
+    src = rng.integers(0, n, e)
+    dst = rng.integers(0, n, e)
+    parts = [f"(n{i}:V {{i:{i}}})" for i in range(n)]
+    parts += [f"(n{s})-[:E {{w:{int(w)}}}]->(n{d})" for s, d, w in
+              zip(src, dst, rng.integers(0, 5, e))]
+    create = "CREATE " + ", ".join(parts)
+
+    fused = [
+        "MATCH (x:V) OPTIONAL MATCH (x)-[r:E]->(y) RETURN x.i, y.i, r.w ORDER BY x.i, y.i, r.w",
+        "MATCH (x:V) OPTIONAL MATCH (x)-[r:E]->(y) RETURN count(*) AS rows, count(y) AS m, sum(r.w) AS s",
+        "MATCH (x:V) OPTIONAL MATCH (x)-[:E]->(y) RETURN x.i, count(y) AS c ORDER BY x.i",
+        # backward: bound var is the edge TARGET
+        "MATCH (x:V) OPTIONAL MATCH (y)-[r:E]->(x) RETURN x.i, y.i, r.w ORDER BY x.i, y.i, r.w",
+        # zero relationships of the requested type: all rows null-padded
+        "MATCH (x:V) OPTIONAL MATCH (x)-[r:NOPE]->(y) RETURN x.i, r.w, y.i ORDER BY x.i",
+    ]
+    classic = [
+        # WHERE (on the base match or inside OPTIONAL), far labels, and
+        # undirected patterns keep the classic outer join
+        "MATCH (x:V) WHERE x.i > 20 OPTIONAL MATCH (x)-[:E]->(y) RETURN x.i, count(y) AS c ORDER BY x.i",
+        "MATCH (x:V) OPTIONAL MATCH (x)-[r:E]->(y) WHERE y.i > 10 RETURN count(y) AS c",
+        "MATCH (x:V) OPTIONAL MATCH (x)-[:E]-(y) RETURN count(y) AS c",
+    ]
+    gl = CypherSession.local().create_graph_from_create_query(create)
+    gt = CypherSession.tpu().create_graph_from_create_query(create)
+    for q in fused + classic:
+        want = gl.cypher(q).records.to_bag()
+        got = gt.cypher(q).records.to_bag()
+        assert got == want, f"{q}: {got} != {want}"
+    assert calls["n"] >= len(fused), "optional expands bypassed the fused path"
+
+
 def test_plan_cache_reuses_plans_and_rebinds_params():
     """Repeated query text on the same graph reuses the planned operator
     tree (no re-parse/re-plan); parameter VALUES rebind per execution, and

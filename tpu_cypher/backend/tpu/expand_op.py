@@ -75,28 +75,43 @@ class _FusedExpandBase(RelationalOperator):
     # -- column assembly ---------------------------------------------------
 
     def _gather_plan(
-        self, plan: Dict[str, Tuple[Column, str]], idx_by_tag: Dict[str, Any]
+        self,
+        plan: Dict[str, Tuple[Column, str]],
+        idx_by_tag: Dict[str, Any],
+        null_mask_by_tag: Optional[Dict[str, Any]] = None,
     ) -> Dict[str, Column]:
         """Execute a tagged gather plan: ONE jitted dispatch per index
-        source for all device columns, host path for OBJ columns."""
+        source for all device columns, host path for OBJ columns. A tag
+        with an entry in ``null_mask_by_tag`` gathers outer-join style:
+        rows where the mask is False come out null. Empty source columns
+        (zero-row scans) take the per-column path, whose empty-source
+        branch emits all-null rows instead of a non-empty take from an
+        empty axis."""
+        masks = null_mask_by_tag or {}
         out: Dict[str, Column] = {}
         for tag, idx in idx_by_tag.items():
             group = {c: s for c, (s, t) in plan.items() if t == tag}
             if not group:
                 continue
+            mask = masks.get(tag)
             dev = {
                 c: (s.data, s.valid, s.int_flag)
                 for c, s in group.items()
-                if s.kind != OBJ
+                if s.kind != OBJ and not (mask is not None and len(s) == 0)
             }
             if dev:
-                taken = J.cols_take(dev, idx)
+                taken = (
+                    J.cols_take(dev, idx)
+                    if mask is None
+                    else J.cols_take_or_null(dev, idx, mask)
+                )
                 for c, (d, v, i) in taken.items():
                     s = group[c]
                     out[c] = Column(s.kind, d, v, s.vocab, int_flag=i)
             for c, s in group.items():
-                if s.kind == OBJ:
-                    out[c] = s.take(idx)
+                if c in out:
+                    continue
+                out[c] = s.take(idx) if mask is None else s.take_or_null(idx, mask)
         return out
 
     def _assemble(
@@ -476,6 +491,95 @@ class CsrExpandIntoOp(_FusedExpandBase):
         )
 
 
+class CsrOptionalExpandOp(_FusedExpandBase):
+    """Fused OPTIONAL MATCH (frontier)-[rel]->(far): the reference plans
+    Optional as a left outer join of the optional subtree
+    (``RelationalPlanner.scala:298``); here matched frontier rows emit
+    their expansions and unmatched rows emit ONE null-padded row, in a
+    single sized CSR program. Unlabeled directed single-hop patterns only
+    (labels/undirected/WHERE keep the classic outer-join shadow)."""
+
+    def __init__(
+        self,
+        in_plan: RelationalOperator,
+        classic: RelationalOperator,
+        graph_obj,
+        *,
+        frontier_fld: str,
+        rel_fld: str,
+        far_fld: str,
+        types_key: Tuple[str, ...],
+        backwards: bool,
+    ):
+        super().__init__(in_plan, classic, graph_obj)
+        self.frontier_fld = frontier_fld
+        self.rel_fld = rel_fld
+        self.far_fld = far_fld
+        self.types_key = types_key
+        self.backwards = backwards
+
+    def _show_inner(self) -> str:
+        arrow = "<-" if self.backwards else "->"
+        t = "|".join(self.types_key) or "*"
+        return f"optional ({self.frontier_fld}){arrow}[{self.rel_fld}:{t}]({self.far_fld})"
+
+    def _fused_table(self):
+        from .table import TpuTable
+
+        gi = GraphIndex.of(self.graph)
+        ctx = self.context
+        in_op = self.children[0]
+        in_t = in_op.table
+        frontier_var = in_op.header.var(self.frontier_fld)
+        id_col = in_t._cols[in_op.header.column(in_op.header.id_expr(frontier_var))]
+        gi.node_ids(ctx)
+        if gi.num_nodes == 0:
+            raise GraphIndexError("empty graph: classic outer join handles")
+        pos, present = gi.compact_of(id_col, ctx)
+        rp, ci, eo = gi.csr(self.types_key, self.backwards, ctx)
+        deg, counts, t_dev = J.optional_expand_degrees(rp, pos, present)
+        total = int(t_dev)
+        row, nbr, orig, matched = J.optional_expand_materialize(
+            rp, ci, eo, pos, deg, counts, total=total
+        )
+        _, _, row_map = gi.node_scan((), ctx)
+        far_rows, _ = J.far_lookup(row_map, nbr)
+        # assembly: input pass-throughs by row; rel/far columns null-masked
+        # where unmatched
+        rel_cols, rel_header = gi.rel_scan(self.types_key, ctx)
+        node_cols, node_header, _ = gi.node_scan((), ctx)
+        canon_rel = E.Var(CANON_REL)
+        canon_node = E.Var(CANON_NODE)
+        plan: Dict[str, Tuple[Column, str]] = {}
+        for e in self.header.expressions:
+            col = self.header.column(e)
+            if col in plan:
+                continue
+            if e in in_op.header:
+                plan[col] = (in_t._cols[in_op.header.column(e)], "row")
+                continue
+            owner = _owner_name(e)
+            if owner == self.rel_fld:
+                key = rekey_element_expr(e, canon_rel)
+                if key is None or key not in rel_header:
+                    raise GraphIndexError(f"unmapped rel expr {e!r}")
+                plan[col] = (rel_cols[rel_header.column(key)], "orig")
+                continue
+            if owner == self.far_fld:
+                key = rekey_element_expr(e, canon_node)
+                if key is None or key not in node_header:
+                    raise GraphIndexError(f"unmapped node expr {e!r}")
+                plan[col] = (node_cols[node_header.column(key)], "far")
+                continue
+            raise GraphIndexError(f"unmapped optional-expand expr {e!r}")
+        out = self._gather_plan(
+            plan,
+            {"row": row, "orig": orig, "far": far_rows},
+            null_mask_by_tag={"orig": matched, "far": matched},
+        )
+        return TpuTable(out, total)
+
+
 class CsrVarExpandOp(_FusedExpandBase):
     """Fused bounded var-length expand: the frontier-loop replacement for
     the unrolled join cascade (reference ``VarLengthExpandPlanner.scala:45-330``,
@@ -644,6 +748,64 @@ def plan_expand_fastpath(planner, op, lhs, rhs, classic) -> Optional[RelationalO
         undirected=op.direction == "-",
         backwards=backwards,
         far_labels=far_labels,
+    )
+
+
+def plan_optional_expand_fastpath(planner, op, lhs, rhs_planned, classic) -> Optional[RelationalOperator]:
+    """Swap Optional(single unlabeled directed Expand) for the fused
+    left-outer expand; None keeps the classic outer join. The optional
+    subtree must be exactly Expand(NodeScan, NodeScan) — any Filter (WHERE
+    inside OPTIONAL), labels, or undirected step keeps the general plan."""
+    from ...logical import ops as L
+
+    e = op.rhs
+    if not isinstance(e, L.Expand) or e.direction != ">":
+        return None
+    if not isinstance(e.lhs, L.NodeScan) or not isinstance(e.rhs, L.NodeScan):
+        return None
+    lhs_vars = {v.name for v in lhs.header.vars}
+    bound = {e.source, e.rel, e.target} & lhs_vars
+    if e.rel in bound:
+        return None
+    if bound == {e.source}:
+        frontier, far, backwards = e.source, e.target, False
+    elif bound == {e.target}:
+        frontier, far, backwards = e.target, e.source, True
+    else:
+        return None
+    # the logical planner always puts the BOUND side at Expand.lhs and the
+    # newly scanned far side at Expand.rhs, regardless of direction
+    frontier_scan, far_scan = e.lhs, e.rhs
+    # far-side labels change which rows match (keep the classic join);
+    # frontier labels are fine only when the bound variable's TYPE already
+    # guarantees them (the planner stamps the binding's labels onto the
+    # optional scan — semantically redundant there)
+    if getattr(far_scan.node_type.material, "labels", None):
+        return None
+    scan_labels = frozenset(
+        getattr(frontier_scan.node_type.material, "labels", None) or ()
+    )
+    if scan_labels:
+        try:
+            bt = lhs.header.var(frontier).cypher_type.material
+            bound_labels = frozenset(getattr(bt, "labels", None) or ())
+        except Exception:
+            return None
+        if not scan_labels <= bound_labels:
+            return None
+    types = getattr(e.rel_type.material, "types", frozenset()) or frozenset()
+    graph_obj = getattr(rhs_planned, "graph", None)
+    if graph_obj is None:
+        return None
+    return CsrOptionalExpandOp(
+        lhs,
+        classic,
+        graph_obj,
+        frontier_fld=frontier,
+        rel_fld=e.rel,
+        far_fld=far,
+        types_key=GraphIndex.types_key(types),
+        backwards=backwards,
     )
 
 

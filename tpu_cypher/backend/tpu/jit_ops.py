@@ -213,6 +213,32 @@ def drop_loops_mask(nbr, pos, row):
 
 
 @jax.jit
+def optional_expand_degrees(rp, pos, present):
+    """Row counts for a LEFT-OUTER expand: matched rows emit their degree,
+    unmatched (or absent-frontier) rows emit exactly ONE null-padded row."""
+    deg = (jnp.take(rp, pos + 1) - jnp.take(rp, pos)).astype(jnp.int64)
+    deg = jnp.where(present, deg, 0)
+    counts = jnp.maximum(deg, 1)
+    return deg, counts, jnp.sum(counts)
+
+
+@partial(jax.jit, static_argnames=("total",))
+def optional_expand_materialize(rp, ci, eo, pos, deg, counts, total: int):
+    """(row, nbr, orig, matched) for a left-outer expand half: pad rows
+    carry matched=False and clipped (masked-out downstream) gather
+    indices — the fused form of the reference's Optional -> left outer
+    join (``RelationalPlanner.scala:298``)."""
+    row, flat = _expand_rows(jnp.take(rp, pos), counts, total)
+    starts = jnp.take(rp, pos).astype(jnp.int64)
+    matched = (flat - jnp.take(starts, row)) < jnp.take(deg, row)
+    nedges = ci.shape[0]
+    safe = jnp.clip(flat, 0, max(nedges - 1, 0))
+    nbr = jnp.take(ci, safe).astype(jnp.int64) if nedges else jnp.zeros(total, jnp.int64)
+    orig = jnp.take(eo, safe) if nedges else jnp.zeros(total, jnp.int64)
+    return row, nbr, orig, matched
+
+
+@jax.jit
 def far_lookup(row_map, nbr):
     far_rows = jnp.take(row_map, nbr)
     return far_rows, far_rows >= 0

@@ -864,6 +864,12 @@ class TpuTable(Table):
 
         return plan_var_expand_fastpath(planner, op, lhs, rhs, classic)
 
+    @staticmethod
+    def plan_optional_expand_fastpath(planner, op, lhs, rhs, classic):
+        from .expand_op import plan_optional_expand_fastpath
+
+        return plan_optional_expand_fastpath(planner, op, lhs, rhs, classic)
+
 
 def _float_as_exact_int(c: Column) -> Column:
     """An F64 key column recast for EXACT equality against int64 keys:

@@ -230,9 +230,18 @@ class RelationalPlanner:
         return pairs
 
     def _plan_Optional(self, op: L.Optional) -> RelationalOperator:
+        """Reference ``RelationalPlanner.scala:298``: Optional = left outer
+        join — or the fused left-outer CSR expand when the backend offers
+        one (classic join kept as the same-header shadow plan)."""
         lhs, rhs = self.process(op.lhs), self.process(op.rhs)
         pairs = self._common_join_pairs(lhs, rhs)
-        return JoinOp(lhs, rhs, pairs, "left_outer")
+        classic = JoinOp(lhs, rhs, pairs, "left_outer")
+        fast = getattr(self.ctx.table_cls, "plan_optional_expand_fastpath", None)
+        if fast is not None:
+            out = fast(self, op, lhs, rhs, classic)
+            if out is not None:
+                return out
+        return classic
 
     def _plan_ExistsSubQuery(self, op: L.ExistsSubQuery) -> RelationalOperator:
         lhs, rhs = self.process(op.lhs), self.process(op.rhs)

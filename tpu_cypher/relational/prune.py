@@ -76,7 +76,12 @@ def _mention_tree(m: Set[E.Expr], e: E.Expr, h) -> None:
 
 def _local_mentions(op: O.RelationalOperator) -> Set[E.Expr]:
     """What this operator itself reads from its children's tables."""
-    from ..backend.tpu.expand_op import CsrExpandIntoOp, CsrExpandOp, CsrVarExpandOp
+    from ..backend.tpu.expand_op import (
+        CsrExpandIntoOp,
+        CsrExpandOp,
+        CsrOptionalExpandOp,
+        CsrVarExpandOp,
+    )
 
     m: Set[E.Expr] = set()
     if isinstance(op, O.FilterOp):
@@ -134,7 +139,7 @@ def _local_mentions(op: O.RelationalOperator) -> Set[E.Expr]:
         m.update(op.children[1].header.expressions)
     elif isinstance(op, O.SwapStartEndOp):
         _mention_var_exprs(m, op.children[0].header, op.rel_var.name)
-    elif isinstance(op, CsrExpandOp):
+    elif isinstance(op, (CsrExpandOp, CsrOptionalExpandOp)):
         h = op.children[0].header
         try:
             m.add(h.id_expr(h.var(op.frontier_fld)))
