@@ -45,6 +45,20 @@ class Table(ABC):
         """Iterate rows as {column: python value} (null = None)."""
         ...
 
+    @classmethod
+    def from_arrays(cls, cols: Dict[str, Any]) -> "Table":
+        """Bulk construction from mixed numpy arrays / value lists (the
+        IO/bench ingestion SPI). Default decodes arrays to value lists and
+        delegates to ``from_columns``; backends override with a zero-decode
+        fast path (``TpuTable.from_arrays`` -> one H2D copy per numeric
+        column)."""
+        return cls.from_columns(
+            {
+                c: (v.tolist() if hasattr(v, "tolist") else list(v))
+                for c, v in cols.items()
+            }
+        )
+
     def column_values(self, col: str) -> List[Any]:
         """One column as host Python values (null = None). Backends override
         with a columnar read; the default goes through ``rows``."""

@@ -104,6 +104,21 @@ class TpuTable(Table):
         """Bulk construction from numpy arrays (one H2D copy per column)."""
         return TpuTable({c: Column.from_numpy(v) for c, v in cols.items()})
 
+    @classmethod
+    def from_arrays(cls, cols: Dict[str, Any]) -> "TpuTable":
+        """Mixed construction: numeric/bool numpy arrays take the bulk H2D
+        path, anything else (value lists, string/object arrays) decodes per
+        value — the ingestion SPI the LDBC loader uses at SF10 scale."""
+        out: Dict[str, Column] = {}
+        for c, v in cols.items():
+            if isinstance(v, np.ndarray) and (
+                np.issubdtype(v.dtype, np.number) or v.dtype == np.bool_
+            ):
+                out[c] = Column.from_numpy(v)
+            else:
+                out[c] = Column.from_values(list(v))
+        return TpuTable(out)
+
     @staticmethod
     def empty(columns: Sequence[str] = ()) -> "TpuTable":
         return TpuTable(

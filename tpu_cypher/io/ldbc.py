@@ -119,15 +119,26 @@ def generate_snb(
     dst_i = rng.integers(0, num_people, size=num_knows)
     keep = src_i != dst_i
     src, dst = ids[src_i[keep]], ids[dst_i[keep]]
+    # birthday: days-since-epoch ints (IS3-style property filters); numpy so
+    # the bulk ingestion path stays one H2D copy per column at SF10 scale
     person_cols: Dict[str, List] = {
-        "id": ids.tolist(),
-        "firstname": [f"p{i}" for i in range(num_people)],
+        "id": ids,
+        "birthday": rng.integers(0, 18_000, size=num_people, dtype=np.int64),
     }
+    # expose the id column as a property too (LDBC queries anchor on
+    # ``a.id`` ranges; the bench's var-length source filter does the same)
+    prop_types: Dict[str, T.CypherType] = {
+        "id": T.CTInteger.nullable,
+        "birthday": T.CTInteger.nullable,
+    }
+    if num_people <= 200_000:  # string props only at list-walkable sizes
+        person_cols["firstname"] = [f"p{i}" for i in range(num_people)]
+        prop_types["firstname"] = T.CTString.nullable
     return _graph_from_arrays(
         session,
         ids,
         person_cols,
-        {"firstname": T.CTString.nullable},
+        prop_types,
         src,
         dst,
         undirected_knows=False,
@@ -158,13 +169,9 @@ def _graph_from_arrays(
     if len(ids) and int(ids.max(initial=0)) >= EDGE_ID_OFFSET:
         raise DataSourceError("LDBC ids exceed the supported id range")
 
-    node_table = session.table_cls.from_columns(person_cols)
-    rel_table = session.table_cls.from_columns(
-        {
-            "id": edge_ids.tolist(),
-            "source": src.tolist(),
-            "target": dst.tolist(),
-        }
+    node_table = session.table_cls.from_arrays(person_cols)
+    rel_table = session.table_cls.from_arrays(
+        {"id": edge_ids, "source": src, "target": dst}
     )
     schema = (
         PropertyGraphSchema.empty()
