@@ -25,14 +25,21 @@ import jax.numpy as jnp
 
 from ...api import types as T
 from ...api.types import CypherType
-from ...parallel.mesh import shard_rows
+from ...parallel.mesh import padded_to_mesh
 
 # column kinds
 I64 = "i64"
 F64 = "f64"
 BOOL = "bool"
 STR = "str"  # dictionary-encoded int32 codes
+DATE = "date"  # int32 days since 1970-01-01 (ref TemporalUdfs.scala:40-160)
+LDT = "ldt"  # int64 microseconds since 1970-01-01T00:00 (local, no zone)
 OBJ = "obj"  # host-side Python objects (lists, elements) — not device resident
+
+# temporal kinds share the integer device machinery (sort keys, joins,
+# distinct/group packing, min/max) — they differ only in decode + typing
+TEMPORAL_KINDS = (DATE, LDT)
+INTEGRAL_KINDS = (I64, BOOL, STR, DATE, LDT)
 
 _NULL_CODE = np.int32(-1)
 
@@ -73,10 +80,22 @@ class Column:
     # guards don't sync repeatedly
     _beyond_f64: Optional[bool] = None
     # host mirrors of ``data``/``valid`` when the column was BUILT from
-    # host data (``from_numpy``): decoding such a column costs zero device
-    # round trips (a D2H fetch is ~73ms over a tunneled TPU per array)
+    # host data (``from_numpy``/``from_values``): decoding such a column
+    # costs zero device round trips (a D2H fetch is ~73ms over a tunneled
+    # TPU per array). Mirrors hold the LOGICAL rows only (no padding).
     _np_cache: Optional[np.ndarray] = None
     _np_valid: Optional[np.ndarray] = None
+    # sharding padding (``parallel.mesh.padded_to_mesh``): the trailing
+    # ``pad`` device rows are phantom rows added so the array shards evenly
+    # over the active mesh. They are ALWAYS marked invalid in ``valid``, so
+    # the fused expand/count paths (which gate on the id column's validity,
+    # ``jit_ops.compact_lookup``) skip them with no extra machinery; eager
+    # relational ops slice them off first (``TpuTable._depad``).
+    pad: int = 0
+    # True when ``valid`` exists ONLY for the padding (the logical column
+    # has no nulls) — type metadata stays non-nullable and depad restores
+    # ``valid=None``.
+    pad_synth: bool = False
 
     def ints_beyond_f64(self) -> bool:
         """True when a VALID int64 payload exceeds f64 exactness (2**53)."""
@@ -90,7 +109,46 @@ class Column:
     def __len__(self) -> int:
         return int(self.data.shape[0]) if self.kind != OBJ else len(self.data)
 
+    @property
+    def logical_len(self) -> int:
+        """Row count excluding sharding pad rows."""
+        return len(self) - self.pad
+
+    def depad(self) -> "Column":
+        """Slice off the sharding pad rows (and drop a synthesized-only
+        validity mask). The result is a plain unpadded column; host mirrors
+        carry over (they never include padding)."""
+        if self.pad == 0:
+            return self
+        n = self.logical_len
+        valid = None if self.pad_synth else (
+            self.valid[:n] if self.valid is not None else None
+        )
+        return Column(
+            self.kind,
+            self.data[:n],
+            valid,
+            self.vocab,
+            int_flag=self.int_flag[:n] if self.int_flag is not None else None,
+            _np_cache=self._np_cache,
+            _np_valid=self._np_valid,
+        )
+
     # -- conversion --------------------------------------------------------
+
+    @staticmethod
+    def _ingest(data_np: np.ndarray, valid_np: Optional[np.ndarray], fill):
+        """Host arrays -> (device data, device valid, pad, pad_synth) with
+        mesh-sharding padding: pad rows are ALWAYS invalid (the valid mask
+        is synthesized when the logical column has none)."""
+        data, pad = padded_to_mesh(data_np, fill)
+        if valid_np is not None:
+            v, _ = padded_to_mesh(valid_np, False)
+            return data, v, pad, False
+        if pad:
+            v, _ = padded_to_mesh(np.ones(len(data_np), bool), False)
+            return data, v, pad, True
+        return data, None, pad, False
 
     @staticmethod
     def from_values(values: Sequence[Any]) -> "Column":
@@ -99,18 +157,37 @@ class Column:
         n = len(values)
         valid_np = np.array([v is not None for v in values], dtype=bool)
         has_null = not valid_np.all()
-        dev = lambda a: shard_rows(jnp.asarray(a))
+        hv = valid_np if has_null else None
+
+        def build(kind, data_np, fill, vocab=None, iflag_np=None):
+            data, v, pad, ps = Column._ingest(data_np, hv, fill)
+            iflag = None
+            if iflag_np is not None and iflag_np.any():
+                iflag = padded_to_mesh(iflag_np, False)[0]
+            return Column(
+                kind, data, v, vocab, int_flag=iflag,
+                _np_cache=data_np, _np_valid=hv, pad=pad, pad_synth=ps,
+            )
+
         if not non_null:
-            return Column(I64, dev(np.zeros(n, np.int64)), dev(np.zeros(n, bool)))
+            data, v, pad, _ = Column._ingest(
+                np.zeros(n, np.int64), valid_np, 0
+            )
+            return Column(
+                I64, data, v, _np_cache=np.zeros(n, np.int64),
+                _np_valid=valid_np, pad=pad,
+            )
         _BOOLK = (bool, np.bool_)
         _INTK = (int, np.integer)
         _NUMK = (int, float, np.integer, np.floating)
         if all(isinstance(v, _BOOLK) for v in non_null):
             data = np.array([bool(v) if v is not None else False for v in values])
-            return Column(BOOL, dev(data), dev(valid_np) if has_null else None)
+            return build(BOOL, data, False)
         if all(isinstance(v, _INTK) and not isinstance(v, _BOOLK) for v in non_null):
-            data = np.array([int(v) if v is not None else 0 for v in values], dtype=np.int64)
-            return Column(I64, dev(data), dev(valid_np) if has_null else None)
+            data = np.array(
+                [int(v) if v is not None else 0 for v in values], dtype=np.int64
+            )
+            return build(I64, data, 0)
         if all(isinstance(v, _NUMK) and not isinstance(v, _BOOLK) for v in non_null):
             ints = [
                 v
@@ -129,12 +206,7 @@ class Column:
                 [isinstance(v, _INTK) and not isinstance(v, _BOOLK) for v in values],
                 dtype=bool,
             )
-            return Column(
-                F64,
-                dev(data),
-                dev(valid_np) if has_null else None,
-                int_flag=dev(iflag) if iflag.any() else None,
-            )
+            return build(F64, data, 0.0, iflag_np=iflag)
         if all(isinstance(v, str) for v in non_null):
             vocab = sorted(set(non_null))
             index = {s: i for i, s in enumerate(vocab)}
@@ -142,12 +214,31 @@ class Column:
                 [index[v] if v is not None else _NULL_CODE for v in values],
                 dtype=np.int32,
             )
-            return Column(
-                STR,
-                dev(codes),
-                dev(valid_np) if has_null else None,
-                vocab,
+            return build(STR, codes, _NULL_CODE, vocab=vocab)
+        import datetime as _dt
+
+        from .temporal import encode_date, encode_ldt
+
+        # naive local datetimes -> int64 micros; pure dates -> int32 days
+        # (datetime IS a date subclass — check it first; zoned datetimes and
+        # mixed date/datetime columns stay host-exact OBJ)
+        if all(
+            isinstance(v, _dt.datetime) and v.tzinfo is None for v in non_null
+        ):
+            data = np.array(
+                [encode_ldt(v) if v is not None else 0 for v in values],
+                dtype=np.int64,
             )
+            return build(LDT, data, 0)
+        if all(
+            isinstance(v, _dt.date) and not isinstance(v, _dt.datetime)
+            for v in non_null
+        ):
+            data = np.array(
+                [encode_date(v) if v is not None else 0 for v in values],
+                dtype=np.int32,
+            )
+            return build(DATE, data, 0)
         # fallback: host objects
         return Column(OBJ, _obj_array(values), None)
 
@@ -158,21 +249,24 @@ class Column:
         work; this is one H2D transfer)."""
         arr = np.asarray(arr)
         hv = np.asarray(valid, dtype=bool).copy() if valid is not None else None
-        v = shard_rows(jnp.asarray(hv)) if hv is not None else None
         if arr.dtype == np.bool_:
             host = arr.copy()
             kind = BOOL
+            fill = False
         elif np.issubdtype(arr.dtype, np.integer):
             host = arr.astype(np.int64, copy=True)
             kind = I64
+            fill = 0
         elif np.issubdtype(arr.dtype, np.floating):
             host = arr.astype(np.float64, copy=True)
             kind = F64
+            fill = 0.0
         else:
             raise TpuBackendError(f"from_numpy: unsupported dtype {arr.dtype}")
+        data, v, pad, ps = Column._ingest(host, hv, fill)
         return Column(
-            kind, shard_rows(jnp.asarray(host)), v,
-            _np_cache=host, _np_valid=hv,
+            kind, data, v,
+            _np_cache=host, _np_valid=hv, pad=pad, pad_synth=ps,
         )
 
     def to_values(self, row_mask: Optional[np.ndarray] = None) -> List[Any]:
@@ -215,6 +309,20 @@ class Column:
                     (vocab[v] if v >= 0 else None)
                     if (valid is None or valid[i])
                     else None
+                    for i, v in enumerate(data)
+                ]
+            elif self.kind == DATE:
+                from .temporal import decode_date
+
+                vals = [
+                    decode_date(v) if (valid is None or valid[i]) else None
+                    for i, v in enumerate(data)
+                ]
+            elif self.kind == LDT:
+                from .temporal import decode_ldt
+
+                vals = [
+                    decode_ldt(v) if (valid is None or valid[i]) else None
                     for i, v in enumerate(data)
                 ]
             else:  # pragma: no cover
@@ -385,11 +493,17 @@ class Column:
             F64: T.CTFloat,
             BOOL: T.CTBoolean,
             STR: T.CTString,
+            DATE: T.CTDate,
+            LDT: T.CTLocalDateTime,
             OBJ: T.CTAny,
         }[self.kind]
         if self.kind == F64 and self.int_flag is not None:
             base = T.join_types([T.CTInteger, T.CTFloat])
-        has_null = self.valid is not None or self.kind == OBJ
+        # a validity mask synthesized only for sharding padding does not
+        # make the column nullable
+        has_null = (
+            self.valid is not None and not self.pad_synth
+        ) or self.kind == OBJ
         return base.nullable if has_null else base
 
 
@@ -421,6 +535,8 @@ def mask_to_idx(mask) -> Tuple[Any, int]:
 
 
 def constant_column(value: Any, n: int) -> Column:
+    import datetime as _dt
+
     if value is None:
         return Column(I64, jnp.zeros(n, jnp.int64), jnp.zeros(n, bool))
     if isinstance(value, bool):
@@ -431,4 +547,14 @@ def constant_column(value: Any, n: int) -> Column:
         return Column(F64, jnp.full(n, value, dtype=jnp.float64), None)
     if isinstance(value, str):
         return Column(STR, jnp.zeros(n, jnp.int32), None, [value])
+    if isinstance(value, _dt.datetime):
+        if value.tzinfo is None:
+            from .temporal import encode_ldt
+
+            return Column(LDT, jnp.full(n, encode_ldt(value), jnp.int64), None)
+        return Column(OBJ, _obj_array([value] * n), None)
+    if isinstance(value, _dt.date):
+        from .temporal import encode_date
+
+        return Column(DATE, jnp.full(n, encode_date(value), jnp.int32), None)
     return Column(OBJ, _obj_array([value] * n), None)

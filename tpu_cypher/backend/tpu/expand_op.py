@@ -27,6 +27,7 @@ from typing import Any, Dict, List, Optional, Tuple
 import jax.numpy as jnp
 
 from ...ir import expr as E
+from ...parallel.mesh import current_mesh, mesh_size
 from ...relational.header import RecordHeader
 from ...relational.ops import RelationalOperator
 from . import jit_ops as J
@@ -41,6 +42,34 @@ def _owner_name(e: E.Expr) -> Optional[str]:
     if isinstance(inner, E.Var):
         return inner.name
     return None
+
+
+def _fused_chain_walk(gi: GraphIndex, ctx, hops, id_col: Column, final):
+    """Walk a stacked expand chain carrying only (base endpoint key, current
+    position, liveness) per partial path — the shared spine of the fused
+    DISTINCT-endpoints count and the fused ExpandInto close count. Middle
+    hops run ``distinct_hop_materialize``; at the OUTERMOST hop (``hops[0]``)
+    ``final(rp, ci, pos, deg, akey, mask, total)`` fuses the terminal
+    computation. Returns final's int, or 0 when any hop empties."""
+    gi.node_ids(ctx)
+    if gi.num_nodes == 0:
+        return 0
+    pos, present = gi.compact_of(id_col, ctx)
+    akey = pos  # base endpoint key = its compact position
+    last = hops[0]
+    for hop in reversed(hops):
+        rp, ci, _ = gi.csr(hop.types_key, hop.backwards, ctx)
+        mask = gi.label_mask(hop.far_labels, ctx)
+        deg, t_dev = J.expand_degrees_total(rp, pos, present)
+        total = int(t_dev)
+        if total == 0:
+            return 0
+        if hop is last:
+            return final(rp, ci, pos, deg, akey, mask, total)
+        akey, pos, present = J.distinct_hop_materialize(
+            rp, ci, pos, deg, akey, mask, total=total
+        )
+    raise AssertionError("unreachable: loop always hits hops[0]")
 
 
 class _FusedExpandBase(RelationalOperator):
@@ -302,8 +331,24 @@ class CsrExpandOp(_FusedExpandBase):
                 rp, ci, _ = gi.csr(hop.types_key, hop.backwards, ctx)
                 hop_data.append((rp, ci, None, None, None, mask))
         dev_ids, _ = gi.node_ids(ctx)
+        chain = J.path_count_chain
+        mesh = current_mesh()
+        if mesh is not None:
+            # explicit shard_map SpMV over the row-sharded CSR (GSPMD's
+            # automatic partitioning of the global cumsum degenerates);
+            # requires every edge array padded to the mesh size — true for
+            # CSRs built under the mesh, checked for safety
+            size = mesh_size()
+            axis = mesh.axis_names[0]
+            divisible = all(
+                (h[1].shape[0] % size == 0)
+                and (h[3] is None or h[3].shape[0] % size == 0)
+                for h in hop_data
+            )
+            if divisible and size > 1:
+                chain = J.path_count_chain_on_mesh(mesh, axis)
         return int(
-            J.path_count_chain(
+            chain(
                 dev_ids,
                 id_col.data,
                 id_col.valid,
@@ -345,31 +390,20 @@ class CsrExpandOp(_FusedExpandBase):
                 in_op.header.column(in_op.header.id_expr(frontier_var))
             ]
             gi.node_ids(ctx)
-            if gi.num_nodes == 0:
-                return 0
             if use_a and use_c and gi.num_nodes >= (1 << 30):
                 return None  # pos*V+pos pair key must stay below the sentinel
-            pos, present = gi.compact_of(id_col, ctx)
-            akey = pos  # base endpoint key = its compact position
-            for hop in reversed(hops):
-                rp, ci, _ = gi.csr(hop.types_key, hop.backwards, ctx)
-                mask = gi.label_mask(hop.far_labels, ctx)
-                deg, t_dev = J.expand_degrees_total(rp, pos, present)
-                total = int(t_dev)
-                if total == 0:
-                    return 0
-                if hop is self:  # final hop: fused materialize+sort+count
-                    return int(
-                        J.distinct_pairs_count_final(
-                            rp, ci, pos, deg, akey, mask,
-                            total=total, use_a=use_a, use_c=use_c,
-                            num_nodes=gi.num_nodes,
-                        )
+
+            def final(rp, ci, pos, deg, akey, mask, total):
+                # final hop: fused materialize+sort+count
+                return int(
+                    J.distinct_pairs_count_final(
+                        rp, ci, pos, deg, akey, mask,
+                        total=total, use_a=use_a, use_c=use_c,
+                        num_nodes=gi.num_nodes,
                     )
-                akey, pos, present = J.distinct_hop_materialize(
-                    rp, ci, pos, deg, akey, mask, total=total
                 )
-            return None  # pragma: no cover - loop always hits `hop is self`
+
+            return _fused_chain_walk(gi, ctx, hops, id_col, final)
         except (GraphIndexError, TpuBackendError):
             return None
 
@@ -468,7 +502,74 @@ class CsrExpandIntoOp(_FusedExpandBase):
         total = int(total_dev)
         return J.into_materialize(eo, lo, counts, total=total)
 
+    def _chain_close_count(self) -> Optional[int]:
+        """count(*) over ExpandInto(fused expand chain) WITHOUT materializing
+        the chain's row set: walk the chain with (base key, position) state
+        (as ``distinct_endpoints_count`` does), then fuse the closing-edge
+        probe into the final hop (``jit_ops.into_close_count``). The classic
+        plan materializes the full k-hop table first — at SF10 the 2-hop
+        set alone is ~10^8 rows; this path keeps O(nodes + edges) memory.
+        None = shape doesn't fit (non-chain input, undirected chain hops,
+        endpoint vars not the chain's ends) — caller materializes."""
+        from ...relational.ops import CacheOp
+
+        in_op = self.children[0]
+        while isinstance(in_op, CacheOp):
+            in_op = in_op.children[0]
+        if (
+            not isinstance(in_op, CsrExpandOp)
+            or in_op._graph_obj is not self._graph_obj
+        ):
+            return None
+        try:
+            hops = in_op._chain_hops()
+            base = hops[-1]
+            ends = {base.frontier_fld, in_op.far_fld}
+            if (
+                {self.source_fld, self.target_fld} != ends
+                or self.source_fld == self.target_fld
+                or base.frontier_fld == in_op.far_fld
+            ):
+                return None
+            if any(h.undirected for h in hops):
+                return None
+            gi = GraphIndex.of(self.graph)
+            ctx = self.context
+            base_in = base.children[0]
+            in_t = base_in.table
+            frontier_var = base_in.header.var(base.frontier_fld)
+            id_col = in_t._cols[
+                base_in.header.column(base_in.header.id_expr(frontier_var))
+            ]
+            gi.node_ids(ctx)
+            if gi.num_nodes >= (1 << 30):
+                return None  # src*N + dst probe key must fit int64
+            keys = gi.edge_keys(self.types_key, ctx)
+            src_is_base = self.source_fld == base.frontier_fld
+
+            def final(rp, ci, pos, deg, akey, mask, total):
+                return int(
+                    J.into_close_count(
+                        rp, ci, pos, deg, akey, mask, keys,
+                        total=total, src_is_base=src_is_base,
+                        num_nodes=gi.num_nodes,
+                        undirected=self.undirected,
+                    )
+                )
+
+            return _fused_chain_walk(gi, ctx, hops, id_col, final)
+        except (GraphIndexError, TpuBackendError):
+            return None
+
     def _fused_table(self):
+        if not self.header.expressions:
+            # pure-multiplicity consumer (pruned count(*) plan): try the
+            # whole-chain fused close count first
+            n = self._chain_close_count()
+            if n is not None:
+                from .table import TpuTable
+
+                return TpuTable({}, n)
         in_op = self.children[0]
         in_t = in_op.table
         gi = GraphIndex.of(self.graph)

@@ -31,7 +31,7 @@ import jax.numpy as jnp
 
 from ...api import types as T
 from ...ir import expr as E
-from ...parallel.mesh import shard_rows
+from ...parallel.mesh import padded_to_mesh
 from .column import Column, TpuBackendError
 
 # canonical scan variable names (reserved: queries cannot produce '$' vars)
@@ -41,6 +41,15 @@ CANON_REL = "$gi_r"
 
 class GraphIndexError(TpuBackendError):
     """The graph cannot be CSR-indexed (e.g. dangling endpoints)."""
+
+
+def _host_logical(col: Column, size: int) -> np.ndarray:
+    """Host int64 copy of a scan column's LOGICAL rows: the ingest-time
+    host mirror when present (zero D2H round trips — ~73ms each over a
+    tunneled chip), else one device fetch sliced past any sharding pad."""
+    if col._np_cache is not None:
+        return np.asarray(col._np_cache[:size], dtype=np.int64)
+    return np.asarray(col.data, dtype=np.int64)[:size]
 
 
 def rekey_element_expr(e: E.Expr, canon: E.Var) -> Optional[E.Expr]:
@@ -85,8 +94,9 @@ class GraphIndex:
         self._node_ids: Optional[Tuple[Any, np.ndarray]] = None
         # labels_key -> (cols, header, row_map)
         self._node_scans: Dict[Tuple[str, ...], Tuple[Dict, Any, Any]] = {}
-        # types_key -> (cols, header)
+        # types_key -> (cols, header); logical row counts in _rel_sizes
         self._rel_scans: Dict[Tuple[str, ...], Tuple[Dict, Any]] = {}
+        self._rel_sizes: Dict[Tuple[str, ...], int] = {}
         # (types_key, reverse) -> (row_ptr, col_idx, edge_orig) device arrays
         self._csr: Dict[Tuple[Tuple[str, ...], bool], Tuple[Any, Any, Any]] = {}
         # (types_key, reverse) -> host max out-degree (Pallas eligibility
@@ -132,7 +142,7 @@ class GraphIndex:
         table = op.table
         header = op.header
         id_col = table._cols[header.column(E.Id(E.Var(CANON_NODE)))]
-        ids_np = np.asarray(id_col.data, dtype=np.int64)
+        ids_np = _host_logical(id_col, table.size)
         if self._node_ids is None:
             if key != ():
                 # the unrestricted scan defines the compact id space
@@ -183,6 +193,7 @@ class GraphIndex:
         )
         out = (op.table._cols, op.header)
         self._rel_scans[types_key] = out
+        self._rel_sizes[types_key] = op.table.size
         return out
 
     def csr(self, types_key: Tuple[str, ...], reverse: bool, ctx):
@@ -192,13 +203,14 @@ class GraphIndex:
         if got is not None:
             return got
         cols, header = self.rel_scan(types_key, ctx)
+        nrel = self._rel_sizes[types_key]
         rel = E.Var(CANON_REL)
         start = cols[header.column(E.StartNode(rel))]
         end = cols[header.column(E.EndNode(rel))]
         _, all_ids = self.node_ids(ctx)
         n = len(all_ids)
-        s_ids = np.asarray(start.data, dtype=np.int64)
-        d_ids = np.asarray(end.data, dtype=np.int64)
+        s_ids = _host_logical(start, nrel)
+        d_ids = _host_logical(end, nrel)
         s = np.searchsorted(all_ids, s_ids).astype(np.int64)
         d = np.searchsorted(all_ids, d_ids).astype(np.int64)
         s = np.clip(s, 0, max(n - 1, 0))
@@ -215,17 +227,22 @@ class GraphIndex:
         self._csr_max_deg[(types_key, reverse)] = int(degs.max()) if n else 0
         out = (
             # row_ptr is node-dim (replicated); the edge-dim arrays shard
-            # over the active mesh — the hash-partitioned-relationship-table
-            # analog (SURVEY §2.3)
+            # over the active mesh, padded to a shard multiple — the
+            # hash-partitioned-relationship-table analog (SURVEY §2.3). Pad
+            # safety: every consumer reads edges through row_ptr ranges
+            # (all < the logical edge count) or clips gathers, so the -1
+            # col_idx / 0 edge_orig tail is never observed.
             jnp.asarray(row_ptr),
-            shard_rows(jnp.asarray(b[order].astype(np.int32))),
-            shard_rows(jnp.asarray(order.astype(np.int64))),
+            padded_to_mesh(b[order].astype(np.int32), -1)[0],
+            padded_to_mesh(order.astype(np.int64), 0)[0],
         )
         self._csr[(types_key, reverse)] = out
         if not reverse and types_key not in self._edge_keys:
-            # forward CSR order is lexsorted by (src, dst) => keys sorted
+            # forward CSR order is lexsorted by (src, dst) => keys sorted;
+            # the pad sentinel sorts past every real (src*N + dst) key so
+            # binary-search probes are unaffected
             keys = a_sorted.astype(np.int64) * n + b[order].astype(np.int64)
-            self._edge_keys[types_key] = shard_rows(jnp.asarray(keys))
+            self._edge_keys[types_key] = padded_to_mesh(keys, (1 << 62))[0]
         if not reverse and types_key not in self._loop_count:
             loops = s[s == d]
             self._loop_count[types_key] = jnp.asarray(

@@ -27,6 +27,7 @@ from typing import Any, Dict, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+from jax import lax
 
 I64 = "i64"
 F64 = "f64"
@@ -257,6 +258,40 @@ def into_probe(keys, s_pos, t_pos, ok, n, drop_loops: bool):
     return lo, counts, jnp.sum(counts)
 
 
+@partial(
+    jax.jit,
+    static_argnames=("total", "src_is_base", "num_nodes", "undirected"),
+)
+def into_close_count(
+    rp, ci, pos, deg, akey, mask, keys,
+    total: int, src_is_base: bool, num_nodes: int, undirected: bool,
+):
+    """Final hop of a count(*) triangle/cycle chain: expand the last hop's
+    (base key, far position) pairs and, INSTEAD of materializing columns,
+    probe the sorted (src*N + dst) edge keys for closing relationships and
+    sum their multiplicities — the whole ExpandInto close fused into one
+    program (BASELINE config #3's workload; the materialized path needs the
+    full 2-hop row set on device first). Mirrors ``into_probe`` semantics
+    exactly, including the swapped-orientation half with loops dropped for
+    undirected closes."""
+    row, edge = _expand_rows(jnp.take(rp, pos), deg, total)
+    nbr = jnp.take(ci, edge).astype(jnp.int64)
+    a = jnp.take(akey, row)
+    ok = jnp.take(mask, nbr) if mask is not None else jnp.ones(total, bool)
+    s, t = (a, nbr) if src_is_base else (nbr, a)
+
+    def probe_count(s, t, ok):
+        probe = s * num_nodes + t
+        lo = jnp.searchsorted(keys, probe, side="left")
+        hi = jnp.searchsorted(keys, probe, side="right")
+        return jnp.sum(jnp.where(ok, hi - lo, 0).astype(jnp.int64))
+
+    cnt = probe_count(s, t, ok)
+    if undirected:
+        cnt = cnt + probe_count(t, s, ok & (s != t))
+    return cnt
+
+
 @partial(jax.jit, static_argnames=("total",))
 def into_materialize(eo, lo, counts, total: int):
     row, edge = _expand_rows(lo, counts, total)
@@ -319,11 +354,67 @@ def gather_swapped(a_data, b_data, a_valid, b_valid, orig, swapped):
 def _csr_spmv(rp, ci, w):
     """(A w)[n] = sum of w[ci[e]] over n's CSR edge range — computed as a
     cumsum difference at row_ptr boundaries: gathers + one scan, ZERO
-    scatters (TPU scatter-add serializes; this stays on the VPU)."""
-    t = jnp.take(w, ci.astype(jnp.int64))
+    scatters (TPU scatter-add serializes; this stays on the VPU). Pad
+    safety: a sharding pad tail (``ci`` = -1, clipped to 0) accumulates
+    into cumsum positions past ``rp[-1]`` that no boundary ever reads."""
+    t = jnp.take(w, jnp.clip(ci, 0).astype(jnp.int64))
     ps = jnp.concatenate([jnp.zeros(1, t.dtype), jnp.cumsum(t)])
     rp64 = rp.astype(jnp.int64)
     return jnp.take(ps, rp64[1:]) - jnp.take(ps, rp64[:-1])
+
+
+def _sharded_spmv(mesh, axis: str):
+    """SpMV over a row-sharded edge array as an EXPLICIT shard_map program:
+    per shard a local cumsum of its contiguous edge range, per-node partial
+    sums via row_ptr boundaries clipped into the shard, combined with one
+    ``psum`` over ICI — the distributed form of ``_csr_spmv`` (SURVEY §2.3's
+    shuffle-reduce replacement). Explicit because GSPMD's partitioning of a
+    globally-sharded cumsum degenerates (observed: a 400k-edge partitioned
+    scan compiled to a ~100s program on the 8-CPU mesh; the shard_map form
+    runs in milliseconds). Pad edges (``ci`` = -1) contribute zero."""
+    from ...parallel.mesh import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    def kernel(rp_r, ci_shard, w_r):
+        size = ci_shard.shape[0]
+        t = jnp.where(
+            ci_shard >= 0,
+            jnp.take(w_r, jnp.clip(ci_shard, 0).astype(jnp.int64)),
+            jnp.zeros((), w_r.dtype),
+        )
+        ps = jnp.concatenate([jnp.zeros(1, t.dtype), jnp.cumsum(t)])
+        lo = lax.axis_index(axis).astype(jnp.int64) * size
+        rp64 = rp_r.astype(jnp.int64)
+        a = jnp.clip(rp64[:-1] - lo, 0, size)
+        b = jnp.clip(rp64[1:] - lo, 0, size)
+        partial_sums = jnp.take(ps, b) - jnp.take(ps, a)
+        return lax.psum(partial_sums, axis)
+
+    def spmv(rp, ci, w):
+        return shard_map(
+            kernel, mesh, in_specs=(P(), P(axis), P()), out_specs=P()
+        )(rp, ci, w)
+
+    return spmv
+
+
+def _chain_body(dev_ids, ids, valid, hops, num_nodes: int, spmv):
+    """Shared traced body of the fused count chain (see
+    ``path_count_chain``); ``spmv`` is the single-device or sharded SpMV."""
+    w = jnp.ones(num_nodes, jnp.int64)
+    for (rp_a, ci_a, rp_b, ci_b, loop_cnt, mask) in reversed(hops):
+        if mask is not None:  # far-label filter of this hop
+            w = jnp.where(mask, w, 0)
+        nw = spmv(rp_a, ci_a, w)
+        if rp_b is not None:
+            nw = nw + spmv(rp_b, ci_b, w) - loop_cnt * w
+        w = nw
+    # base frontier: one completion-count gather per input row
+    pos = jnp.clip(jnp.searchsorted(dev_ids, ids), 0, num_nodes - 1)
+    present = jnp.take(dev_ids, pos) == ids
+    if valid is not None:
+        present = present & valid
+    return jnp.sum(jnp.where(present, jnp.take(w, pos), 0))
 
 
 @partial(jax.jit, static_argnames=("num_nodes",))
@@ -343,20 +434,26 @@ def path_count_chain(dev_ids, ids, valid, hops, num_nodes: int):
     und: both orientations + per-node self-loop counts (primary half counts
     loops once, the opposite half excludes them — subtracting loop_cnt*w
     reproduces exactly the two CsrExpandOp halves)."""
-    w = jnp.ones(num_nodes, jnp.int64)
-    for (rp_a, ci_a, rp_b, ci_b, loop_cnt, mask) in reversed(hops):
-        if mask is not None:  # far-label filter of this hop
-            w = jnp.where(mask, w, 0)
-        nw = _csr_spmv(rp_a, ci_a, w)
-        if rp_b is not None:
-            nw = nw + _csr_spmv(rp_b, ci_b, w) - loop_cnt * w
-        w = nw
-    # base frontier: one completion-count gather per input row
-    pos = jnp.clip(jnp.searchsorted(dev_ids, ids), 0, num_nodes - 1)
-    present = jnp.take(dev_ids, pos) == ids
-    if valid is not None:
-        present = present & valid
-    return jnp.sum(jnp.where(present, jnp.take(w, pos), 0))
+    return _chain_body(dev_ids, ids, valid, hops, num_nodes, _csr_spmv)
+
+
+_MESH_CHAIN_CACHE: Dict[Any, Any] = {}
+
+
+def path_count_chain_on_mesh(mesh, axis: str):
+    """Mesh-active variant of ``path_count_chain``: same chain body with
+    the shard_map SpMV. Jitted once per mesh (cached)."""
+    got = _MESH_CHAIN_CACHE.get((mesh, axis))
+    if got is not None:
+        return got
+    spmv = _sharded_spmv(mesh, axis)
+
+    @partial(jax.jit, static_argnames=("num_nodes",))
+    def run(dev_ids, ids, valid, hops, num_nodes: int):
+        return _chain_body(dev_ids, ids, valid, hops, num_nodes, spmv)
+
+    _MESH_CHAIN_CACHE[(mesh, axis)] = run
+    return run
 
 
 # ---------------------------------------------------------------------------

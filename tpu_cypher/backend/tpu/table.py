@@ -45,8 +45,11 @@ from ...api.types import CypherType
 from . import jit_ops as J
 from .column import (
     BOOL,
+    DATE,
     F64,
     I64,
+    INTEGRAL_KINDS,
+    LDT,
     OBJ,
     STR,
     Column,
@@ -85,8 +88,39 @@ class TpuTable(Table):
     def __init__(self, cols: Dict[str, Column], nrows: Optional[int] = None):
         self._cols = dict(cols)
         if nrows is None:
-            nrows = len(next(iter(cols.values()))) if cols else 0
+            nrows = (
+                next(iter(cols.values())).logical_len if cols else 0
+            )
         self._nrows = nrows
+        self._depadded: Optional["TpuTable"] = None
+
+    # -- sharding-pad handling --------------------------------------------
+
+    def _depad(self) -> "TpuTable":
+        """Slice off mesh-sharding pad rows before an eager relational op.
+
+        Ingested tables under an active mesh carry device columns padded to
+        a shard multiple (``Column.pad`` phantom tail rows, always invalid).
+        The FUSED expand/count paths consume the padded arrays in place —
+        ``jit_ops.compact_lookup`` gates on the validity mask, so pad rows
+        contribute nothing while the big arrays keep their even
+        ``NamedSharding`` layout. Eager relational ops instead see the
+        logical rows: this memoized slice is the boundary."""
+        if all(c.pad == 0 for c in self._cols.values()):
+            return self
+        if self._depadded is None:
+            self._depadded = TpuTable(
+                {c: col.depad() for c, col in self._cols.items()}, self._nrows
+            )
+        return self._depadded
+
+    @property
+    def _phys(self) -> int:
+        """Physical device row count (logical + sharding pad)."""
+        return max(
+            (len(c) for c in self._cols.values() if c.kind != OBJ),
+            default=self._nrows,
+        )
 
     # -- construction -----------------------------------------------------
 
@@ -134,9 +168,10 @@ class TpuTable(Table):
     def _to_local(self, _reason: str = "unspecified"):
         from ..local.table import LocalTable
 
+        t = self._depad()
         FALLBACK_COUNTER.record(_reason)
         return LocalTable(
-            {c: col.to_values() for c, col in self._cols.items()}, self._nrows
+            {c: col.to_values() for c, col in t._cols.items()}, t._nrows
         )
 
     @staticmethod
@@ -168,11 +203,17 @@ class TpuTable(Table):
         return self._nrows
 
     def column_values(self, col: str) -> List[Any]:
+        t = self._depad()
+        if t is not self:
+            return t.column_values(col)
         return self._cols[col].to_values()
 
     def rows(self) -> Iterator[Dict[str, Any]]:
-        decoded = {c: col.to_values() for c, col in self._cols.items()}
-        for i in range(self._nrows):
+        # NOTE: generator — an early `return other.rows()` would silently
+        # end iteration, so the depadded table is used inline
+        t = self._depad()
+        decoded = {c: col.to_values() for c, col in t._cols.items()}
+        for i in range(t._nrows):
             yield {c: v[i] for c, v in decoded.items()}
 
     # -- simple ops --------------------------------------------------------
@@ -211,6 +252,9 @@ class TpuTable(Table):
         return TpuTable(out, n)
 
     def skip(self, n: int) -> "TpuTable":
+        t = self._depad()
+        if t is not self:
+            return t.skip(n)
         n = min(n, self._nrows)
         return TpuTable(
             {c: col.slice(n, self._nrows) for c, col in self._cols.items()},
@@ -218,6 +262,9 @@ class TpuTable(Table):
         )
 
     def limit(self, n: int) -> "TpuTable":
+        t = self._depad()
+        if t is not self:
+            return t.limit(n)
         n = min(n, self._nrows)
         return TpuTable({c: col.slice(0, n) for c, col in self._cols.items()}, n)
 
@@ -234,6 +281,9 @@ class TpuTable(Table):
     # -- filter ------------------------------------------------------------
 
     def filter(self, expr, header, parameters) -> "TpuTable":
+        t = self._depad()
+        if t is not self:
+            return t.filter(expr, header, parameters)
         try:
             c = TpuEvaluator(self, header, parameters).eval(expr)
         except TpuUnsupportedExpr:
@@ -246,6 +296,9 @@ class TpuTable(Table):
     # -- join --------------------------------------------------------------
 
     def join(self, other: "TpuTable", kind, join_cols) -> "TpuTable":
+        t, o = self._depad(), other._depad()
+        if t is not self or o is not other:
+            return t.join(o, kind, join_cols)
         if kind == "cross":
             n, m = self._nrows, other._nrows
             li = jnp.repeat(jnp.arange(n), m)
@@ -458,6 +511,9 @@ class TpuTable(Table):
     # -- union -------------------------------------------------------------
 
     def union_all(self, other: "TpuTable") -> "TpuTable":
+        t, o = self._depad(), other._depad()
+        if t is not self or o is not other:
+            return t.union_all(o)
         if set(self._cols) != set(other._cols):
             raise TpuBackendError("unionAll column mismatch")
         # structurally simple columns (same kind/dtype, shared vocab) concat
@@ -493,6 +549,9 @@ class TpuTable(Table):
     def order_by_limit(
         self, items: Sequence[Tuple[str, bool]], k: int
     ) -> Optional["TpuTable"]:
+        t = self._depad()
+        if t is not self:
+            return t.order_by_limit(items, k)
         """First ``k`` rows under ORDER BY as ONE top-k over a packed int64
         rank — O(n log k) instead of the full device sort. Returns None
         (caller falls back to sort+limit) unless every sort key is integral
@@ -502,7 +561,7 @@ class TpuTable(Table):
         if not items or n == 0 or k == 0:
             return None
         cols = [self._cols[c] for c, _ in items]
-        if any(c.kind not in (I64, BOOL, STR) for c in cols):
+        if any(c.kind not in INTEGRAL_KINDS for c in cols):
             return None
         k = min(k, n)
         datas = tuple(c.data for c in cols)
@@ -528,6 +587,9 @@ class TpuTable(Table):
         return self._take(idx)
 
     def order_by(self, items: Sequence[Tuple[str, bool]]) -> "TpuTable":
+        t = self._depad()
+        if t is not self:
+            return t.order_by(items)
         """ORDER BY: one jitted stable lexsort under Cypher orderability
         (``jit_ops.order_permutation``) + one batched gather."""
         if any(self._cols[c].kind == OBJ for c, _ in items):
@@ -569,7 +631,7 @@ class TpuTable(Table):
         ``min_keys`` keys (one jitted min/max probe + one scalar sync)."""
         packable = (
             self._nrows > 0
-            and all(k in (I64, BOOL, STR) for k in kinds)
+            and all(k in INTEGRAL_KINDS for k in kinds)
             and all(jnp.issubdtype(e.dtype, jnp.integer) or e.dtype == jnp.bool_
                     for e in extras)
         )
@@ -590,6 +652,9 @@ class TpuTable(Table):
         return tuple((int(lo), b) for lo, b in zip(mins, bits))
 
     def distinct_count(self, cols: Sequence[str]) -> Optional[int]:
+        t = self._depad()
+        if t is not self:
+            return t.distinct_count(cols)
         """Number of distinct rows over ``cols`` WITHOUT materializing them
         (count-over-distinct pushdown). All-integer key sets take a packed
         VALUES-ONLY sort (``lax.sort`` without an argsort payload is ~5x
@@ -612,6 +677,9 @@ class TpuTable(Table):
         return int(cnt)
 
     def distinct(self, cols: Optional[Sequence[str]] = None) -> "TpuTable":
+        t = self._depad()
+        if t is not self:
+            return t.distinct(cols)
         on = list(cols) if cols is not None else self.physical_columns
         if any(self._cols[c].kind == OBJ for c in on):
             return self._from_local(self._to_local('distinct:obj-keys').distinct(on))
@@ -645,6 +713,9 @@ class TpuTable(Table):
     _DISTINCT_AGGS = frozenset({"count", "sum", "avg", "min", "max", "collect"})
 
     def group(self, by, aggregations, header, parameters) -> "TpuTable":
+        t = self._depad()
+        if t is not self:
+            return t.group(by, aggregations, header, parameters)
         try:
             return self._group_device(by, aggregations, header, parameters)
         except (TpuUnsupportedExpr, TpuBackendError):
@@ -799,7 +870,10 @@ class TpuTable(Table):
             raise TpuUnsupportedExpr("percentile fraction out of range")
         p = float(p)
         data, kind, vocab = col.data, col.kind, col.vocab
-        if kind == OBJ or kind == BOOL:
+        if kind in (OBJ, BOOL, DATE, LDT):
+            # STR stays: percentileDisc over order-preserving dictionary
+            # codes is a device sort+gather; temporal kinds keep the
+            # oracle's type-error semantics
             raise TpuUnsupportedExpr(f"percentile over {kind}")
         if name == "percentilecont" and kind not in (I64, F64):
             raise TpuUnsupportedExpr("percentileCont over non-numeric")
@@ -816,6 +890,47 @@ class TpuTable(Table):
         return Column(F64, out, out_valid)
 
     def with_columns(self, items, header, parameters) -> "TpuTable":
+        phys = self._phys
+        if phys > self._nrows:
+            from ...ir import expr as E
+
+            if all(isinstance(e, E.Lit) for e, _ in items):
+                # scan alignment adds literal columns (HasLabel flags,
+                # absent-property nulls) to freshly ingested tables; build
+                # them at PHYSICAL length with the shared pad mask so the
+                # sharded layout survives to the fused expand path
+                # (depadding here would un-shard every scan)
+                # ONLY a synthesized-for-padding mask qualifies: a nullable
+                # column's mask carries genuine null holes that must not
+                # leak into the new literal columns
+                mask = next(
+                    (
+                        c.valid
+                        for c in self._cols.values()
+                        if c.kind != OBJ and c.pad > 0 and c.pad_synth
+                        and c.valid is not None
+                    ),
+                    None,
+                )
+                out = dict(self._cols)
+                pad = phys - self._nrows
+                for e, col in items:
+                    c = constant_column(e.value, phys)
+                    if e.value is None or mask is None:
+                        # null constants are already all-invalid; without a
+                        # shared mask fall back to the constant as-is
+                        out[col] = Column(
+                            c.kind, c.data, c.valid, c.vocab, pad=pad,
+                            pad_synth=False,
+                        )
+                    else:
+                        out[col] = Column(
+                            c.kind, c.data, mask, c.vocab, pad=pad,
+                            pad_synth=True,
+                        )
+                return TpuTable(out, self._nrows)
+            t = self._depad()
+            return t.with_columns(items, header, parameters)
         out = dict(self._cols)
         try:
             ev = TpuEvaluator(self, header, parameters)
@@ -830,11 +945,17 @@ class TpuTable(Table):
         return TpuTable({new: self._cols[old] for old, new in pairs}, self._nrows)
 
     def with_row_index(self, col: str) -> "TpuTable":
+        t = self._depad()
+        if t is not self:
+            return t.with_row_index(col)
         out = dict(self._cols)
         out[col] = Column(I64, jnp.arange(self._nrows, dtype=jnp.int64), None)
         return TpuTable(out, self._nrows)
 
     def explode(self, expr, col: str, header, parameters) -> "TpuTable":
+        t = self._depad()
+        if t is not self:
+            return t.explode(expr, col, header, parameters)
         """UNWIND: only the LIST column itself is host-decoded (lists are
         host objects by definition); every other column stays on device and
         is flattened with one device gather over the repeat index."""
