@@ -29,8 +29,10 @@ from ...api import types as T
 from ...ir import expr as E
 from .column import (
     BOOL,
+    DATE,
     F64,
     I64,
+    LDT,
     OBJ,
     STR,
     Column,
@@ -344,7 +346,33 @@ class TpuEvaluator:
             return self._function(expr)
         if isinstance(expr, (E.StartsWith, E.EndsWith, E.Contains, E.RegexMatch)):
             return self._string_predicate(expr)
+        if isinstance(expr, E.Property):
+            # dynamic property access reaching here is an accessor on a
+            # computed value; temporal columns answer on device (the
+            # reference's TemporalUdfs run these on executors)
+            return self._temporal_accessor(self.eval(expr.expr), expr.key)
         raise TpuUnsupportedExpr(type(expr).__name__)
+
+    def _temporal_accessor(self, inner: Column, key: str) -> Column:
+        """Calendar-field accessors over device temporal columns: branch-free
+        civil-calendar math on the VPU (``backend.tpu.temporal``)."""
+        from .temporal import date_accessor, split_ldt, time_accessor
+
+        k = key.lower()
+        if inner.kind == DATE:
+            out = date_accessor(k, inner.data)
+            if out is None:
+                raise TpuUnsupportedExpr(f"date accessor {key!r}")
+            return Column(I64, out, inner.valid)
+        if inner.kind == LDT:
+            days, tod = split_ldt(inner.data)
+            out = date_accessor(k, days)
+            if out is None:
+                out = time_accessor(k, tod)
+            if out is None:
+                raise TpuUnsupportedExpr(f"datetime accessor {key!r}")
+            return Column(I64, out, inner.valid)
+        raise TpuUnsupportedExpr(f"property access on {inner.kind}")
 
     # -- vocab-space string ops -----------------------------------------
     #

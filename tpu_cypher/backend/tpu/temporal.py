@@ -1,0 +1,164 @@
+"""Device calendar math for temporal columns.
+
+The reference executes temporal accessors and arithmetic inside the engine
+on executors (``morpheus-spark-cypher/.../impl/temporal/TemporalUdfs.scala:40-160``);
+the TPU-native equivalent stores date as days-since-epoch int32 and
+localdatetime as microseconds-since-epoch int64 (SURVEY §2.2 temporal row)
+and computes the civil-calendar fields with branch-free integer arithmetic
+on the VPU (the standard era/year-of-era decomposition of the proleptic
+Gregorian calendar — Howard Hinnant's public-domain ``civil_from_days``
+construction — vectorized with ``jnp.where`` instead of branches).
+
+All functions here are TRACED helpers (called inside jitted programs or the
+eager compiler path); every input/output is a device array.
+"""
+
+from __future__ import annotations
+
+import datetime as _dt
+
+import jax.numpy as jnp
+
+EPOCH_ORDINAL = _dt.date(1970, 1, 1).toordinal()
+US_PER_SECOND = 1_000_000
+US_PER_DAY = 86_400 * US_PER_SECOND
+
+
+def encode_date(d: _dt.date) -> int:
+    return d.toordinal() - EPOCH_ORDINAL
+
+
+def decode_date(z: int) -> _dt.date:
+    return _dt.date.fromordinal(int(z) + EPOCH_ORDINAL)
+
+
+def encode_ldt(dt: _dt.datetime) -> int:
+    days = dt.toordinal() - EPOCH_ORDINAL
+    tod = (
+        (dt.hour * 3600 + dt.minute * 60 + dt.second) * US_PER_SECOND
+        + dt.microsecond
+    )
+    return days * US_PER_DAY + tod
+
+
+def decode_ldt(us: int) -> _dt.datetime:
+    days, tod = divmod(int(us), US_PER_DAY)
+    secs, micro = divmod(tod, US_PER_SECOND)
+    h, rem = divmod(secs, 3600)
+    m, s = divmod(rem, 60)
+    d = _dt.date.fromordinal(days + EPOCH_ORDINAL)
+    return _dt.datetime(d.year, d.month, d.day, h, m, s, micro)
+
+
+# ---------------------------------------------------------------------------
+# traced calendar decomposition
+# ---------------------------------------------------------------------------
+
+
+def civil_from_days(z):
+    """days-since-1970 -> (year, month, day), all int64 device arrays."""
+    z = z.astype(jnp.int64) + 719468
+    era = jnp.floor_divide(z, 146097)
+    doe = z - era * 146097  # [0, 146096]
+    yoe = jnp.floor_divide(
+        doe - doe // 1460 + doe // 36524 - doe // 146096, 365
+    )  # [0, 399]
+    y = yoe + era * 400
+    doy = doe - (365 * yoe + yoe // 4 - yoe // 100)  # [0, 365]
+    mp = (5 * doy + 2) // 153  # [0, 11]
+    d = doy - (153 * mp + 2) // 5 + 1  # [1, 31]
+    m = mp + jnp.where(mp < 10, 3, -9)  # [1, 12]
+    return y + (m <= 2), m, d
+
+
+def days_from_civil(y, m, d):
+    """(year, month, day) -> days-since-1970 (inverse of civil_from_days)."""
+    y = y.astype(jnp.int64) - (m <= 2)
+    era = jnp.floor_divide(y, 400)
+    yoe = y - era * 400
+    doy = (153 * (m + jnp.where(m > 2, -3, 9)) + 2) // 5 + d - 1
+    doe = yoe * 365 + yoe // 4 - yoe // 100 + doy
+    return era * 146097 + doe - 719468
+
+
+def iso_weekday(z):
+    """ISO day of week (Mon=1..Sun=7); 1970-01-01 (day 0) was a Thursday.
+    ``jnp.mod`` is floor-mod, so negative days (pre-1970) wrap correctly."""
+    return (z.astype(jnp.int64) + 3) % 7 + 1
+
+
+def _ordinal_day(z, y):
+    """1-based day of year."""
+    jan1 = days_from_civil(y, jnp.ones_like(y), jnp.ones_like(y))
+    return (z.astype(jnp.int64) - jan1 + 1).astype(jnp.int64)
+
+
+def _iso_weeks_in_year(y):
+    """52 or 53 (ISO): 53 iff Jan 1 or Dec 31 falls on a Thursday."""
+    jan1 = days_from_civil(y, jnp.ones_like(y), jnp.ones_like(y))
+    dec31 = days_from_civil(y, jnp.full_like(y, 12), jnp.full_like(y, 31))
+    return jnp.where(
+        (iso_weekday(jan1) == 4) | (iso_weekday(dec31) == 4), 53, 52
+    )
+
+
+def iso_week_and_year(z):
+    """(ISO week number, ISO week-based year)."""
+    y, _, _ = civil_from_days(z)
+    doy = _ordinal_day(z, y)
+    dow = iso_weekday(z)
+    woy = (doy - dow + 10) // 7
+    prev_weeks = _iso_weeks_in_year(y - 1)
+    this_weeks = _iso_weeks_in_year(y)
+    week = jnp.where(woy < 1, prev_weeks, jnp.where(woy > this_weeks, 1, woy))
+    weekyear = jnp.where(woy < 1, y - 1, jnp.where(woy > this_weeks, y + 1, y))
+    return week, weekyear
+
+
+def split_ldt(us):
+    """micros-since-epoch -> (days, time-of-day micros), floor semantics."""
+    us = us.astype(jnp.int64)
+    days = jnp.floor_divide(us, US_PER_DAY)
+    return days, us - days * US_PER_DAY
+
+
+def date_accessor(key: str, days):
+    """One temporal accessor over a days array -> int64 data, or None when
+    the key is not a date field (mirrors ``ir.functions.TEMPORAL_ACCESSORS``)."""
+    y, m, d = civil_from_days(days)
+    if key == "year":
+        return y
+    if key == "month":
+        return m
+    if key == "day":
+        return d
+    if key == "quarter":
+        return (m - 1) // 3 + 1
+    if key == "dayofweek":
+        return iso_weekday(days)
+    if key == "ordinalday":
+        return _ordinal_day(days, y)
+    if key == "week":
+        return iso_week_and_year(days)[0]
+    if key == "weekyear":
+        return iso_week_and_year(days)[1]
+    if key == "dayofquarter":
+        qm = 3 * ((m - 1) // 3) + 1
+        qstart = days_from_civil(y, qm, jnp.ones_like(y))
+        return days.astype(jnp.int64) - qstart + 1
+    return None
+
+
+def time_accessor(key: str, tod):
+    """Accessor over a time-of-day micros array -> int64 data or None."""
+    if key == "hour":
+        return tod // (3600 * US_PER_SECOND)
+    if key == "minute":
+        return (tod // (60 * US_PER_SECOND)) % 60
+    if key == "second":
+        return (tod // US_PER_SECOND) % 60
+    if key == "millisecond":
+        return (tod % US_PER_SECOND) // 1000
+    if key == "microsecond":
+        return tod % US_PER_SECOND
+    return None
