@@ -707,6 +707,7 @@ class CsrVarExpandOp(_FusedExpandBase):
         lower: int,
         upper: int,
         far_labels: Tuple[str, ...],
+        undirected: bool = False,
     ):
         super().__init__(in_plan, classic, graph_obj)
         self.source_fld = source_fld
@@ -716,12 +717,14 @@ class CsrVarExpandOp(_FusedExpandBase):
         self.lower = lower
         self.upper = upper
         self.far_labels = far_labels
+        self.undirected = undirected
 
     def _show_inner(self) -> str:
         t = "|".join(self.types_key) or "*"
+        arrow = "-" if self.undirected else "->"
         return (
             f"({self.source_fld})-[{self.rel_fld}:{t}*{self.lower}.."
-            f"{self.upper}]->({self.target_fld})"
+            f"{self.upper}]{arrow}({self.target_fld})"
         )
 
     def _fused_table(self):
@@ -744,12 +747,29 @@ class CsrVarExpandOp(_FusedExpandBase):
         if gi.num_nodes == 0:
             return TpuTable({}, 0) if count_only else self._assemble_levels(gi, [])
         pos, present = gi.compact_of(id_col, ctx)
-        rp, ci, eo = gi.csr(self.types_key, False, ctx)
+        if self.undirected:
+            # both-orientation CSR: one frontier loop replaces the classic
+            # planner's per-step orientation-product cascade; the shared
+            # edge_orig makes the walked-edge masks direction-agnostic
+            rp, ci, eo = gi.csr_undirected(self.types_key, ctx)
+        else:
+            rp, ci, eo = gi.csr(self.types_key, False, ctx)
         _, _, row_map = gi.node_scan(self.far_labels, ctx)
         row0 = None
         prev_edges: Tuple[Any, ...] = ()
         total_count = 0
         levels: List[Tuple[Any, Any]] = []
+        if self.lower == 0:
+            # length 0: the target IS the source node (must carry the far
+            # labels) — the identity frontier prepended to the loop's levels
+            row00, far, keep, k_dev = J.varlen_zero(pos, present, row_map)
+            if count_only:
+                total_count += int(k_dev)
+            else:
+                k = int(k_dev)
+                if k:
+                    idx = J.mask_nonzero(keep, size=k)
+                    levels.append(J.tree_take((row00, far), idx))
         for level in range(1, self.upper + 1):
             deg, t_dev = J.expand_degrees_total(rp, pos, present)
             total = int(t_dev)
@@ -912,12 +932,14 @@ def plan_optional_expand_fastpath(planner, op, lhs, rhs_planned, classic) -> Opt
 
 def plan_var_expand_fastpath(planner, op, lhs, rhs, classic) -> Optional[RelationalOperator]:
     """Swap the unrolled var-length join cascade for ``CsrVarExpandOp`` when
-    statically safe; None keeps the classic plan. Zero-length branches,
-    undirected steps, named-path capture, and pre-bound endpoints keep the
-    general machinery."""
+    statically safe; None keeps the classic plan. Directed and undirected
+    steps and zero-length lower bounds all fuse (undirected walks ride the
+    both-orientation CSR — replacing the orientation-product cascade of
+    reference ``VarLengthExpandPlanner.scala:264-310``); named-path capture
+    and pre-bound endpoints keep the general machinery."""
     from ...logical import ops as L
 
-    if op.direction != ">" or op.lower < 1 or getattr(op, "capture_path_nodes", False):
+    if op.direction not in (">", "-") or getattr(op, "capture_path_nodes", False):
         return None
     lhs_vars = {v.name for v in lhs.header.vars}
     if op.rel in lhs_vars or op.source not in lhs_vars or op.target in lhs_vars:
@@ -940,6 +962,7 @@ def plan_var_expand_fastpath(planner, op, lhs, rhs, classic) -> Optional[Relatio
         lower=op.lower,
         upper=op.upper,
         far_labels=far_labels,
+        undirected=op.direction == "-",
     )
 
 

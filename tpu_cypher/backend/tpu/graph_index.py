@@ -99,6 +99,9 @@ class GraphIndex:
         self._rel_sizes: Dict[Tuple[str, ...], int] = {}
         # (types_key, reverse) -> (row_ptr, col_idx, edge_orig) device arrays
         self._csr: Dict[Tuple[Tuple[str, ...], bool], Tuple[Any, Any, Any]] = {}
+        # types_key -> both-orientation CSR (undirected var-length walks:
+        # each relationship appears once per endpoint, self-loops once)
+        self._csr_und: Dict[Tuple[str, ...], Tuple[Any, Any, Any]] = {}
         # (types_key, reverse) -> host max out-degree (Pallas eligibility
         # probe — computed once at build, never synced per query)
         self._csr_max_deg: Dict[Tuple[Tuple[str, ...], bool], int] = {}
@@ -196,12 +199,10 @@ class GraphIndex:
         self._rel_sizes[types_key] = op.table.size
         return out
 
-    def csr(self, types_key: Tuple[str, ...], reverse: bool, ctx):
-        """(row_ptr, col_idx, edge_orig) int32/int32/int64 device arrays for
-        one orientation of one relationship-type set."""
-        got = self._csr.get((types_key, reverse))
-        if got is not None:
-            return got
+    def _edge_endpoints(self, types_key: Tuple[str, ...], ctx):
+        """Resolve one type set's relationships to compact endpoint
+        positions: (src_pos int64, dst_pos int64, num_nodes) — the shared
+        front half of every CSR build (validates endpoints)."""
         cols, header = self.rel_scan(types_key, ctx)
         nrel = self._rel_sizes[types_key]
         rel = E.Var(CANON_REL)
@@ -211,18 +212,33 @@ class GraphIndex:
         n = len(all_ids)
         s_ids = _host_logical(start, nrel)
         d_ids = _host_logical(end, nrel)
-        s = np.searchsorted(all_ids, s_ids).astype(np.int64)
-        d = np.searchsorted(all_ids, d_ids).astype(np.int64)
-        s = np.clip(s, 0, max(n - 1, 0))
-        d = np.clip(d, 0, max(n - 1, 0))
+        s = np.clip(np.searchsorted(all_ids, s_ids), 0, max(n - 1, 0)).astype(np.int64)
+        d = np.clip(np.searchsorted(all_ids, d_ids), 0, max(n - 1, 0)).astype(np.int64)
         if len(s_ids) and (
             not (all_ids[s] == s_ids).all() or not (all_ids[d] == d_ids).all()
         ):
             raise GraphIndexError("relationship endpoint not a graph node")
-        a, b = (d, s) if reverse else (s, d)
+        return s, d, n
+
+    @staticmethod
+    def _sorted_csr(a: np.ndarray, b: np.ndarray, n: int):
+        """Lexsort edges by (a, b) and build the row_ptr — the shared back
+        half of every CSR build. Returns host (row_ptr, order, a_sorted);
+        callers gather their per-edge payloads (col ids, edge origins)
+        through ``order``."""
         order = np.lexsort((b, a))
-        a_sorted = a[order]
-        row_ptr = np.searchsorted(a_sorted, np.arange(n + 1)).astype(np.int32)
+        row_ptr = np.searchsorted(a[order], np.arange(n + 1)).astype(np.int32)
+        return row_ptr, order, a[order]
+
+    def csr(self, types_key: Tuple[str, ...], reverse: bool, ctx):
+        """(row_ptr, col_idx, edge_orig) int32/int32/int64 device arrays for
+        one orientation of one relationship-type set."""
+        got = self._csr.get((types_key, reverse))
+        if got is not None:
+            return got
+        s, d, n = self._edge_endpoints(types_key, ctx)
+        a, b = (d, s) if reverse else (s, d)
+        row_ptr, order, a_sorted = self._sorted_csr(a, b, n)
         degs = row_ptr[1:] - row_ptr[:-1]
         self._csr_max_deg[(types_key, reverse)] = int(degs.max()) if n else 0
         out = (
@@ -248,6 +264,35 @@ class GraphIndex:
             self._loop_count[types_key] = jnp.asarray(
                 np.bincount(loops, minlength=n).astype(np.int64)
             )
+        return out
+
+    def csr_undirected(self, types_key: Tuple[str, ...], ctx):
+        """(row_ptr, col_idx, edge_orig) for the BOTH-ORIENTATION graph of
+        one type set: every relationship contributes an edge from each
+        endpoint (self-loops once), with ``edge_orig`` carrying the SAME
+        canonical scan row for both orientations — so the var-length
+        frontier loop's walked-edge masks (``orig != prev``) implement
+        relationship uniqueness across directions for free. One index
+        build replaces the classic planner's per-step union of four scan
+        orientations (reference ``VarLengthExpandPlanner.scala:264-310``)."""
+        got = self._csr_und.get(types_key)
+        if got is not None:
+            return got
+        s, d, n = self._edge_endpoints(types_key, ctx)
+        nrel = len(s)
+        nonloop = s != d
+        a = np.concatenate([s, d[nonloop]])
+        b = np.concatenate([d, s[nonloop]])
+        eo = np.concatenate(
+            [np.arange(nrel, dtype=np.int64), np.arange(nrel, dtype=np.int64)[nonloop]]
+        )
+        row_ptr, order, _ = self._sorted_csr(a, b, n)
+        out = (
+            jnp.asarray(row_ptr),
+            padded_to_mesh(b[order].astype(np.int32), -1)[0],
+            padded_to_mesh(eo[order], 0)[0],
+        )
+        self._csr_und[types_key] = out
         return out
 
     def loop_count(self, types_key: Tuple[str, ...], ctx):

@@ -492,6 +492,20 @@ def varlen_emit(nbr, iso, row_map):
 
 
 @jax.jit
+def varlen_zero(pos, present, row_map):
+    """Length-0 emission: each input row whose source node is present and
+    carries the target labels emits itself once (target = source)."""
+    far = jnp.take(row_map, pos)
+    keep = present & (far >= 0)
+    return (
+        jnp.arange(pos.shape[0], dtype=jnp.int64),
+        far,
+        keep,
+        jnp.sum(keep),
+    )
+
+
+@jax.jit
 def concat_rows(parts):
     """Concatenate per-level (row0, far) pairs into one output frame."""
     return (

@@ -276,6 +276,17 @@ class LogicalPlanner:
         if upper is None:
             raise LogicalPlanningError("Unbounded var-length expand not supported")
         capture = any(rel in fields for fields in pattern.paths.values())
+        if dst_solved and not src_solved:
+            # the walk reached this connection from its TARGET: the classic
+            # cascade and the fused frontier loop both expand FROM the
+            # source, so bring the source into the plan (cartesian) and
+            # reuse the both-solved alignment below. The optimizer's
+            # filter/value-join rewrites then tighten the product.
+            scan = L.NodeScan(
+                L.Start(graph, ()), c.source, pattern.node_types[c.source]
+            )
+            plan = L.CartesianProduct(plan, scan)
+            src_solved = True
         if src_solved and dst_solved:
             # expand to a fresh target, then align on id equality
             fresh_t = self.fresh(f"vt_{c.target}")
