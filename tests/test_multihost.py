@@ -1,0 +1,41 @@
+"""Multi-host path (SURVEY §2.3 / BASELINE #5): the jax.distributed wiring
+exercised in its single-process degenerate form — initialize no-ops, the
+global mesh is the local 8-device mesh, ingest shards across it, and the
+host-0 gather is the identity. The pod run differs only by the coordinator
+environment variables."""
+
+import numpy as np
+
+import jax
+
+from tpu_cypher.parallel import multihost as MH
+from tpu_cypher.parallel.mesh import ROW_AXIS
+
+
+def test_initialize_degenerate_no_coordinator(monkeypatch):
+    monkeypatch.delenv("JAX_COORDINATOR_ADDRESS", raising=False)
+    assert MH.initialize_distributed() is False
+    assert MH.process_count() == 1
+    assert MH.is_host0() is True
+
+
+def test_global_mesh_spans_all_devices():
+    mesh = MH.global_row_mesh()
+    assert mesh.axis_names == (ROW_AXIS,)
+    assert int(np.prod(list(mesh.shape.values()))) == len(jax.devices())
+
+
+def test_collect_on_host0_identity_single_process():
+    import jax.numpy as jnp
+
+    x = jnp.arange(10, dtype=jnp.int64)
+    got = MH.collect_on_host0(x)
+    assert got is not None and (got == np.arange(10)).all()
+
+
+def test_dryrun_multihost_engine_query():
+    report = MH.dryrun_multihost()
+    assert report["processes"] == 1
+    assert report["devices"] == len(jax.devices())
+    assert report["host0"] is True
+    assert report["two_hop"] > 0

@@ -1,0 +1,174 @@
+"""Multi-host orchestration: the DCN scale-out path.
+
+The reference scales out by deploying on a Spark/Flink cluster — the session
+rides the engine's distributed ExecutionEnvironment
+(``flink-cypher/src/main/scala/org/opencypher/flink/api/CAPFSession.scala:47``);
+workers coordinate through the engine's RPC layer. The TPU-native analog
+(SURVEY §2.3, BASELINE config #5: LDBC SF100 sharded over a v5e-64 pod) is:
+
+* ``jax.distributed.initialize`` connects the per-host processes over DCN
+  (coordinator + process id, env-driven like Spark's master/worker env),
+* ONE global ``Mesh`` spans every device of every process; the engine's row
+  sharding (``parallel.mesh.use_mesh``) then lays ingested columns and CSR
+  arrays across the whole pod — GSPMD/shard_map collectives ride ICI within
+  a host and DCN across hosts, exactly where the engines shuffle,
+* results gather to process 0 (``collect_on_host0``) the way the engines
+  collect to the driver.
+
+Single-process use degenerates cleanly: ``initialize_distributed`` is a
+no-op, the global mesh is the local mesh, and gathering is the identity —
+so the SF100 pod run is a config change (environment variables), not new
+code. The degenerate path is exercised by ``dryrun_multihost`` and tests;
+the pod path cannot run in this environment (one chip) but shares every
+line except the ``jax.distributed.initialize`` call."""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import numpy as np
+
+import jax
+
+from .mesh import ROW_AXIS, make_row_mesh, use_mesh
+
+_INITIALIZED = False
+
+
+def initialize_distributed(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+    local_device_ids: Optional[list] = None,
+) -> bool:
+    """Connect this process to the pod's coordination service.
+
+    Arguments default to the standard env vars (``JAX_COORDINATOR_ADDRESS``,
+    ``JAX_NUM_PROCESSES``, ``JAX_PROCESS_ID``) — the deployment shape of the
+    engines' master/worker env. Returns True when a multi-process runtime
+    was initialized, False for the single-process degenerate case (no env,
+    one process). Idempotent."""
+    global _INITIALIZED
+    if _INITIALIZED:
+        return True
+    coordinator_address = coordinator_address or os.environ.get(
+        "JAX_COORDINATOR_ADDRESS"
+    )
+    if num_processes is None:
+        num_processes = int(os.environ.get("JAX_NUM_PROCESSES", "1"))
+    if process_id is None:
+        process_id = int(os.environ.get("JAX_PROCESS_ID", "0"))
+    if coordinator_address is None or num_processes <= 1:
+        return False  # single process: nothing to coordinate
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+        local_device_ids=local_device_ids,
+    )
+    _INITIALIZED = True
+    return True
+
+
+def global_row_mesh():
+    """Row mesh over EVERY device of every connected process (after
+    ``initialize_distributed``, ``jax.devices()`` is the global list)."""
+    return make_row_mesh(jax.devices())
+
+
+def process_count() -> int:
+    return jax.process_count()
+
+
+def is_host0() -> bool:
+    return jax.process_index() == 0
+
+
+def collect_on_host0(arr) -> Optional[np.ndarray]:
+    """Gather a (possibly sharded) device array's GLOBAL value onto process
+    0 (None elsewhere) — the driver-collect step. Single-process: identity."""
+    if jax.process_count() == 1:
+        return np.asarray(arr)
+    from jax.experimental import multihost_utils
+
+    full = multihost_utils.process_allgather(arr, tiled=True)
+    return np.asarray(full) if is_host0() else None
+
+
+class multihost_session:
+    """Context manager for the full scale-out recipe:
+
+    >>> with multihost_session() as mesh:   # doctest: +SKIP
+    ...     g = session.read_from(...)      # ingests sharded over the pod
+    ...     g.cypher("MATCH ...")
+
+    initialize (no-op single-process) -> global mesh -> engine row sharding
+    active. BASELINE #5's v5e-64 run is this block plus the coordinator env."""
+
+    def __init__(self, **init_kwargs):
+        self._init_kwargs = init_kwargs
+        self._mesh_ctx = None
+
+    def __enter__(self):
+        initialize_distributed(**self._init_kwargs)
+        mesh = global_row_mesh()
+        self._mesh_ctx = use_mesh(mesh)
+        return self._mesh_ctx.__enter__()
+
+    def __exit__(self, *exc):
+        return self._mesh_ctx.__exit__(*exc)
+
+
+def dryrun_multihost() -> dict:
+    """Exercise the whole multi-host code path in whatever topology this
+    process sees (single-process degenerate case included): session inside
+    ``multihost_session``, a sharded engine query, host-0 gather. Returns a
+    small report dict (used by tests and the driver dryrun)."""
+    from tpu_cypher import CypherSession
+    from tpu_cypher.api.mapping import (
+        NodeMappingBuilder,
+        RelationshipMappingBuilder,
+    )
+    from tpu_cypher.relational.graphs import ElementTable
+
+    n, e = 51, 173  # non-divisible: exercises pad-to-shard across the mesh
+    rng = np.random.default_rng(0)
+    ids = np.arange(n, dtype=np.int64) * 3 + 1
+    src = rng.integers(0, n, e)
+    dst = rng.integers(0, n, e)
+    keep = src != dst
+    src, dst = src[keep], dst[keep]
+    with multihost_session() as mesh:
+        s = CypherSession.tpu()
+        nt = s.table_cls.from_arrays({"id": ids})
+        nm = NodeMappingBuilder.on("id").with_implied_label("P").build()
+        rt = s.table_cls.from_arrays(
+            {
+                "rid": np.arange(len(src), dtype=np.int64) + 10_000,
+                "s": ids[src],
+                "t": ids[dst],
+            }
+        )
+        rm = (
+            RelationshipMappingBuilder.on("rid")
+            .from_("s")
+            .to("t")
+            .with_relationship_type("K")
+            .build()
+        )
+        g = s.read_from(ElementTable(nm, nt), ElementTable(rm, rt))
+        got = g.cypher(
+            "MATCH (a:P)-[:K]->(b)-[:K]->(c) RETURN count(*) AS c"
+        ).records.collect()
+    outdeg = np.bincount(np.searchsorted(np.sort(ids), ids[src]), minlength=n)
+    expected = int(outdeg[np.searchsorted(np.sort(ids), ids[dst])].sum())
+    count = int(got[0]["c"])
+    assert count == expected, (count, expected)
+    return {
+        "processes": process_count(),
+        "devices": len(jax.devices()),
+        "mesh_axes": dict(mesh.shape),
+        "two_hop": count,
+        "host0": is_host0(),
+    }
