@@ -1,0 +1,256 @@
+Feature: FunctionsAcceptance
+
+  Scenario: coalesce returns the first non-null argument
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:E {a: 1}), (:E {b: 2}), (:E)
+      """
+    When executing query:
+      """
+      MATCH (e:E) RETURN coalesce(e.a, e.b, -1) AS v ORDER BY v
+      """
+    Then the result should be, in order:
+      | v  |
+      | -1 |
+      | 1  |
+      | 2  |
+    And no side effects
+
+  Scenario: size of a string counts characters
+    Given an empty graph
+    When executing query:
+      """
+      RETURN size('hello') AS n, size('') AS z
+      """
+    Then the result should be, in any order:
+      | n | z |
+      | 5 | 0 |
+    And no side effects
+
+  Scenario: size of a list literal
+    Given an empty graph
+    When executing query:
+      """
+      RETURN size([1, 2, 3]) AS n
+      """
+    Then the result should be, in any order:
+      | n |
+      | 3 |
+    And no side effects
+
+  Scenario: range with a step
+    Given an empty graph
+    When executing query:
+      """
+      RETURN range(2, 18, 3) AS l
+      """
+    Then the result should be, in any order:
+      | l                      |
+      | [2, 5, 8, 11, 14, 17]  |
+    And no side effects
+
+  Scenario: range descending with negative step
+    Given an empty graph
+    When executing query:
+      """
+      RETURN range(5, 1, -2) AS l
+      """
+    Then the result should be, in any order:
+      | l         |
+      | [5, 3, 1] |
+    And no side effects
+
+  Scenario: split produces string parts
+    Given an empty graph
+    When executing query:
+      """
+      RETURN split('one,two,three', ',') AS l
+      """
+    Then the result should be, in any order:
+      | l                       |
+      | ['one', 'two', 'three'] |
+    And no side effects
+
+  Scenario: substring with and without length
+    Given an empty graph
+    When executing query:
+      """
+      RETURN substring('hello', 1, 3) AS a, substring('hello', 2) AS b
+      """
+    Then the result should be, in any order:
+      | a     | b     |
+      | 'ell' | 'llo' |
+    And no side effects
+
+  Scenario: left and right string slices
+    Given an empty graph
+    When executing query:
+      """
+      RETURN left('hello', 3) AS l, right('hello', 2) AS r
+      """
+    Then the result should be, in any order:
+      | l     | r    |
+      | 'hel' | 'lo' |
+    And no side effects
+
+  Scenario: replace substitutes every occurrence
+    Given an empty graph
+    When executing query:
+      """
+      RETURN replace('aaa', 'a', 'ab') AS s
+      """
+    Then the result should be, in any order:
+      | s        |
+      | 'ababab' |
+    And no side effects
+
+  Scenario: reverse of a string and of a list
+    Given an empty graph
+    When executing query:
+      """
+      RETURN reverse('abc') AS s, reverse([1, 2, 3]) AS l
+      """
+    Then the result should be, in any order:
+      | s     | l         |
+      | 'cba' | [3, 2, 1] |
+    And no side effects
+
+  Scenario: trim family strips whitespace
+    Given an empty graph
+    When executing query:
+      """
+      RETURN trim('  x  ') AS t, ltrim('  x') AS l, rtrim('x  ') AS r
+      """
+    Then the result should be, in any order:
+      | t   | l   | r   |
+      | 'x' | 'x' | 'x' |
+    And no side effects
+
+  Scenario: abs and sign over mixed numerics
+    Given an empty graph
+    When executing query:
+      """
+      RETURN abs(-3) AS a, abs(-3.5) AS f, sign(-7) AS s, sign(0) AS z
+      """
+    Then the result should be, in any order:
+      | a | f   | s  | z |
+      | 3 | 3.5 | -1 | 0 |
+    And no side effects
+
+  Scenario: round ties away from zero
+    Given an empty graph
+    When executing query:
+      """
+      RETURN round(0.5) AS a, round(-0.5) AS b, round(1.4) AS c
+      """
+    Then the result should be, in any order:
+      | a   | b    | c   |
+      | 1.0 | -1.0 | 1.0 |
+    And no side effects
+
+  Scenario: toString on numbers and booleans
+    Given an empty graph
+    When executing query:
+      """
+      RETURN toString(11) AS i, toString(2.5) AS f, toString(true) AS b
+      """
+    Then the result should be, in any order:
+      | i    | f     | b      |
+      | '11' | '2.5' | 'true' |
+    And no side effects
+
+  Scenario: head last and tail of a list
+    Given an empty graph
+    When executing query:
+      """
+      RETURN head([1, 2, 3]) AS h, last([1, 2, 3]) AS l, tail([1, 2, 3]) AS t
+      """
+    Then the result should be, in any order:
+      | h | l | t      |
+      | 1 | 3 | [2, 3] |
+    And no side effects
+
+  Scenario: head and last of an empty list are null
+    Given an empty graph
+    When executing query:
+      """
+      RETURN head([]) AS h, last([]) AS l
+      """
+    Then the result should be, in any order:
+      | h    | l    |
+      | null | null |
+    And no side effects
+
+  Scenario: exists on properties
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:E {a: 1}), (:E)
+      """
+    When executing query:
+      """
+      MATCH (e:E) RETURN exists(e.a) AS x ORDER BY x
+      """
+    Then the result should be, in order:
+      | x     |
+      | false |
+      | true  |
+    And no side effects
+
+  Scenario: keys of a node lists its property keys
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:E {b: 1, a: 2})
+      """
+    When executing query:
+      """
+      MATCH (e:E) RETURN keys(e) AS k
+      """
+    Then the result should be (ignoring element order for lists):
+      | k          |
+      | ['a', 'b'] |
+    And no side effects
+
+  Scenario: labels and type of matched elements
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:A:B)-[:REL]->(:C)
+      """
+    When executing query:
+      """
+      MATCH (a)-[r]->() RETURN labels(a) AS l, type(r) AS t
+      """
+    Then the result should be (ignoring element order for lists):
+      | l          | t     |
+      | ['A', 'B'] | 'REL' |
+    And no side effects
+
+  Scenario: toUpper and toLower
+    Given an empty graph
+    When executing query:
+      """
+      RETURN toUpper('mIxEd') AS u, toLower('mIxEd') AS l
+      """
+    Then the result should be, in any order:
+      | u       | l       |
+      | 'MIXED' | 'mixed' |
+    And no side effects
+
+  Scenario: String functions compose over stored properties
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:E {s: ' Alice '}), (:E {s: 'bob'})
+      """
+    When executing query:
+      """
+      MATCH (e:E) RETURN toUpper(trim(e.s)) AS s ORDER BY s
+      """
+    Then the result should be, in order:
+      | s       |
+      | 'ALICE' |
+      | 'BOB'   |
+    And no side effects

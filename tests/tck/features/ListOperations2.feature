@@ -1,0 +1,183 @@
+Feature: ListOperations2
+
+  Scenario: Concatenating lists with plus
+    Given an empty graph
+    When executing query:
+      """
+      RETURN [1, 2] + [3] AS l
+      """
+    Then the result should be, in any order:
+      | l         |
+      | [1, 2, 3] |
+    And no side effects
+
+  Scenario: Appending an element with plus
+    Given an empty graph
+    When executing query:
+      """
+      RETURN [1, 2] + 3 AS l
+      """
+    Then the result should be, in any order:
+      | l         |
+      | [1, 2, 3] |
+    And no side effects
+
+  Scenario: Negative list indices count from the end
+    Given an empty graph
+    When executing query:
+      """
+      WITH [1, 2, 3, 4] AS l
+      RETURN l[-1] AS a, l[-2] AS b
+      """
+    Then the result should be, in any order:
+      | a | b |
+      | 4 | 3 |
+    And no side effects
+
+  Scenario: Out-of-bounds list index is null
+    Given an empty graph
+    When executing query:
+      """
+      WITH [1, 2] AS l RETURN l[5] AS x
+      """
+    Then the result should be, in any order:
+      | x    |
+      | null |
+    And no side effects
+
+  Scenario: Slicing with open ends
+    Given an empty graph
+    When executing query:
+      """
+      WITH [1, 2, 3, 4, 5] AS l
+      RETURN l[1..3] AS mid, l[..2] AS head, l[3..] AS tail
+      """
+    Then the result should be, in any order:
+      | mid    | head   | tail   |
+      | [2, 3] | [1, 2] | [4, 5] |
+    And no side effects
+
+  Scenario: List comprehension with filter and map
+    Given an empty graph
+    When executing query:
+      """
+      RETURN [x IN range(1, 10) WHERE x % 3 = 0 | x * x] AS l
+      """
+    Then the result should be, in any order:
+      | l           |
+      | [9, 36, 81] |
+    And no side effects
+
+  Scenario: reduce accumulates across a list
+    Given an empty graph
+    When executing query:
+      """
+      RETURN reduce(acc = 0, x IN [1, 2, 3, 4] | acc + x) AS s
+      """
+    Then the result should be, in any order:
+      | s  |
+      | 10 |
+    And no side effects
+
+  Scenario: any all none and single quantifiers
+    Given an empty graph
+    When executing query:
+      """
+      WITH [1, 2, 3] AS l
+      RETURN any(x IN l WHERE x > 2) AS a, all(x IN l WHERE x > 0) AS b,
+             none(x IN l WHERE x > 5) AS c, single(x IN l WHERE x = 2) AS d
+      """
+    Then the result should be, in any order:
+      | a    | b    | c    | d    |
+      | true | true | true | true |
+    And no side effects
+
+  Scenario: IN over nested lists compares deeply
+    Given an empty graph
+    When executing query:
+      """
+      RETURN [1, 2] IN [[1, 2], [3]] AS a, [1] IN [[1, 2]] AS b
+      """
+    Then the result should be, in any order:
+      | a    | b     |
+      | true | false |
+    And no side effects
+
+  Scenario: UNWIND a literal list of maps
+    Given an empty graph
+    When executing query:
+      """
+      UNWIND [{k: 1}, {k: 2}] AS m RETURN m.k AS k ORDER BY k
+      """
+    Then the result should be, in order:
+      | k |
+      | 1 |
+      | 2 |
+    And no side effects
+
+  Scenario: UNWIND of an empty list produces no rows
+    Given an empty graph
+    When executing query:
+      """
+      UNWIND [] AS x RETURN x
+      """
+    Then the result should be empty
+    And no side effects
+
+  Scenario: UNWIND of null produces no rows
+    Given an empty graph
+    When executing query:
+      """
+      UNWIND null AS x RETURN x
+      """
+    Then the result should be empty
+    And no side effects
+
+  Scenario: collect then UNWIND round-trips values
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:E {v: 3}), (:E {v: 1}), (:E {v: 2})
+      """
+    When executing query:
+      """
+      MATCH (e:E) WITH collect(e.v) AS l
+      UNWIND l AS v RETURN v ORDER BY v
+      """
+    Then the result should be, in order:
+      | v |
+      | 1 |
+      | 2 |
+      | 3 |
+    And no side effects
+
+  Scenario: Lists of dates sort inside ORDER BY
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:E {d: date('2019-06-15')}), (:E {d: date('2019-01-01')})
+      """
+    When executing query:
+      """
+      MATCH (e:E) WITH e.d AS d ORDER BY d DESC
+      RETURN collect(toString(d)) AS l
+      """
+    Then the result should be, in any order:
+      | l                            |
+      | ['2019-06-15', '2019-01-01'] |
+    And no side effects
+
+  Scenario: size of collected distinct values
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:E {v: 1}), (:E {v: 1}), (:E {v: 2})
+      """
+    When executing query:
+      """
+      MATCH (e:E) RETURN size(collect(DISTINCT e.v)) AS n
+      """
+    Then the result should be, in any order:
+      | n |
+      | 2 |
+    And no side effects

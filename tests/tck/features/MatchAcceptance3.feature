@@ -1,0 +1,308 @@
+Feature: MatchAcceptance3
+
+  Scenario: Diamond pattern counts all paths
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (a:S), (b1:M), (b2:M), (c:T),
+             (a)-[:R]->(b1), (a)-[:R]->(b2), (b1)-[:R]->(c), (b2)-[:R]->(c)
+      """
+    When executing query:
+      """
+      MATCH (a:S)-[:R]->()-[:R]->(c:T) RETURN count(*) AS c
+      """
+    Then the result should be, in any order:
+      | c |
+      | 2 |
+    And no side effects
+
+  Scenario: Shared endpoint forks multiply
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (h:Hub), (a:L), (b:L), (c:L),
+             (h)-[:R]->(a), (h)-[:R]->(b), (h)-[:R]->(c)
+      """
+    When executing query:
+      """
+      MATCH (x)-[:R]->(p), (x)-[:R]->(q) RETURN count(*) AS c
+      """
+    Then the result should be, in any order:
+      | c |
+      | 9 |
+    And no side effects
+
+  Scenario: Multiple relationship types as alternatives
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (a:N)-[:X]->(b:N), (a)-[:Y]->(b), (a)-[:Z]->(b)
+      """
+    When executing query:
+      """
+      MATCH (:N)-[r:X|Y]->(:N) RETURN count(*) AS c
+      """
+    Then the result should be, in any order:
+      | c |
+      | 2 |
+    And no side effects
+
+  Scenario: Mixed directions in one chain
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (a:A)-[:R]->(m:M)<-[:R]-(b:B)
+      """
+    When executing query:
+      """
+      MATCH (a:A)-[:R]->(m)<-[:R]-(b:B) RETURN count(*) AS c
+      """
+    Then the result should be, in any order:
+      | c |
+      | 1 |
+    And no side effects
+
+  Scenario: Undirected match counts both orientations
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:N)-[:R]->(:N)
+      """
+    When executing query:
+      """
+      MATCH (a)-[:R]-(b) RETURN count(*) AS c
+      """
+    Then the result should be, in any order:
+      | c |
+      | 2 |
+    And no side effects
+
+  Scenario: A self-loop matches an undirected pattern once per orientation set
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (a:N)-[:R]->(a)
+      """
+    When executing query:
+      """
+      MATCH (x)-[:R]-(y) RETURN count(*) AS c
+      """
+    Then the result should be, in any order:
+      | c |
+      | 1 |
+    And no side effects
+
+  Scenario: Same variable at both pattern ends restricts to cycles
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (a:N)-[:R]->(b:N), (b)-[:R]->(a), (b)-[:R]->(:N)
+      """
+    When executing query:
+      """
+      MATCH (x)-[:R]->(y)-[:R]->(x) RETURN count(*) AS c
+      """
+    Then the result should be, in any order:
+      | c |
+      | 2 |
+    And no side effects
+
+  Scenario: Label predicate inside WHERE equals inline label
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:A {v: 1}), (:B {v: 2}), (:A {v: 3})
+      """
+    When executing query:
+      """
+      MATCH (n) WHERE n:A RETURN sum(n.v) AS s
+      """
+    Then the result should be, in any order:
+      | s |
+      | 4 |
+    And no side effects
+
+  Scenario: Matching on multiple labels requires all of them
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:A:B {v: 1}), (:A {v: 2}), (:B {v: 3})
+      """
+    When executing query:
+      """
+      MATCH (n:A:B) RETURN sum(n.v) AS s
+      """
+    Then the result should be, in any order:
+      | s |
+      | 1 |
+    And no side effects
+
+  Scenario: Inline property map filters the scan
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:P {name: 'x', age: 1}), (:P {name: 'y', age: 2}),
+             (:P {name: 'x', age: 3})
+      """
+    When executing query:
+      """
+      MATCH (p:P {name: 'x'}) RETURN sum(p.age) AS s
+      """
+    Then the result should be, in any order:
+      | s |
+      | 4 |
+    And no side effects
+
+  Scenario: Relationship property map filters expansions
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (a:N), (b:N), (a)-[:R {w: 1}]->(b), (a)-[:R {w: 2}]->(b)
+      """
+    When executing query:
+      """
+      MATCH (:N)-[r:R {w: 2}]->(:N) RETURN count(*) AS c
+      """
+    Then the result should be, in any order:
+      | c |
+      | 1 |
+    And no side effects
+
+  Scenario: Disconnected patterns produce the cross product
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:A), (:A), (:B), (:B), (:B)
+      """
+    When executing query:
+      """
+      MATCH (a:A), (b:B) RETURN count(*) AS c
+      """
+    Then the result should be, in any order:
+      | c |
+      | 6 |
+    And no side effects
+
+  Scenario: Re-matching a bound node by id
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (a:A)-[:R]->(:B), (a)-[:R]->(:B)
+      """
+    When executing query:
+      """
+      MATCH (a:A) WITH a MATCH (a)-[:R]->(b) RETURN count(*) AS c
+      """
+    Then the result should be, in any order:
+      | c |
+      | 2 |
+    And no side effects
+
+  Scenario: Matching a relationship by bound variable keeps its identity
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:A)-[:R {w: 7}]->(:B)
+      """
+    When executing query:
+      """
+      MATCH ()-[r:R]->() WITH r MATCH (x)-[r]->(y) RETURN r.w AS w
+      """
+    Then the result should be, in any order:
+      | w |
+      | 7 |
+    And no side effects
+
+  Scenario: Triangle over mixed labels
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (a:X), (b:Y), (c:Z),
+             (a)-[:R]->(b), (b)-[:R]->(c), (c)-[:R]->(a)
+      """
+    When executing query:
+      """
+      MATCH (a:X)-[:R]->(b:Y)-[:R]->(c:Z)-[:R]->(a) RETURN count(*) AS c
+      """
+    Then the result should be, in any order:
+      | c |
+      | 1 |
+    And no side effects
+
+  Scenario: Anonymous relationship variables stay independent
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (a:N)-[:R]->(b:N), (a)-[:R]->(b)
+      """
+    When executing query:
+      """
+      MATCH (a:N)-[]->(b:N), (a)-[]->(b) RETURN count(*) AS c
+      """
+    Then the result should be, in any order:
+      | c |
+      | 4 |
+    And no side effects
+
+  Scenario: OPTIONAL MATCH after WITH keeps unmatched rows
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:P {v: 1})-[:R]->(:Q), (:P {v: 2})
+      """
+    When executing query:
+      """
+      MATCH (p:P) WITH p ORDER BY p.v
+      OPTIONAL MATCH (p)-[:R]->(q)
+      RETURN p.v AS v, q IS NULL AS missing
+      """
+    Then the result should be, in order:
+      | v | missing |
+      | 1 | false   |
+      | 2 | true    |
+    And no side effects
+
+  Scenario: Matching nothing yields no rows not nulls
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:A)
+      """
+    When executing query:
+      """
+      MATCH (:DoesNotExist) RETURN 1 AS one
+      """
+    Then the result should be empty
+    And no side effects
+
+  Scenario: Two hops with the same relationship type but distinct rels
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (a:N)-[:R]->(b:N), (b)-[:R]->(a)
+      """
+    When executing query:
+      """
+      MATCH (x)-[r1:R]->(y)-[r2:R]->(z)
+      RETURN count(*) AS c
+      """
+    Then the result should be, in any order:
+      | c |
+      | 2 |
+    And no side effects
+
+  Scenario: Long chain across five nodes
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:C1)-[:R]->(:C2)-[:R]->(:C3)-[:R]->(:C4)-[:R]->(:C5)
+      """
+    When executing query:
+      """
+      MATCH (a:C1)-[:R]->()-[:R]->()-[:R]->()-[:R]->(e:C5)
+      RETURN count(*) AS c
+      """
+    Then the result should be, in any order:
+      | c |
+      | 1 |
+    And no side effects

@@ -1,0 +1,191 @@
+Feature: NullAcceptance
+
+  Scenario: Property of a null element is null
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:P {v: 1})
+      """
+    When executing query:
+      """
+      MATCH (p:P) OPTIONAL MATCH (p)-[:R]->(q)
+      RETURN q.anything AS x
+      """
+    Then the result should be, in any order:
+      | x    |
+      | null |
+    And no side effects
+
+  Scenario: Arithmetic with null propagates
+    Given an empty graph
+    When executing query:
+      """
+      RETURN 1 + null AS a, null * 2 AS b, null / 0 AS c
+      """
+    Then the result should be, in any order:
+      | a    | b    | c    |
+      | null | null | null |
+    And no side effects
+
+  Scenario: Null equality is null not true
+    Given an empty graph
+    When executing query:
+      """
+      RETURN null = null AS eq, null <> null AS ne
+      """
+    Then the result should be, in any order:
+      | eq   | ne   |
+      | null | null |
+    And no side effects
+
+  Scenario: IS NULL and IS NOT NULL are three-valued escapes
+    Given an empty graph
+    When executing query:
+      """
+      RETURN null IS NULL AS a, null IS NOT NULL AS b,
+             1 IS NULL AS c, 1 IS NOT NULL AS d
+      """
+    Then the result should be, in any order:
+      | a    | b     | c     | d    |
+      | true | false | false | true |
+    And no side effects
+
+  Scenario: WHERE treats null as false
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:E {v: 1}), (:E)
+      """
+    When executing query:
+      """
+      MATCH (e:E) WHERE e.v > 0 RETURN count(*) AS c
+      """
+    Then the result should be, in any order:
+      | c |
+      | 1 |
+    And no side effects
+
+  Scenario: count of a nullable property skips nulls
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:E {v: 1}), (:E), (:E {v: 3})
+      """
+    When executing query:
+      """
+      MATCH (e:E) RETURN count(e.v) AS c, count(*) AS all
+      """
+    Then the result should be, in any order:
+      | c | all |
+      | 2 | 3   |
+    And no side effects
+
+  Scenario: sum avg min max ignore nulls
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:E {v: 1}), (:E), (:E {v: 3})
+      """
+    When executing query:
+      """
+      MATCH (e:E) RETURN sum(e.v) AS s, avg(e.v) AS a, min(e.v) AS lo, max(e.v) AS hi
+      """
+    Then the result should be, in any order:
+      | s | a   | lo | hi |
+      | 4 | 2.0 | 1  | 3  |
+    And no side effects
+
+  Scenario: collect drops nulls
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:E {v: 1}), (:E), (:E {v: 3})
+      """
+    When executing query:
+      """
+      MATCH (e:E) RETURN collect(e.v) AS l
+      """
+    Then the result should be (ignoring element order for lists):
+      | l      |
+      | [1, 3] |
+    And no side effects
+
+  Scenario: null IN a list is null unless a match is certain
+    Given an empty graph
+    When executing query:
+      """
+      RETURN null IN [1, 2] AS a, 3 IN [1, null] AS b, 1 IN [1, null] AS c
+      """
+    Then the result should be, in any order:
+      | a    | b    | c    |
+      | null | null | true |
+    And no side effects
+
+  Scenario: AND OR three-valued truth table edges
+    Given an empty graph
+    When executing query:
+      """
+      RETURN (null AND false) AS af, (null AND true) AS at,
+             (null OR true) AS ot, (null OR false) AS of
+      """
+    Then the result should be, in any order:
+      | af    | at   | ot   | of   |
+      | false | null | true | null |
+    And no side effects
+
+  Scenario: NOT null is null
+    Given an empty graph
+    When executing query:
+      """
+      RETURN NOT null AS x
+      """
+    Then the result should be, in any order:
+      | x    |
+      | null |
+    And no side effects
+
+  Scenario: DISTINCT groups all nulls together
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:E), (:E), (:E {v: 1})
+      """
+    When executing query:
+      """
+      MATCH (e:E) WITH DISTINCT e.v AS v RETURN count(*) AS c
+      """
+    Then the result should be, in any order:
+      | c |
+      | 2 |
+    And no side effects
+
+  Scenario: Grouping key null forms its own group
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:E), (:E), (:E {v: 1})
+      """
+    When executing query:
+      """
+      MATCH (e:E) RETURN e.v AS v, count(*) AS c ORDER BY v
+      """
+    Then the result should be, in order:
+      | v    | c |
+      | 1    | 1 |
+      | null | 2 |
+    And no side effects
+
+  Scenario: String predicates on null are null-filtered
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:E {s: 'abc'}), (:E)
+      """
+    When executing query:
+      """
+      MATCH (e:E) WHERE e.s STARTS WITH 'a' RETURN count(*) AS c
+      """
+    Then the result should be, in any order:
+      | c |
+      | 1 |
+    And no side effects

@@ -1,0 +1,310 @@
+Feature: ReturnAcceptance
+
+  Scenario: DISTINCT on a projected expression
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:E {v: 1}), (:E {v: 2}), (:E {v: 3})
+      """
+    When executing query:
+      """
+      MATCH (e:E) RETURN DISTINCT e.v % 2 AS m ORDER BY m
+      """
+    Then the result should be, in order:
+      | m |
+      | 0 |
+      | 1 |
+    And no side effects
+
+  Scenario: Arithmetic expression with aggregation
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:E {v: 1}), (:E {v: 2})
+      """
+    When executing query:
+      """
+      MATCH (e:E) RETURN sum(e.v) * 2 AS s
+      """
+    Then the result should be, in any order:
+      | s |
+      | 6 |
+    And no side effects
+
+  Scenario: Aliased expressions are usable in ORDER BY
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:E {v: 5}), (:E {v: 2}), (:E {v: 9})
+      """
+    When executing query:
+      """
+      MATCH (e:E) RETURN e.v * -1 AS neg ORDER BY neg
+      """
+    Then the result should be, in order:
+      | neg |
+      | -9  |
+      | -5  |
+      | -2  |
+    And no side effects
+
+  Scenario: SKIP then LIMIT paginates
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:E {v: 1}), (:E {v: 2}), (:E {v: 3}), (:E {v: 4}), (:E {v: 5})
+      """
+    When executing query:
+      """
+      MATCH (e:E) RETURN e.v AS v ORDER BY v SKIP 1 LIMIT 2
+      """
+    Then the result should be, in order:
+      | v |
+      | 2 |
+      | 3 |
+    And no side effects
+
+  Scenario: SKIP past the end is empty
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:E {v: 1})
+      """
+    When executing query:
+      """
+      MATCH (e:E) RETURN e.v AS v SKIP 10
+      """
+    Then the result should be empty
+    And no side effects
+
+  Scenario: LIMIT zero is empty
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:E {v: 1})
+      """
+    When executing query:
+      """
+      MATCH (e:E) RETURN e.v AS v LIMIT 0
+      """
+    Then the result should be empty
+    And no side effects
+
+  Scenario: SKIP and LIMIT as parameters
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:E {v: 1}), (:E {v: 2}), (:E {v: 3})
+      """
+    And parameters are:
+      | s | 1 |
+      | l | 1 |
+    When executing query:
+      """
+      MATCH (e:E) RETURN e.v AS v ORDER BY v SKIP $s LIMIT $l
+      """
+    Then the result should be, in order:
+      | v |
+      | 2 |
+    And no side effects
+
+  Scenario: ORDER BY mixed ascending and descending keys
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:E {a: 1, b: 1}), (:E {a: 1, b: 2}), (:E {a: 2, b: 1})
+      """
+    When executing query:
+      """
+      MATCH (e:E) RETURN e.a AS a, e.b AS b ORDER BY a ASC, b DESC
+      """
+    Then the result should be, in order:
+      | a | b |
+      | 1 | 2 |
+      | 1 | 1 |
+      | 2 | 1 |
+    And no side effects
+
+  Scenario: RETURN star keeps every variable
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:A {v: 1})-[:R]->(:B {w: 2})
+      """
+    When executing query:
+      """
+      MATCH (a:A)-[:R]->(b:B) RETURN * ORDER BY a.v
+      """
+    Then the result should be, in order:
+      | a            | b            |
+      | (:A {v: 1})  | (:B {w: 2})  |
+    And no side effects
+
+  Scenario: Returning a literal map
+    Given an empty graph
+    When executing query:
+      """
+      RETURN {a: 1, b: 'x'} AS m
+      """
+    Then the result should be, in any order:
+      | m               |
+      | {a: 1, b: 'x'}  |
+    And no side effects
+
+  Scenario: Returning nested lists and maps
+    Given an empty graph
+    When executing query:
+      """
+      RETURN {l: [1, {k: 2}]} AS m
+      """
+    Then the result should be, in any order:
+      | m                 |
+      | {l: [1, {k: 2}]}  |
+    And no side effects
+
+  Scenario: WITH chains recompute aliases
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:E {v: 2}), (:E {v: 4})
+      """
+    When executing query:
+      """
+      MATCH (e:E) WITH e.v * 10 AS x WITH x + 1 AS y RETURN y ORDER BY y
+      """
+    Then the result should be, in order:
+      | y  |
+      | 21 |
+      | 41 |
+    And no side effects
+
+  Scenario: WITH WHERE filters between clauses
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:E {v: 1}), (:E {v: 5}), (:E {v: 9})
+      """
+    When executing query:
+      """
+      MATCH (e:E) WITH e.v AS v WHERE v > 3 RETURN sum(v) AS s
+      """
+    Then the result should be, in any order:
+      | s  |
+      | 14 |
+    And no side effects
+
+  Scenario: Aggregation grouped by two keys
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:E {a: 1, b: 'x', v: 10}), (:E {a: 1, b: 'x', v: 20}),
+             (:E {a: 1, b: 'y', v: 30}), (:E {a: 2, b: 'x', v: 40})
+      """
+    When executing query:
+      """
+      MATCH (e:E) RETURN e.a AS a, e.b AS b, sum(e.v) AS s ORDER BY a, b
+      """
+    Then the result should be, in order:
+      | a | b   | s  |
+      | 1 | 'x' | 30 |
+      | 1 | 'y' | 30 |
+      | 2 | 'x' | 40 |
+    And no side effects
+
+  Scenario: UNION combines deduplicated rows
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:A {v: 1}), (:B {v: 1}), (:B {v: 2})
+      """
+    When executing query:
+      """
+      MATCH (a:A) RETURN a.v AS v
+      UNION
+      MATCH (b:B) RETURN b.v AS v
+      """
+    Then the result should be, in any order:
+      | v |
+      | 1 |
+      | 2 |
+    And no side effects
+
+  Scenario: UNION ALL keeps duplicates
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:A {v: 1}), (:B {v: 1})
+      """
+    When executing query:
+      """
+      MATCH (a:A) RETURN a.v AS v
+      UNION ALL
+      MATCH (b:B) RETURN b.v AS v
+      """
+    Then the result should be, in any order:
+      | v |
+      | 1 |
+      | 1 |
+    And no side effects
+
+  Scenario: Expression of a grouping key is allowed after aggregation
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:E {v: 1}), (:E {v: 1}), (:E {v: 2})
+      """
+    When executing query:
+      """
+      MATCH (e:E) RETURN e.v + 1 AS k, count(*) AS c ORDER BY k
+      """
+    Then the result should be, in order:
+      | k | c |
+      | 2 | 2 |
+      | 3 | 1 |
+    And no side effects
+
+  Scenario: Limit applies after a full sort
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:E {v: 3}), (:E {v: 1}), (:E {v: 2})
+      """
+    When executing query:
+      """
+      MATCH (e:E) RETURN e.v AS v ORDER BY v DESC LIMIT 1
+      """
+    Then the result should be, in order:
+      | v |
+      | 3 |
+    And no side effects
+
+  Scenario: Boolean expressions project as values
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:E {v: 1}), (:E {v: 5})
+      """
+    When executing query:
+      """
+      MATCH (e:E) RETURN e.v > 3 AS big ORDER BY big
+      """
+    Then the result should be, in order:
+      | big   |
+      | false |
+      | true  |
+    And no side effects
+
+  Scenario: count DISTINCT of an expression
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:E {v: 1}), (:E {v: 3}), (:E {v: 5})
+      """
+    When executing query:
+      """
+      MATCH (e:E) RETURN count(DISTINCT e.v % 2) AS c
+      """
+    Then the result should be, in any order:
+      | c |
+      | 1 |
+    And no side effects

@@ -1,0 +1,117 @@
+Feature: SemanticErrors
+
+  Scenario: Adding a boolean and an integer is a type error
+    Given an empty graph
+    When executing query:
+      """
+      RETURN true + 1 AS x
+      """
+    Then a TypeError should be raised at runtime: InvalidArgumentValue
+
+  Scenario: Negating a string is a type error
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:E {s: 'abc'})
+      """
+    When executing query:
+      """
+      MATCH (e:E) RETURN -e.s AS x
+      """
+    Then a TypeError should be raised at runtime: InvalidArgumentValue
+
+  Scenario: Temporal accessor with an unknown field is an error
+    Given an empty graph
+    When executing query:
+      """
+      RETURN date('2019-01-01').century AS x
+      """
+    Then a TypeError should be raised at runtime: InvalidArgumentValue
+
+  Scenario: Duration accessor on a date is an error
+    Given an empty graph
+    When executing query:
+      """
+      RETURN date('2019-01-01').monthsOfYear AS x
+      """
+    Then a TypeError should be raised at runtime: InvalidArgumentValue
+
+  Scenario: Property access on an integer is an error
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:E {v: 5})
+      """
+    When executing query:
+      """
+      MATCH (e:E) RETURN e.v.year AS x
+      """
+    Then a TypeError should be raised at runtime: InvalidArgumentValue
+
+  Scenario: percentileCont with an out-of-range fraction is an error
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:E {v: 1}), (:E {v: 2})
+      """
+    When executing query:
+      """
+      MATCH (e:E) RETURN percentileCont(e.v, 1.5) AS p
+      """
+    Then a TypeError should be raised at runtime: InvalidArgumentValue
+
+  Scenario: Aggregation inside WHERE is a syntax error
+    Given an empty graph
+    When executing query:
+      """
+      MATCH (n) WHERE count(n) > 0 RETURN n
+      """
+    Then a SyntaxError should be raised at compile time: InvalidAggregation
+
+  Scenario: Referencing an undefined variable is a syntax error
+    Given an empty graph
+    When executing query:
+      """
+      RETURN undefinedVariable AS x
+      """
+    Then a SyntaxError should be raised at compile time: UndefinedVariable
+
+  Scenario: ORDER BY on an unprojected alias after aggregation is an error
+    Given an empty graph
+    When executing query:
+      """
+      MATCH (n) RETURN count(*) AS c ORDER BY nonexistent
+      """
+    Then a SyntaxError should be raised at compile time: UndefinedVariable
+
+  Scenario: Unknown function is an error
+    Given an empty graph
+    When executing query:
+      """
+      RETURN totallyNotAFunction(1) AS x
+      """
+    Then a SyntaxError should be raised at compile time: UnknownFunction
+
+  Scenario: sqrt of a string is a type error
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:E {s: 'abc'})
+      """
+    When executing query:
+      """
+      MATCH (e:E) RETURN sqrt(e.s) AS x
+      """
+    Then a TypeError should be raised at runtime: InvalidArgumentValue
+
+  Scenario: Indexing a scalar like a list is a type error
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:E {v: 42})
+      """
+    When executing query:
+      """
+      MATCH (e:E) RETURN e.v[0] AS x
+      """
+    Then a TypeError should be raised at runtime: InvalidArgumentValue

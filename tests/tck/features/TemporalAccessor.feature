@@ -1,0 +1,131 @@
+Feature: TemporalAccessor
+
+  Scenario: ISO week 53 of a long year
+    Given an empty graph
+    When executing query:
+      """
+      WITH date('2015-12-31') AS d
+      RETURN d.week AS w, d.weekYear AS wy
+      """
+    Then the result should be, in any order:
+      | w  | wy   |
+      | 53 | 2015 |
+    And no side effects
+
+  Scenario: Early January belonging to the previous ISO week-year
+    Given an empty graph
+    When executing query:
+      """
+      WITH date('2016-01-01') AS d
+      RETURN d.week AS w, d.weekYear AS wy
+      """
+    Then the result should be, in any order:
+      | w  | wy   |
+      | 53 | 2015 |
+    And no side effects
+
+  Scenario: Late December belonging to the next ISO week-year
+    Given an empty graph
+    When executing query:
+      """
+      WITH date('2019-12-30') AS d
+      RETURN d.week AS w, d.weekYear AS wy
+      """
+    Then the result should be, in any order:
+      | w | wy   |
+      | 1 | 2020 |
+    And no side effects
+
+  Scenario: Ordinal day on a leap year
+    Given an empty graph
+    When executing query:
+      """
+      RETURN date('2020-12-31').ordinalDay AS od, date('2019-12-31').ordinalDay AS on
+      """
+    Then the result should be, in any order:
+      | od  | on  |
+      | 366 | 365 |
+    And no side effects
+
+  Scenario: Day of week across a whole week
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:E {d: date('2019-03-04')}), (:E {d: date('2019-03-05')}),
+             (:E {d: date('2019-03-10')})
+      """
+    When executing query:
+      """
+      MATCH (e:E) RETURN e.d.dayOfWeek AS dow ORDER BY dow
+      """
+    Then the result should be, in order:
+      | dow |
+      | 1   |
+      | 2   |
+      | 7   |
+    And no side effects
+
+  Scenario: Quarter and dayOfQuarter accessors
+    Given an empty graph
+    When executing query:
+      """
+      WITH date('2019-05-01') AS d
+      RETURN d.quarter AS q, d.dayOfQuarter AS dq
+      """
+    Then the result should be, in any order:
+      | q | dq |
+      | 2 | 31 |
+    And no side effects
+
+  Scenario: Datetime carries both date and time fields
+    Given an empty graph
+    When executing query:
+      """
+      WITH localdatetime('2019-03-09T23:59:59.999999') AS t
+      RETURN t.day AS d, t.hour AS h, t.minute AS m, t.second AS s,
+             t.millisecond AS ms, t.microsecond AS us
+      """
+    Then the result should be, in any order:
+      | d | h  | m  | s  | ms  | us     |
+      | 9 | 23 | 59 | 59 | 999 | 999999 |
+    And no side effects
+
+  Scenario: Accessors survive aggregation boundaries
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:E {d: date('2019-03-09')}), (:E {d: date('2020-07-01')})
+      """
+    When executing query:
+      """
+      MATCH (e:E) WITH max(e.d) AS m RETURN m.year AS y, m.month AS mo
+      """
+    Then the result should be, in any order:
+      | y    | mo |
+      | 2020 | 7  |
+    And no side effects
+
+  Scenario: Accessor on a parameter-built temporal
+    Given an empty graph
+    And parameters are:
+      | y | 1984 |
+    When executing query:
+      """
+      RETURN date({year: $y, month: 2, day: 29}).dayOfWeek AS dow
+      """
+    Then the result should be, in any order:
+      | dow |
+      | 3   |
+    And no side effects
+
+  Scenario: Week of the epoch day
+    Given an empty graph
+    When executing query:
+      """
+      WITH date('1970-01-01') AS d
+      RETURN d.dayOfWeek AS dow, d.week AS w, d.weekYear AS wy
+      """
+    Then the result should be, in any order:
+      | dow | w | wy   |
+      | 4   | 1 | 1970 |
+    And no side effects

@@ -1,0 +1,138 @@
+Feature: TemporalArithmetic
+
+  Scenario: Adding a day duration to a date
+    Given an empty graph
+    When executing query:
+      """
+      RETURN toString(date('2019-03-09') + duration('P5D')) AS s
+      """
+    Then the result should be, in any order:
+      | s            |
+      | '2019-03-14' |
+    And no side effects
+
+  Scenario: Adding a month duration clamps to month end
+    Given an empty graph
+    When executing query:
+      """
+      RETURN toString(date('2019-01-31') + duration('P1M')) AS s
+      """
+    Then the result should be, in any order:
+      | s            |
+      | '2019-02-28' |
+    And no side effects
+
+  Scenario: Adding a month duration clamps to leap-day
+    Given an empty graph
+    When executing query:
+      """
+      RETURN toString(date('2020-01-31') + duration('P1M')) AS s
+      """
+    Then the result should be, in any order:
+      | s            |
+      | '2020-02-29' |
+    And no side effects
+
+  Scenario: Subtracting a duration from a date
+    Given an empty graph
+    When executing query:
+      """
+      RETURN toString(date('2019-03-09') - duration('P10D')) AS s
+      """
+    Then the result should be, in any order:
+      | s            |
+      | '2019-02-27' |
+    And no side effects
+
+  Scenario: Adding a mixed duration applies months then days
+    Given an empty graph
+    When executing query:
+      """
+      RETURN toString(date('2019-01-31') + duration('P1M1D')) AS s
+      """
+    Then the result should be, in any order:
+      | s            |
+      | '2019-03-01' |
+    And no side effects
+
+  Scenario: Adding a time duration to a datetime
+    Given an empty graph
+    When executing query:
+      """
+      RETURN toString(localdatetime('2019-03-09T11:45:22') + duration('PT30M38S')) AS s
+      """
+    Then the result should be, in any order:
+      | s                     |
+      | '2019-03-09T12:16:00' |
+    And no side effects
+
+  Scenario: Adding a time duration to a date spills into a datetime
+    Given an empty graph
+    When executing query:
+      """
+      RETURN toString(date('2019-03-09') + duration('PT12H')) AS s
+      """
+    Then the result should be, in any order:
+      | s                     |
+      | '2019-03-09T12:00:00' |
+    And no side effects
+
+  Scenario: Duration addition across a year boundary
+    Given an empty graph
+    When executing query:
+      """
+      RETURN toString(date('2019-11-30') + duration('P3M')) AS s
+      """
+    Then the result should be, in any order:
+      | s            |
+      | '2020-02-29' |
+    And no side effects
+
+  Scenario: Adding a negative duration moves backwards
+    Given an empty graph
+    When executing query:
+      """
+      RETURN toString(date('2019-03-09') + duration('-P1M')) AS s
+      """
+    Then the result should be, in any order:
+      | s            |
+      | '2019-02-09' |
+    And no side effects
+
+  Scenario: between then re-apply round-trips
+    Given an empty graph
+    When executing query:
+      """
+      WITH date('2018-01-15') AS a, date('2019-03-10') AS b
+      RETURN toString(a + duration.between(a, b)) AS s
+      """
+    Then the result should be, in any order:
+      | s            |
+      | '2019-03-10' |
+    And no side effects
+
+  Scenario: Arithmetic over stored temporal properties
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:E {d: date('2019-03-09')}), (:E {d: date('2019-06-01')})
+      """
+    When executing query:
+      """
+      MATCH (e:E)
+      RETURN toString(e.d + duration('P1M')) AS s ORDER BY s
+      """
+    Then the result should be, in order:
+      | s            |
+      | '2019-04-09' |
+      | '2019-07-01' |
+    And no side effects
+
+  Scenario: Adding a duration to null is null
+    Given an empty graph
+    When executing query:
+      """
+      MATCH (n) RETURN n.d + duration('P1D') AS x
+      """
+    Then the result should be empty
+    And no side effects

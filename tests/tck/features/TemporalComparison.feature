@@ -1,0 +1,214 @@
+Feature: TemporalComparison
+
+  Scenario: Dates order chronologically
+    Given an empty graph
+    When executing query:
+      """
+      RETURN date('2019-03-09') < date('2019-03-10') AS lt,
+             date('2019-03-09') <= date('2019-03-09') AS le,
+             date('2020-01-01') > date('2019-12-31') AS gt
+      """
+    Then the result should be, in any order:
+      | lt   | le   | gt   |
+      | true | true | true |
+    And no side effects
+
+  Scenario: Datetimes order chronologically to the microsecond
+    Given an empty graph
+    When executing query:
+      """
+      RETURN localdatetime('2019-03-09T11:45:22.000001')
+               > localdatetime('2019-03-09T11:45:22') AS gt
+      """
+    Then the result should be, in any order:
+      | gt   |
+      | true |
+    And no side effects
+
+  Scenario: Date equality and inequality
+    Given an empty graph
+    When executing query:
+      """
+      RETURN date('2019-03-09') = date('2019-03-09') AS eq,
+             date('2019-03-09') <> date('2019-03-10') AS ne
+      """
+    Then the result should be, in any order:
+      | eq   | ne   |
+      | true | true |
+    And no side effects
+
+  Scenario: Comparing a date with a datetime is null
+    Given an empty graph
+    When executing query:
+      """
+      RETURN date('2019-03-09') < localdatetime('2019-03-09T00:00:00') AS x
+      """
+    Then the result should be, in any order:
+      | x    |
+      | null |
+    And no side effects
+
+  Scenario: Comparing a date with a number is null
+    Given an empty graph
+    When executing query:
+      """
+      RETURN date('2019-03-09') < 17967 AS x
+      """
+    Then the result should be, in any order:
+      | x    |
+      | null |
+    And no side effects
+
+  Scenario: Filtering rows on a date range
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:E {d: date('2019-01-01')}), (:E {d: date('2019-06-15')}),
+             (:E {d: date('2019-12-31')}), (:E {d: date('2020-01-01')})
+      """
+    When executing query:
+      """
+      MATCH (e:E)
+      WHERE date('2019-02-01') <= e.d AND e.d < date('2020-01-01')
+      RETURN count(*) AS c
+      """
+    Then the result should be, in any order:
+      | c |
+      | 2 |
+    And no side effects
+
+  Scenario: ORDER BY over dates is chronological with nulls last
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:E {d: date('2019-06-15')}), (:E {d: date('2019-01-01')}), (:E)
+      """
+    When executing query:
+      """
+      MATCH (e:E)
+      RETURN toString(e.d) AS s ORDER BY e.d
+      """
+    Then the result should be, in order:
+      | s            |
+      | '2019-01-01' |
+      | '2019-06-15' |
+      | null         |
+    And no side effects
+
+  Scenario: min and max over date properties
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:E {d: date('2019-06-15')}), (:E {d: date('2019-01-01')}),
+             (:E {d: date('2021-03-03')})
+      """
+    When executing query:
+      """
+      MATCH (e:E)
+      RETURN toString(min(e.d)) AS lo, toString(max(e.d)) AS hi
+      """
+    Then the result should be, in any order:
+      | lo           | hi           |
+      | '2019-01-01' | '2021-03-03' |
+    And no side effects
+
+  Scenario: DISTINCT over equal dates collapses them
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:E {d: date('2019-06-15')}), (:E {d: date('2019-06-15')}),
+             (:E {d: date('2019-01-01')})
+      """
+    When executing query:
+      """
+      MATCH (e:E) WITH DISTINCT e.d AS d RETURN count(*) AS c
+      """
+    Then the result should be, in any order:
+      | c |
+      | 2 |
+    And no side effects
+
+  Scenario: Grouping by a date key
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:E {d: date('2019-06-15'), v: 1}), (:E {d: date('2019-06-15'), v: 2}),
+             (:E {d: date('2019-01-01'), v: 5})
+      """
+    When executing query:
+      """
+      MATCH (e:E)
+      RETURN toString(e.d) AS d, sum(e.v) AS s ORDER BY d
+      """
+    Then the result should be, in order:
+      | d            | s |
+      | '2019-01-01' | 5 |
+      | '2019-06-15' | 3 |
+    And no side effects
+
+  Scenario: Joining on equal date properties
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:A {d: date('2019-06-15')}), (:A {d: date('2019-01-01')}),
+             (:B {d: date('2019-06-15')}), (:B {d: date('2019-06-15')})
+      """
+    When executing query:
+      """
+      MATCH (a:A), (b:B) WHERE a.d = b.d RETURN count(*) AS c
+      """
+    Then the result should be, in any order:
+      | c |
+      | 2 |
+    And no side effects
+
+  Scenario: Comparing dates from accessors round-trips
+    Given an empty graph
+    When executing query:
+      """
+      WITH date('2019-03-09') AS d
+      RETURN date({year: d.year, month: d.month, day: d.day}) = d AS eq
+      """
+    Then the result should be, in any order:
+      | eq   |
+      | true |
+    And no side effects
+
+  Scenario: Datetime equality ignores nothing
+    Given an empty graph
+    When executing query:
+      """
+      RETURN localdatetime('2019-03-09T11:45:22')
+               = localdatetime('2019-03-09T11:45:22.000001') AS eq
+      """
+    Then the result should be, in any order:
+      | eq    |
+      | false |
+    And no side effects
+
+  Scenario: Null-propagating date comparison
+    Given an empty graph
+    When executing query:
+      """
+      MATCH (n) RETURN date('2019-01-01') < n.d AS x
+      """
+    Then the result should be empty
+    And no side effects
+
+  Scenario: CASE over date comparisons
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:E {d: date('2019-01-01')}), (:E {d: date('2020-06-15')})
+      """
+    When executing query:
+      """
+      MATCH (e:E)
+      RETURN CASE WHEN e.d < date('2020-01-01') THEN 'old' ELSE 'new' END AS tag
+      ORDER BY tag
+      """
+    Then the result should be, in order:
+      | tag   |
+      | 'new' |
+      | 'old' |
+    And no side effects

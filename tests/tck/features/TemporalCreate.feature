@@ -1,0 +1,231 @@
+Feature: TemporalCreate
+
+  Scenario: Date from a full component map
+    Given an empty graph
+    When executing query:
+      """
+      RETURN toString(date({year: 1984, month: 10, day: 11})) AS s
+      """
+    Then the result should be, in any order:
+      | s            |
+      | '1984-10-11' |
+    And no side effects
+
+  Scenario: Date from a year-month map defaults the day
+    Given an empty graph
+    When executing query:
+      """
+      RETURN toString(date({year: 1984, month: 10})) AS s
+      """
+    Then the result should be, in any order:
+      | s            |
+      | '1984-10-01' |
+    And no side effects
+
+  Scenario: Date from a year-only map defaults month and day
+    Given an empty graph
+    When executing query:
+      """
+      RETURN toString(date({year: 1984})) AS s
+      """
+    Then the result should be, in any order:
+      | s            |
+      | '1984-01-01' |
+    And no side effects
+
+  Scenario: Date from a full ISO string
+    Given an empty graph
+    When executing query:
+      """
+      RETURN toString(date('1984-10-11')) AS s
+      """
+    Then the result should be, in any order:
+      | s            |
+      | '1984-10-11' |
+    And no side effects
+
+  Scenario: Date from a compact ISO string
+    Given an empty graph
+    When executing query:
+      """
+      RETURN toString(date('19841011')) AS s
+      """
+    Then the result should be, in any order:
+      | s            |
+      | '1984-10-11' |
+    And no side effects
+
+  Scenario: Date from a year-month string
+    Given an empty graph
+    When executing query:
+      """
+      WITH date('1984-10') AS d
+      RETURN d.year AS y, d.month AS m, d.day AS dd
+      """
+    Then the result should be, in any order:
+      | y    | m  | dd |
+      | 1984 | 10 | 1  |
+    And no side effects
+
+  Scenario: Local datetime from a full component map
+    Given an empty graph
+    When executing query:
+      """
+      RETURN toString(localdatetime({year: 1984, month: 10, day: 11,
+                                     hour: 12, minute: 31, second: 14})) AS s
+      """
+    Then the result should be, in any order:
+      | s                     |
+      | '1984-10-11T12:31:14' |
+    And no side effects
+
+  Scenario: Local datetime map defaults the time fields to zero
+    Given an empty graph
+    When executing query:
+      """
+      WITH localdatetime({year: 1984, month: 10, day: 11}) AS t
+      RETURN t.hour AS h, t.minute AS m, t.second AS s
+      """
+    Then the result should be, in any order:
+      | h | m | s |
+      | 0 | 0 | 0 |
+    And no side effects
+
+  Scenario: Local datetime with millisecond component
+    Given an empty graph
+    When executing query:
+      """
+      WITH localdatetime({year: 1984, month: 10, day: 11,
+                          hour: 12, minute: 31, second: 14,
+                          millisecond: 645}) AS t
+      RETURN t.millisecond AS ms, t.microsecond AS us
+      """
+    Then the result should be, in any order:
+      | ms  | us     |
+      | 645 | 645000 |
+    And no side effects
+
+  Scenario: Local datetime with microsecond component
+    Given an empty graph
+    When executing query:
+      """
+      WITH localdatetime({year: 1984, month: 10, day: 11,
+                          hour: 12, minute: 31, second: 14,
+                          microsecond: 645876}) AS t
+      RETURN t.microsecond AS us, t.millisecond AS ms
+      """
+    Then the result should be, in any order:
+      | us     | ms  |
+      | 645876 | 645 |
+    And no side effects
+
+  Scenario: Local datetime from an ISO string with fraction
+    Given an empty graph
+    When executing query:
+      """
+      WITH localdatetime('2015-07-21T21:40:32.142') AS t
+      RETURN t.second AS s, t.millisecond AS ms
+      """
+    Then the result should be, in any order:
+      | s  | ms  |
+      | 32 | 142 |
+    And no side effects
+
+  Scenario: Leap-day date is valid
+    Given an empty graph
+    When executing query:
+      """
+      RETURN toString(date('2020-02-29')) AS s
+      """
+    Then the result should be, in any order:
+      | s            |
+      | '2020-02-29' |
+    And no side effects
+
+  Scenario: Invalid calendar date is an error
+    Given an empty graph
+    When executing query:
+      """
+      RETURN date({year: 2019, month: 2, day: 30}) AS d
+      """
+    Then a TypeError should be raised at runtime: InvalidArgumentValue
+
+  Scenario: Unparseable date string is an error
+    Given an empty graph
+    When executing query:
+      """
+      RETURN date('not-a-date') AS d
+      """
+    Then a TypeError should be raised at runtime: InvalidArgumentValue
+
+  Scenario: Date from an integer is an error
+    Given an empty graph
+    When executing query:
+      """
+      RETURN date(123) AS d
+      """
+    Then a TypeError should be raised at runtime: InvalidArgumentValue
+
+  Scenario: Stored temporal properties round-trip their type
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:E {d: date('1984-10-11'), t: localdatetime('1984-10-11T12:31:14')})
+      """
+    When executing query:
+      """
+      MATCH (e:E)
+      RETURN toString(e.d) AS d, toString(e.t) AS t
+      """
+    Then the result should be, in any order:
+      | d            | t                     |
+      | '1984-10-11' | '1984-10-11T12:31:14' |
+    And no side effects
+
+  Scenario: Temporal values as query parameters
+    Given an empty graph
+    And parameters are:
+      | y | 1999 |
+    When executing query:
+      """
+      RETURN toString(date({year: $y, month: 12, day: 31})) AS s
+      """
+    Then the result should be, in any order:
+      | s            |
+      | '1999-12-31' |
+    And no side effects
+
+  Scenario: Constructing dates inside a list comprehension
+    Given an empty graph
+    When executing query:
+      """
+      RETURN [m IN [1, 6, 12] | toString(date({year: 2000, month: m}))] AS l
+      """
+    Then the result should be, in any order:
+      | l                                          |
+      | ['2000-01-01', '2000-06-01', '2000-12-01'] |
+    And no side effects
+
+  Scenario: Dates before the epoch
+    Given an empty graph
+    When executing query:
+      """
+      WITH date('1969-07-20') AS d
+      RETURN d.year AS y, d.dayOfWeek AS dow
+      """
+    Then the result should be, in any order:
+      | y    | dow |
+      | 1969 | 7   |
+    And no side effects
+
+  Scenario: Dates far before the epoch keep calendar fields
+    Given an empty graph
+    When executing query:
+      """
+      WITH date('1582-10-15') AS d
+      RETURN d.year AS y, d.month AS m, d.day AS dd
+      """
+    Then the result should be, in any order:
+      | y    | m  | dd |
+      | 1582 | 10 | 15 |
+    And no side effects

@@ -1,0 +1,243 @@
+Feature: TemporalDuration
+
+  Scenario: Duration between two dates decomposes calendar-aware
+    Given an empty graph
+    When executing query:
+      """
+      WITH duration.between(date('1984-10-11'), date('2015-06-24')) AS d
+      RETURN d.years AS y, d.monthsOfYear AS m, d.days AS dd
+      """
+    Then the result should be, in any order:
+      | y  | m | dd |
+      | 30 | 8 | 13 |
+    And no side effects
+
+  Scenario: Duration between anchors at month ends
+    Given an empty graph
+    When executing query:
+      """
+      WITH duration.between(date('2020-01-31'), date('2020-02-29')) AS d
+      RETURN d.months AS m, d.days AS dd
+      """
+    Then the result should be, in any order:
+      | m | dd |
+      | 1 | 0  |
+    And no side effects
+
+  Scenario: Duration between reversed arguments is negative
+    Given an empty graph
+    When executing query:
+      """
+      WITH duration.between(date('2019-03-10'), date('2018-01-15')) AS d
+      RETURN d.months AS m, d.days AS dd
+      """
+    Then the result should be, in any order:
+      | m   | dd |
+      | -13 | -26 |
+    And no side effects
+
+  Scenario: Duration between datetimes keeps the time remainder
+    Given an empty graph
+    When executing query:
+      """
+      WITH duration.between(localdatetime('2019-03-09T11:45:22'),
+                            localdatetime('2019-03-11T12:00:00')) AS d
+      RETURN d.days AS dd, d.hours AS h, d.minutes AS mi
+      """
+    Then the result should be, in any order:
+      | dd | h | mi |
+      | 2  | 0 | 14 |
+    And no side effects
+
+  Scenario: duration.inMonths keeps only whole months
+    Given an empty graph
+    When executing query:
+      """
+      WITH duration.inMonths(date('2018-01-15'), date('2019-03-10')) AS d
+      RETURN d.months AS m, d.days AS dd, d.seconds AS s
+      """
+    Then the result should be, in any order:
+      | m  | dd | s |
+      | 13 | 0  | 0 |
+    And no side effects
+
+  Scenario: duration.inDays flattens months into days
+    Given an empty graph
+    When executing query:
+      """
+      WITH duration.inDays(date('2018-01-15'), date('2019-03-10')) AS d
+      RETURN d.months AS m, d.days AS dd
+      """
+    Then the result should be, in any order:
+      | m | dd  |
+      | 0 | 419 |
+    And no side effects
+
+  Scenario: duration.inDays is negative when reversed
+    Given an empty graph
+    When executing query:
+      """
+      RETURN duration.inDays(date('2019-03-10'), date('2018-01-15')).days AS dd
+      """
+    Then the result should be, in any order:
+      | dd   |
+      | -419 |
+    And no side effects
+
+  Scenario: duration.inSeconds gives the exact second count
+    Given an empty graph
+    When executing query:
+      """
+      WITH duration.inSeconds(localdatetime('2019-03-09T11:45:22'),
+                              localdatetime('2019-03-09T12:00:00')) AS d
+      RETURN d.seconds AS s, d.days AS dd
+      """
+    Then the result should be, in any order:
+      | s   | dd |
+      | 878 | 0  |
+    And no side effects
+
+  Scenario: Duration from an ISO string
+    Given an empty graph
+    When executing query:
+      """
+      WITH duration('P1Y2M10DT12H45M30S') AS d
+      RETURN d.years AS y, d.monthsOfYear AS m, d.days AS dd,
+             d.hours AS h, d.minutes AS mi, d.seconds AS s
+      """
+    Then the result should be, in any order:
+      | y | m | dd | h  | mi  | s     |
+      | 1 | 2 | 10 | 12 | 765 | 45930 |
+    And no side effects
+
+  Scenario: Duration from a week string
+    Given an empty graph
+    When executing query:
+      """
+      RETURN duration('P2W').days AS dd
+      """
+    Then the result should be, in any order:
+      | dd |
+      | 14 |
+    And no side effects
+
+  Scenario: Negative ISO duration
+    Given an empty graph
+    When executing query:
+      """
+      WITH duration('-P1M5D') AS d
+      RETURN d.months AS m, d.days AS dd
+      """
+    Then the result should be, in any order:
+      | m  | dd |
+      | -1 | -5 |
+    And no side effects
+
+  Scenario: Duration from a component map
+    Given an empty graph
+    When executing query:
+      """
+      WITH duration({months: 14, days: 3, hours: 2}) AS d
+      RETURN d.years AS y, d.monthsOfYear AS m, d.days AS dd, d.hours AS h
+      """
+    Then the result should be, in any order:
+      | y | m | dd | h |
+      | 1 | 2 | 3  | 2 |
+    And no side effects
+
+  Scenario: Fractional duration components carry down
+    Given an empty graph
+    When executing query:
+      """
+      WITH duration({days: 1.5}) AS d
+      RETURN d.days AS dd, d.hours AS h
+      """
+    Then the result should be, in any order:
+      | dd | h  |
+      | 1  | 12 |
+    And no side effects
+
+  Scenario: Adding durations adds component-wise
+    Given an empty graph
+    When executing query:
+      """
+      WITH duration('P1M2D') + duration('P2M3DT4H') AS d
+      RETURN d.months AS m, d.days AS dd, d.hours AS h
+      """
+    Then the result should be, in any order:
+      | m | dd | h |
+      | 3 | 5  | 4 |
+    And no side effects
+
+  Scenario: Subtracting durations subtracts component-wise
+    Given an empty graph
+    When executing query:
+      """
+      WITH duration('P3M5D') - duration('P1M7D') AS d
+      RETURN d.months AS m, d.days AS dd
+      """
+    Then the result should be, in any order:
+      | m | dd |
+      | 2 | -2 |
+    And no side effects
+
+  Scenario: Duration equality is component-wise
+    Given an empty graph
+    When executing query:
+      """
+      RETURN duration('P1M') = duration('P1M') AS eq,
+             duration('P1M') = duration('P30D') AS neq
+      """
+    Then the result should be, in any order:
+      | eq   | neq   |
+      | true | false |
+    And no side effects
+
+  Scenario: Duration milliseconds and microseconds accessors
+    Given an empty graph
+    When executing query:
+      """
+      WITH duration('PT1.5S') AS d
+      RETURN d.seconds AS s, d.milliseconds AS ms, d.microseconds AS us
+      """
+    Then the result should be, in any order:
+      | s | ms   | us      |
+      | 1 | 1500 | 1500000 |
+    And no side effects
+
+  Scenario: Stored durations decompose after retrieval
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:E {p: duration('P2M7DT3H')})
+      """
+    When executing query:
+      """
+      MATCH (e:E)
+      RETURN e.p.months AS m, e.p.days AS dd, e.p.hours AS h
+      """
+    Then the result should be, in any order:
+      | m | dd | h |
+      | 2 | 7  | 3 |
+    And no side effects
+
+  Scenario: Unparseable duration string is an error
+    Given an empty graph
+    When executing query:
+      """
+      RETURN duration('P') AS d
+      """
+    Then a TypeError should be raised at runtime: InvalidArgumentValue
+
+  Scenario: duration.between over mixed date and datetime
+    Given an empty graph
+    When executing query:
+      """
+      WITH duration.between(date('2019-03-09'),
+                            localdatetime('2019-03-09T11:45:22')) AS d
+      RETURN d.hours AS h, d.minutes AS mi
+      """
+    Then the result should be, in any order:
+      | h  | mi  |
+      | 11 | 705 |
+    And no side effects

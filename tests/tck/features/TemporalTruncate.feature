@@ -1,0 +1,306 @@
+Feature: TemporalTruncate
+
+  Scenario: Truncate date to millennium
+    Given an empty graph
+    When executing query:
+      """
+      RETURN toString(date.truncate('millennium', date('2019-03-09'))) AS s
+      """
+    Then the result should be, in any order:
+      | s            |
+      | '2000-01-01' |
+    And no side effects
+
+  Scenario: Truncate date to century
+    Given an empty graph
+    When executing query:
+      """
+      RETURN toString(date.truncate('century', date('1987-06-15'))) AS s
+      """
+    Then the result should be, in any order:
+      | s            |
+      | '1900-01-01' |
+    And no side effects
+
+  Scenario: Truncate date to decade
+    Given an empty graph
+    When executing query:
+      """
+      RETURN toString(date.truncate('decade', date('2019-03-09'))) AS s
+      """
+    Then the result should be, in any order:
+      | s            |
+      | '2010-01-01' |
+    And no side effects
+
+  Scenario: Truncate date to year
+    Given an empty graph
+    When executing query:
+      """
+      RETURN toString(date.truncate('year', date('2019-03-09'))) AS s
+      """
+    Then the result should be, in any order:
+      | s            |
+      | '2019-01-01' |
+    And no side effects
+
+  Scenario: Truncate date to quarter
+    Given an empty graph
+    When executing query:
+      """
+      RETURN toString(date.truncate('quarter', date('2019-05-20'))) AS s
+      """
+    Then the result should be, in any order:
+      | s            |
+      | '2019-04-01' |
+    And no side effects
+
+  Scenario: Truncate date to quarter in first quarter
+    Given an empty graph
+    When executing query:
+      """
+      RETURN toString(date.truncate('quarter', date('2019-03-31'))) AS s
+      """
+    Then the result should be, in any order:
+      | s            |
+      | '2019-01-01' |
+    And no side effects
+
+  Scenario: Truncate date to month
+    Given an empty graph
+    When executing query:
+      """
+      RETURN toString(date.truncate('month', date('2019-03-09'))) AS s
+      """
+    Then the result should be, in any order:
+      | s            |
+      | '2019-03-01' |
+    And no side effects
+
+  Scenario: Truncate date to week lands on Monday
+    Given an empty graph
+    When executing query:
+      """
+      WITH date.truncate('week', date('2019-03-09')) AS d
+      RETURN toString(d) AS s, d.dayOfWeek AS dow
+      """
+    Then the result should be, in any order:
+      | s            | dow |
+      | '2019-03-04' | 1   |
+    And no side effects
+
+  Scenario: Truncate date to week across a month boundary
+    Given an empty graph
+    When executing query:
+      """
+      RETURN toString(date.truncate('week', date('2019-03-01'))) AS s
+      """
+    Then the result should be, in any order:
+      | s            |
+      | '2019-02-25' |
+    And no side effects
+
+  Scenario: Truncate date to day is the identity
+    Given an empty graph
+    When executing query:
+      """
+      RETURN toString(date.truncate('day', date('2019-03-09'))) AS s
+      """
+    Then the result should be, in any order:
+      | s            |
+      | '2019-03-09' |
+    And no side effects
+
+  Scenario: Truncate datetime to year
+    Given an empty graph
+    When executing query:
+      """
+      RETURN toString(localdatetime.truncate('year', localdatetime('2019-03-09T11:45:22'))) AS s
+      """
+    Then the result should be, in any order:
+      | s                     |
+      | '2019-01-01T00:00:00' |
+    And no side effects
+
+  Scenario: Truncate datetime to month
+    Given an empty graph
+    When executing query:
+      """
+      RETURN toString(localdatetime.truncate('month', localdatetime('2019-03-09T11:45:22'))) AS s
+      """
+    Then the result should be, in any order:
+      | s                     |
+      | '2019-03-01T00:00:00' |
+    And no side effects
+
+  Scenario: Truncate datetime to day
+    Given an empty graph
+    When executing query:
+      """
+      RETURN toString(localdatetime.truncate('day', localdatetime('2019-03-09T11:45:22'))) AS s
+      """
+    Then the result should be, in any order:
+      | s                     |
+      | '2019-03-09T00:00:00' |
+    And no side effects
+
+  Scenario: Truncate datetime to hour
+    Given an empty graph
+    When executing query:
+      """
+      RETURN toString(localdatetime.truncate('hour', localdatetime('2019-03-09T11:45:22'))) AS s
+      """
+    Then the result should be, in any order:
+      | s                     |
+      | '2019-03-09T11:00:00' |
+    And no side effects
+
+  Scenario: Truncate datetime to minute
+    Given an empty graph
+    When executing query:
+      """
+      RETURN toString(localdatetime.truncate('minute', localdatetime('2019-03-09T11:45:22'))) AS s
+      """
+    Then the result should be, in any order:
+      | s                     |
+      | '2019-03-09T11:45:00' |
+    And no side effects
+
+  Scenario: Truncate datetime to second drops sub-second fields
+    Given an empty graph
+    When executing query:
+      """
+      WITH localdatetime.truncate('second', localdatetime('2019-03-09T11:45:22.987654')) AS t
+      RETURN t.second AS s, t.microsecond AS us
+      """
+    Then the result should be, in any order:
+      | s  | us |
+      | 22 | 0  |
+    And no side effects
+
+  Scenario: Truncate datetime to millisecond keeps whole milliseconds
+    Given an empty graph
+    When executing query:
+      """
+      WITH localdatetime.truncate('millisecond', localdatetime('2019-03-09T11:45:22.987654')) AS t
+      RETURN t.millisecond AS ms, t.microsecond AS us
+      """
+    Then the result should be, in any order:
+      | ms  | us     |
+      | 987 | 987000 |
+    And no side effects
+
+  Scenario: Truncate a datetime down to a date value
+    Given an empty graph
+    When executing query:
+      """
+      WITH date.truncate('month', localdatetime('2019-03-09T11:45:22')) AS d
+      RETURN toString(d) AS s, d.day AS dd
+      """
+    Then the result should be, in any order:
+      | s            | dd |
+      | '2019-03-01' | 1  |
+    And no side effects
+
+  Scenario: Truncate stored date properties to quarter starts
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:E {d: date('2019-01-31')}), (:E {d: date('2019-04-01')}),
+             (:E {d: date('2019-08-09')}), (:E {d: date('2019-12-31')})
+      """
+    When executing query:
+      """
+      MATCH (e:E)
+      RETURN toString(date.truncate('quarter', e.d)) AS q, count(*) AS c
+      ORDER BY q
+      """
+    Then the result should be, in order:
+      | q            | c |
+      | '2019-01-01' | 1 |
+      | '2019-04-01' | 1 |
+      | '2019-07-01' | 1 |
+      | '2019-10-01' | 1 |
+    And no side effects
+
+  Scenario: Grouping by truncated month
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:E {d: date('2019-03-01')}), (:E {d: date('2019-03-31')}),
+             (:E {d: date('2019-04-02')})
+      """
+    When executing query:
+      """
+      MATCH (e:E)
+      WITH date.truncate('month', e.d) AS m, count(*) AS c
+      RETURN toString(m) AS m, c ORDER BY m
+      """
+    Then the result should be, in order:
+      | m            | c |
+      | '2019-03-01' | 2 |
+      | '2019-04-01' | 1 |
+    And no side effects
+
+  Scenario: Truncating a date to an hour is an error
+    Given an empty graph
+    When executing query:
+      """
+      RETURN date.truncate('hour', date('2019-03-09')) AS d
+      """
+    Then a TypeError should be raised at runtime: InvalidArgumentValue
+
+  Scenario: Truncating with an unknown unit is an error
+    Given an empty graph
+    When executing query:
+      """
+      RETURN date.truncate('fortnight', date('2019-03-09')) AS d
+      """
+    Then a TypeError should be raised at runtime: InvalidArgumentValue
+
+  Scenario: date truncate of a datetime to a sub-day unit is an error
+    Given an empty graph
+    When executing query:
+      """
+      RETURN date.truncate('hour', localdatetime('2020-05-05T10:30:00')) AS d
+      """
+    Then a TypeError should be raised at runtime: InvalidArgumentValue
+
+  Scenario: Truncating below the proleptic year range is an error
+    Given an empty graph
+    When executing query:
+      """
+      RETURN date.truncate('millennium', date('0950-01-01')) AS d
+      """
+    Then a TypeError should be raised at runtime: InvalidArgumentValue
+
+  Scenario: Truncating a null propagates null
+    Given an empty graph
+    When executing query:
+      """
+      MATCH (n) RETURN date.truncate('month', n.nope) AS d
+      """
+    Then the result should be empty
+    And no side effects
+
+  Scenario: Truncate week at a year boundary
+    Given an empty graph
+    When executing query:
+      """
+      RETURN toString(date.truncate('week', date('2020-01-01'))) AS s
+      """
+    Then the result should be, in any order:
+      | s            |
+      | '2019-12-30' |
+    And no side effects
+
+  Scenario: Truncate millennium at the boundary year
+    Given an empty graph
+    When executing query:
+      """
+      RETURN toString(date.truncate('millennium', date('2000-01-01'))) AS s
+      """
+    Then the result should be, in any order:
+      | s            |
+      | '2000-01-01' |
+    And no side effects

@@ -1,0 +1,137 @@
+Feature: TypeConversions3
+
+  Scenario: toInteger truncates floats toward zero
+    Given an empty graph
+    When executing query:
+      """
+      RETURN toInteger(3.9) AS a, toInteger(-3.9) AS b
+      """
+    Then the result should be, in any order:
+      | a | b  |
+      | 3 | -3 |
+    And no side effects
+
+  Scenario: toInteger parses integer strings
+    Given an empty graph
+    When executing query:
+      """
+      RETURN toInteger('42') AS a, toInteger('-7') AS b
+      """
+    Then the result should be, in any order:
+      | a  | b  |
+      | 42 | -7 |
+    And no side effects
+
+  Scenario: toInteger of an unparseable string is null
+    Given an empty graph
+    When executing query:
+      """
+      RETURN toInteger('not a number') AS x
+      """
+    Then the result should be, in any order:
+      | x    |
+      | null |
+    And no side effects
+
+  Scenario: toFloat parses decimal strings
+    Given an empty graph
+    When executing query:
+      """
+      RETURN toFloat('3.5') AS a, toFloat('-0.25') AS b
+      """
+    Then the result should be, in any order:
+      | a   | b     |
+      | 3.5 | -0.25 |
+    And no side effects
+
+  Scenario: toFloat of an integer widens
+    Given an empty graph
+    When executing query:
+      """
+      RETURN toFloat(7) AS x
+      """
+    Then the result should be, in any order:
+      | x   |
+      | 7.0 |
+    And no side effects
+
+  Scenario: toFloat of an unparseable string is null
+    Given an empty graph
+    When executing query:
+      """
+      RETURN toFloat('xyz') AS x
+      """
+    Then the result should be, in any order:
+      | x    |
+      | null |
+    And no side effects
+
+  Scenario: toBoolean parses true and false strings
+    Given an empty graph
+    When executing query:
+      """
+      RETURN toBoolean('true') AS t, toBoolean('false') AS f
+      """
+    Then the result should be, in any order:
+      | t    | f     |
+      | true | false |
+    And no side effects
+
+  Scenario: toBoolean of other strings is null
+    Given an empty graph
+    When executing query:
+      """
+      RETURN toBoolean('yes') AS x
+      """
+    Then the result should be, in any order:
+      | x    |
+      | null |
+    And no side effects
+
+  Scenario: toBoolean passes booleans through
+    Given an empty graph
+    When executing query:
+      """
+      RETURN toBoolean(true) AS t, toBoolean(false) AS f
+      """
+    Then the result should be, in any order:
+      | t    | f     |
+      | true | false |
+    And no side effects
+
+  Scenario: Conversions over a mixed stored column
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:E {v: '1'}), (:E {v: '2'}), (:E {v: 'x'})
+      """
+    When executing query:
+      """
+      MATCH (e:E) RETURN toInteger(e.v) AS i ORDER BY i
+      """
+    Then the result should be, in order:
+      | i    |
+      | 1    |
+      | 2    |
+      | null |
+    And no side effects
+
+  Scenario: toString of a date and a duration
+    Given an empty graph
+    When executing query:
+      """
+      RETURN toString(date('2019-03-09')) AS d, toString(duration('P1M2DT3H')) AS u
+      """
+    Then the result should be, in any order:
+      | d            | u          |
+      | '2019-03-09' | 'P1M2DT3H' |
+    And no side effects
+
+  Scenario: Conversion of null is null for every converter
+    Given an empty graph
+    When executing query:
+      """
+      MATCH (n) RETURN toInteger(n.v) AS a
+      """
+    Then the result should be empty
+    And no side effects

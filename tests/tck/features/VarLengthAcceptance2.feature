@@ -1,0 +1,244 @@
+Feature: VarLengthAcceptance2
+
+  Scenario: Fixed-length star variant matches exact hops
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:C1)-[:R]->(:C2)-[:R]->(:C3)-[:R]->(:C4)
+      """
+    When executing query:
+      """
+      MATCH (a:C1)-[:R*2..2]->(b) RETURN count(*) AS c
+      """
+    Then the result should be, in any order:
+      | c |
+      | 1 |
+    And no side effects
+
+  Scenario: Range covers every length in the interval
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:C1)-[:R]->(:C2)-[:R]->(:C3)-[:R]->(:C4)
+      """
+    When executing query:
+      """
+      MATCH (a:C1)-[:R*1..3]->(b) RETURN count(*) AS c
+      """
+    Then the result should be, in any order:
+      | c |
+      | 3 |
+    And no side effects
+
+  Scenario: Zero length binds target to source
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:C1 {v: 7})-[:R]->(:C2)
+      """
+    When executing query:
+      """
+      MATCH (a:C1)-[:R*0..1]->(b) RETURN count(*) AS c
+      """
+    Then the result should be, in any order:
+      | c |
+      | 2 |
+    And no side effects
+
+  Scenario: Zero length respects target labels
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:C1)-[:R]->(:C2)
+      """
+    When executing query:
+      """
+      MATCH (a)-[:R*0..1]->(b:C2) RETURN count(*) AS c
+      """
+    Then the result should be, in any order:
+      | c |
+      | 2 |
+    And no side effects
+
+  Scenario: Relationship uniqueness prunes back-and-forth walks
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (a:N)-[:R]->(b:N)
+      """
+    When executing query:
+      """
+      MATCH (x)-[:R*2..2]-(y) RETURN count(*) AS c
+      """
+    Then the result should be, in any order:
+      | c |
+      | 0 |
+    And no side effects
+
+  Scenario: Undirected var-length walks both orientations
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (a:N)-[:R]->(b:N), (c:N)-[:R]->(b)
+      """
+    When executing query:
+      """
+      MATCH (x:N)-[:R*2..2]-(y:N) RETURN count(*) AS c
+      """
+    Then the result should be, in any order:
+      | c |
+      | 2 |
+    And no side effects
+
+  Scenario: Var-length with a labeled target only
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:A)-[:R]->(:B)-[:R]->(:C), (:A)-[:R]->(:C)
+      """
+    When executing query:
+      """
+      MATCH (a)-[:R*1..2]->(c:C) RETURN count(*) AS c
+      """
+    Then the result should be, in any order:
+      | c |
+      | 3 |
+    And no side effects
+
+  Scenario: Var-length over parallel edges counts each edge path
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (a:N), (b:N), (a)-[:R]->(b), (a)-[:R]->(b)
+      """
+    When executing query:
+      """
+      MATCH (a)-[:R*1..1]->(b) RETURN count(*) AS c
+      """
+    Then the result should be, in any order:
+      | c |
+      | 2 |
+    And no side effects
+
+  Scenario: Two-hop through parallel edges multiplies paths
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (a:N), (b:N), (c:N),
+             (a)-[:R]->(b), (a)-[:R]->(b), (b)-[:R]->(c)
+      """
+    When executing query:
+      """
+      MATCH (a)-[:R*2..2]->(c) RETURN count(*) AS c
+      """
+    Then the result should be, in any order:
+      | c |
+      | 2 |
+    And no side effects
+
+  Scenario: Var-length followed by a fixed relationship
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:S)-[:R]->(:M)-[:R]->(:M2)-[:F]->(:T)
+      """
+    When executing query:
+      """
+      MATCH (s:S)-[:R*1..2]->(m)-[:F]->(t:T) RETURN count(*) AS c
+      """
+    Then the result should be, in any order:
+      | c |
+      | 1 |
+    And no side effects
+
+  Scenario: Relationship list variable has the walk length
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:C1)-[:R]->(:C2)-[:R]->(:C3)
+      """
+    When executing query:
+      """
+      MATCH (a:C1)-[rs:R*1..2]->(b) RETURN size(rs) AS n ORDER BY n
+      """
+    Then the result should be, in order:
+      | n |
+      | 1 |
+      | 2 |
+    And no side effects
+
+  Scenario: Var-length starting at multiple sources
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (s1:S {v: 1}), (s2:S {v: 2}), (m:M),
+             (s1)-[:R]->(m), (s2)-[:R]->(m)
+      """
+    When executing query:
+      """
+      MATCH (s:S)-[:R*1..1]->(m:M) RETURN count(*) AS c
+      """
+    Then the result should be, in any order:
+      | c |
+      | 2 |
+    And no side effects
+
+  Scenario: Undirected zero-or-one length around a single edge
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:N)-[:R]->(:N)
+      """
+    When executing query:
+      """
+      MATCH (a)-[:R*0..1]-(b) RETURN count(*) AS c
+      """
+    Then the result should be, in any order:
+      | c |
+      | 4 |
+    And no side effects
+
+  Scenario: Self-loop participates once per length
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (a:N)-[:R]->(a)
+      """
+    When executing query:
+      """
+      MATCH (x)-[:R*1..2]->(y) RETURN count(*) AS c
+      """
+    Then the result should be, in any order:
+      | c |
+      | 1 |
+    And no side effects
+
+  Scenario: Unlabeled source with labeled target plans from the target
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (x:X)-[:R]->(t1:T), (y:Y)-[:R]->(t2:T), (x2:X2)-[:R]->(y)
+      """
+    When executing query:
+      """
+      MATCH (a)-[:R*1..2]->(t:T) RETURN count(*) AS c
+      """
+    Then the result should be, in any order:
+      | c |
+      | 3 |
+    And no side effects
+
+  Scenario: Var-length between two bound endpoints
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (a:S)-[:R]->(:M)-[:R]->(b:T), (a)-[:R]->(b)
+      """
+    When executing query:
+      """
+      MATCH (a:S), (b:T) MATCH (a)-[:R*1..2]->(b) RETURN count(*) AS c
+      """
+    Then the result should be, in any order:
+      | c |
+      | 2 |
+    And no side effects

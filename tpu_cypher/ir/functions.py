@@ -468,10 +468,123 @@ def _f_duration_between(a, b):
     return Duration(months=months, days=days, seconds=secs, microseconds=us)
 
 
+_TRUNC_UNITS = (
+    "millennium", "century", "decade", "year", "quarter", "month", "week",
+    "day", "hour", "minute", "second", "millisecond", "microsecond",
+)
+
+
+_SUB_DAY_UNITS = ("hour", "minute", "second", "millisecond", "microsecond")
+
+
+def _truncate_temporal(unit: str, v, allow_sub_day: bool):
+    """Shared truncation core (Neo4j ``<type>.truncate(unit, temporal)``):
+    returns a datetime at the start of the requested unit. ``allow_sub_day``
+    is False for ``date.truncate`` — a date cannot carry time fields, so
+    sub-day units are an error regardless of the input's type."""
+    u = str(unit).lower()
+    if u not in _TRUNC_UNITS:
+        raise CypherTypeError(f"Unknown truncation unit {unit!r}")
+    if u in _SUB_DAY_UNITS and not allow_sub_day:
+        raise CypherTypeError(f"Unit {unit!r} is too small to truncate a date to")
+    if isinstance(v, _dt.datetime):
+        y, mo, d = v.year, v.month, v.day
+        h, mi, s, us = v.hour, v.minute, v.second, v.microsecond
+    elif isinstance(v, _dt.date):
+        y, mo, d = v.year, v.month, v.day
+        h = mi = s = us = 0
+        if u in _SUB_DAY_UNITS:
+            raise CypherTypeError(f"Cannot truncate a date to {unit!r}")
+    else:
+        raise CypherTypeError("truncate() expects a temporal value")
+
+    def year_start(yy: int) -> _dt.datetime:
+        if yy < _dt.MINYEAR:  # proleptic range floor (year 0 unrepresentable)
+            raise CypherTypeError(
+                f"Cannot truncate year {y} to {unit!r}: start of unit is out of range"
+            )
+        return _dt.datetime(yy, 1, 1)
+
+    if u == "millennium":
+        return year_start(y - y % 1000)
+    if u == "century":
+        return year_start(y - y % 100)
+    if u == "decade":
+        return year_start(y - y % 10)
+    if u == "year":
+        return _dt.datetime(y, 1, 1)
+    if u == "quarter":
+        return _dt.datetime(y, 3 * ((mo - 1) // 3) + 1, 1)
+    if u == "month":
+        return _dt.datetime(y, mo, 1)
+    if u == "week":
+        monday = _dt.date(y, mo, d) - _dt.timedelta(
+            days=_dt.date(y, mo, d).isoweekday() - 1
+        )
+        return _dt.datetime(monday.year, monday.month, monday.day)
+    if u == "day":
+        return _dt.datetime(y, mo, d)
+    if u == "hour":
+        return _dt.datetime(y, mo, d, h)
+    if u == "minute":
+        return _dt.datetime(y, mo, d, h, mi)
+    if u == "second":
+        return _dt.datetime(y, mo, d, h, mi, s)
+    if u == "millisecond":
+        return _dt.datetime(y, mo, d, h, mi, s, us - us % 1000)
+    return _dt.datetime(y, mo, d, h, mi, s, us)
+
+
+def _f_date_truncate(unit, v):
+    return _truncate_temporal(unit, v, allow_sub_day=False).date()
+
+
+def _f_ldt_truncate(unit, v):
+    return _truncate_temporal(unit, v, allow_sub_day=True)
+
+
+_US_PER_DAY = 86_400 * 1_000_000
+
+
+def _between_micros(a, b) -> int:
+    if isinstance(a, _dt.date) and not isinstance(a, _dt.datetime):
+        a = _dt.datetime(a.year, a.month, a.day)
+    if isinstance(b, _dt.date) and not isinstance(b, _dt.datetime):
+        b = _dt.datetime(b.year, b.month, b.day)
+    delta = b - a
+    return (delta.days * 86400 + delta.seconds) * 1_000_000 + delta.microseconds
+
+
+def _f_duration_inmonths(a, b):
+    """Whole months between (days/seconds dropped — Neo4j duration.inMonths)."""
+    d = _f_duration_between(a, b)
+    return Duration(months=d.months, days=0, seconds=0, microseconds=0)
+
+
+def _f_duration_indays(a, b):
+    """Whole days between, no month component (Neo4j duration.inDays)."""
+    us = _between_micros(a, b)
+    sign = 1 if us >= 0 else -1
+    return Duration(months=0, days=sign * (abs(us) // _US_PER_DAY), seconds=0, microseconds=0)
+
+
+def _f_duration_inseconds(a, b):
+    """Exact seconds+microseconds between (Neo4j duration.inSeconds);
+    ``Duration`` normalizes the raw microsecond count itself."""
+    return Duration(microseconds=_between_micros(a, b))
+
+
 _register("date", _f_date, T.CTDate, min_args=0, max_args=1)
 _register("localdatetime", _f_localdatetime, T.CTLocalDateTime, min_args=0, max_args=1)
+_register("date.truncate", _f_date_truncate, T.CTDate, min_args=2)
+_register(
+    "localdatetime.truncate", _f_ldt_truncate, T.CTLocalDateTime, min_args=2
+)
 _register("duration", _f_duration, T.CTDuration)
 _register("duration.between", _f_duration_between, T.CTDuration, min_args=2)
+_register("duration.inmonths", _f_duration_inmonths, T.CTDuration, min_args=2)
+_register("duration.indays", _f_duration_indays, T.CTDuration, min_args=2)
+_register("duration.inseconds", _f_duration_inseconds, T.CTDuration, min_args=2)
 
 
 # temporal accessors used via property syntax (d.year, d.month, ...)
