@@ -273,12 +273,14 @@ def test_distinct_endpoints_count_fused_matches_oracle(monkeypatch):
         "MATCH (a:P)-[:K]->(b)-[:K]->(c) WITH DISTINCT c RETURN count(*) AS x",
         "MATCH (a:P)-[:K]->(b)-[:K]->(c) WITH DISTINCT a RETURN count(*) AS x",
         "MATCH (a)<-[:K]-(b)<-[:K]-(c:Q) WITH DISTINCT a, c RETURN count(*) AS x",
-        "MATCH (a)-[:K]->(b)-[:K]->(c)-[:K]->(d:P) WITH DISTINCT a, d RETURN count(*) AS x",
     ]
-    # plans as a STAR from the labeled middle node (two expands sharing
-    # frontier b) — not a linear chain, must fall back and stay correct
+    # not fused, must stay correct: the star shape (two expands sharing
+    # frontier b), and a 3-hop chain whose NON-adjacent relationship-
+    # uniqueness predicate (r0 <> r2 can be violated via a 2-cycle, not
+    # just a self-loop) cannot be dropped, so the filter stays planned
     unfused_queries = [
         "MATCH (a)-[:K]->(b:Q)-[:K]->(c) WITH DISTINCT a, c RETURN count(*) AS x",
+        "MATCH (a)-[:K]->(b)-[:K]->(c)-[:K]->(d:P) WITH DISTINCT a, d RETURN count(*) AS x",
     ]
     gl = CypherSession.local().create_graph_from_create_query(create)
     gt = CypherSession.tpu().create_graph_from_create_query(create)

@@ -375,6 +375,38 @@ class TpuEvaluator:
             return self._temporal_accessor(self.eval(expr.expr), expr.key)
         raise TpuUnsupportedExpr(type(expr).__name__)
 
+    def _device_truncate(self, fn_name: str, unit: str, arg: E.Expr) -> Column:
+        from .temporal import US_PER_DAY, truncate_days, truncate_ldt_micros
+
+        inner = self.eval(arg)
+        to_date = fn_name == "date.truncate"
+        if inner.kind == DATE:
+            if not to_date and unit not in (
+                "day", "week", "month", "quarter", "year",
+            ):
+                raise TpuUnsupportedExpr("ldt truncate of a date (host path)")
+            out = truncate_days(unit, inner.data)
+            if out is None:
+                raise TpuUnsupportedExpr(f"truncate unit {unit}")
+            if to_date:
+                return Column(DATE, out.astype(jnp.int32), inner.valid)
+            return Column(LDT, out * US_PER_DAY, inner.valid)
+        if inner.kind == LDT:
+            if to_date:
+                days = truncate_days(
+                    unit if unit != "day" else "day",
+                    jnp.floor_divide(inner.data.astype(jnp.int64), US_PER_DAY),
+                )
+                if days is None or unit in ("hour", "minute", "second",
+                                            "millisecond", "microsecond"):
+                    raise TpuUnsupportedExpr(f"truncate unit {unit}")
+                return Column(DATE, days.astype(jnp.int32), inner.valid)
+            out = truncate_ldt_micros(unit, inner.data)
+            if out is None:
+                raise TpuUnsupportedExpr(f"truncate unit {unit}")
+            return Column(LDT, out, inner.valid)
+        raise TpuUnsupportedExpr(f"truncate over {inner.kind}")
+
     def _temporal_accessor(self, inner: Column, key: str) -> Column:
         """Calendar-field accessors over device temporal columns: branch-free
         civil-calendar math on the VPU (``backend.tpu.temporal``)."""
@@ -672,6 +704,15 @@ class TpuEvaluator:
             if f.null_prop and any(c is None for c in consts):
                 return constant_column(None, self.n)
             return constant_column(f.fn(*consts), self.n)
+        if (
+            name in ("date.truncate", "localdatetime.truncate")
+            and len(expr.args) == 2
+            and isinstance(consts[0], str)
+        ):
+            # constant unit over a temporal device column: branch-free
+            # calendar truncation on the VPU (the reference's biggest
+            # temporal UDF family, TemporalUdfs.scala truncate variants)
+            return self._device_truncate(name, consts[0].lower(), expr.args[1])
         args = [self.eval(a) for a in expr.args]
         if name == "abs" and args[0].kind in (I64, F64):
             return Column(args[0].kind, jnp.abs(args[0].data), args[0].valid)

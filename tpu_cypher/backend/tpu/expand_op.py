@@ -966,6 +966,119 @@ def plan_var_expand_fastpath(planner, op, lhs, rhs, classic) -> Optional[Relatio
     )
 
 
+def _rel_neq_pair(pred) -> Optional[Tuple[str, str]]:
+    """Recognize a relationship-uniqueness predicate id(a) <> id(b) over two
+    relationship variables (the shape ``ir.builder`` emits)."""
+    from ...api import types as T
+
+    if not isinstance(pred, E.Neq):
+        return None
+    l, r = pred.lhs, pred.rhs
+    if not (isinstance(l, E.Id) and isinstance(r, E.Id)):
+        return None
+    lv, rv = l.expr, r.expr
+    if not (isinstance(lv, E.Var) and isinstance(rv, E.Var)):
+        return None
+    for v in (lv, rv):
+        t = getattr(v, "cypher_type", None)
+        if t is None or not isinstance(t.material, T.CTRelationshipType):
+            return None
+    return lv.name, rv.name
+
+
+def _graph_loop_free(graph_obj, types_key, ctx) -> bool:
+    """True when no relationship of the type set is a self-loop (host-cached
+    on the GraphIndex)."""
+    gi = GraphIndex.of(graph_obj)
+    cache = getattr(gi, "_loop_free", None)
+    if cache is None:
+        cache = gi._loop_free = {}
+    got = cache.get(types_key)
+    if got is None:
+        try:
+            s, d, _ = gi._edge_endpoints(types_key, ctx)
+        except (GraphIndexError, TpuBackendError):
+            cache[types_key] = False
+            return False
+        got = cache[types_key] = not bool((s == d).any())
+    return got
+
+
+def plan_filter_fastpath(planner, op, child) -> Optional[RelationalOperator]:
+    """Drop a relationship-uniqueness filter that is PROVABLY redundant over
+    a fused expand subtree, so count(*)/DISTINCT chains keep their whole-plan
+    fusion (the openCypher isomorphism predicates the IR now adds would
+    otherwise force the chain to materialize just to compare edge ids):
+
+    * adjacent DIRECTED chain hops: the same relationship at positions i and
+      i+1 requires a self-loop — redundant when both type sets are loop-free;
+    * an ExpandInto closing a directed chain's endpoints vs ANY chain rel:
+      edge identity forces all endpoints equal, i.e. a self-loop — same
+      loop-free condition.
+
+    Anything else (non-adjacent chain pairs, undirected hops, loops present,
+    non-fused subtrees) keeps the filter. Returns the CHILD to drop the
+    filter, or None to keep the generic plan. The local oracle has no such
+    hook and evaluates every predicate literally — differential tests hold."""
+    from ...relational.ops import CacheOp
+
+    pair = _rel_neq_pair(op.predicate)
+    if pair is None:
+        return None
+    node = child
+    while isinstance(node, CacheOp):
+        node = node.children[0]
+
+    def chain_adjacent_redundant(chain_op: "CsrExpandOp", ra: str, rb: str) -> bool:
+        hops = chain_op._chain_hops()
+        if any(h.undirected for h in hops):
+            return False
+        rels = [h.rel_fld for h in hops]
+        if ra not in rels or rb not in rels:
+            return False
+        i, j = sorted((rels.index(ra), rels.index(rb)))
+        if j != i + 1:
+            return False  # non-adjacent reuse needs only a cycle, not a loop
+        return _graph_loop_free(
+            chain_op._graph_obj, hops[i].types_key, chain_op.context
+        ) and _graph_loop_free(
+            chain_op._graph_obj, hops[j].types_key, chain_op.context
+        )
+
+    if isinstance(node, CsrExpandIntoOp) and not node.undirected:
+        in_op = node.children[0]
+        while isinstance(in_op, CacheOp):
+            in_op = in_op.children[0]
+        if isinstance(in_op, CsrExpandOp) and in_op._graph_obj is node._graph_obj:
+            hops = in_op._chain_hops()
+            rels = [h.rel_fld for h in hops]
+            base = hops[-1]
+            ends_ok = (
+                {node.source_fld, node.target_fld}
+                == {base.frontier_fld, in_op.far_fld}
+                and base.frontier_fld != in_op.far_fld
+            )
+            if node.rel_fld in pair and ends_ok and not any(
+                h.undirected for h in hops
+            ):
+                other = pair[0] if pair[1] == node.rel_fld else pair[1]
+                if other in rels:
+                    h_other = hops[rels.index(other)]
+                    if _graph_loop_free(
+                        node._graph_obj, node.types_key, node.context
+                    ) and _graph_loop_free(
+                        node._graph_obj, h_other.types_key, node.context
+                    ):
+                        return child
+            if set(pair) <= set(rels) and chain_adjacent_redundant(in_op, *pair):
+                return child
+        return None
+    if isinstance(node, CsrExpandOp):
+        if chain_adjacent_redundant(node, *pair):
+            return child
+    return None
+
+
 def plan_expand_into_fastpath(planner, op, in_plan, classic) -> Optional[RelationalOperator]:
     if op.direction not in (">", "-"):
         return None

@@ -1006,6 +1006,12 @@ class TpuTable(Table):
 
         return plan_optional_expand_fastpath(planner, op, lhs, rhs, classic)
 
+    @staticmethod
+    def plan_filter_fastpath(planner, op, child):
+        from .expand_op import plan_filter_fastpath
+
+        return plan_filter_fastpath(planner, op, child)
+
 
 def _float_as_exact_int(c: Column) -> Column:
     """An F64 key column recast for EXACT equality against int64 keys:

@@ -149,6 +149,47 @@ def date_accessor(key: str, days):
     return None
 
 
+_TRUNC_UNIT_US = {
+    "hour": 3600 * US_PER_SECOND,
+    "minute": 60 * US_PER_SECOND,
+    "second": US_PER_SECOND,
+    "millisecond": 1000,
+    "microsecond": 1,
+}
+
+
+def truncate_days(unit: str, days):
+    """Truncate a days-since-epoch array to the start of ``unit`` (day-or-
+    coarser units; proleptic-range-risky millennium/century/decade return
+    None — callers fall back to the host, which raises properly on year 0)."""
+    if unit == "day":
+        return days.astype(jnp.int64)
+    if unit == "week":
+        return days.astype(jnp.int64) - (iso_weekday(days) - 1)
+    y, m, _ = civil_from_days(days)
+    one = jnp.ones_like(y)
+    if unit == "year":
+        return days_from_civil(y, one, one)
+    if unit == "quarter":
+        return days_from_civil(y, 3 * ((m - 1) // 3) + 1, one)
+    if unit == "month":
+        return days_from_civil(y, m, one)
+    return None
+
+
+def truncate_ldt_micros(unit: str, us):
+    """Truncate a micros-since-epoch array to the start of ``unit``; None
+    for unsupported units."""
+    days, tod = split_ldt(us)
+    if unit in _TRUNC_UNIT_US:
+        u = _TRUNC_UNIT_US[unit]
+        return days * US_PER_DAY + (tod - tod % u)
+    tdays = truncate_days(unit, days)
+    if tdays is None:
+        return None
+    return tdays * US_PER_DAY
+
+
 def time_accessor(key: str, tod):
     """Accessor over a time-of-day micros array -> int64 data or None."""
     if key == "hour":

@@ -196,9 +196,19 @@ class IRBuilder:
     # ------------------------------------------------------------------
 
     def convert_pattern(
-        self, pattern: A.Pattern, env: Dict[str, CypherType]
+        self,
+        pattern: A.Pattern,
+        env: Dict[str, CypherType],
+        rel_uniqueness: bool = True,
     ) -> Tuple[IRPattern, List[E.Expr]]:
-        """Frontend pattern -> IRPattern + lowered property predicates."""
+        """Frontend pattern -> IRPattern + lowered property predicates.
+
+        ``rel_uniqueness`` adds the openCypher per-MATCH relationship-
+        isomorphism predicates ``id(r_i) <> id(r_j)`` for every pair of
+        fixed-length relationship variables whose type sets can intersect —
+        the rewrite Neo4j's frontend performs (AddUniquenessPredicates)
+        before the reference ever sees the query. CONSTRUCT patterns define
+        NEW elements and pass False."""
         ir = IRPattern()
         predicates: List[E.Expr] = []
 
@@ -285,6 +295,27 @@ class IRBuilder:
                         f"Path variable {part.path_var!r} already bound"
                     )
                 ir.paths[part.path_var] = tuple(path_fields)
+        if rel_uniqueness:
+            fixed = [
+                r for r, conn in ir.topology.items() if not conn.is_var_length
+            ]
+            for i in range(len(fixed)):
+                for j in range(i + 1, len(fixed)):
+                    r1, r2 = fixed[i], fixed[j]
+                    t1 = ir.rel_types[r1].types or None  # None/empty = any
+                    t2 = ir.rel_types[r2].types or None
+                    if t1 is not None and t2 is not None and not (set(t1) & set(t2)):
+                        continue  # disjoint types can never be the same rel
+                    predicates.append(
+                        E.Neq(
+                            E.Id(E.Var(r1).with_type(ir.rel_types[r1])).with_type(
+                                T.CTInteger
+                            ),
+                            E.Id(E.Var(r2).with_type(ir.rel_types[r2])).with_type(
+                                T.CTInteger
+                            ),
+                        ).with_type(T.CTBoolean)
+                    )
         return ir, predicates
 
     # ------------------------------------------------------------------
@@ -474,7 +505,7 @@ class IRBuilder:
         new_props: List[Tuple[str, str, E.Expr]] = []
         cloned = {new for new, _ in clones}
         for pat in c.news:
-            ir, preds = self.convert_pattern(pat, clone_env)
+            ir, preds = self.convert_pattern(pat, clone_env, rel_uniqueness=False)
             for n, t in ir.node_types.items():
                 if n in clone_env:
                     # references an existing/cloned entity: an implicit clone

@@ -132,7 +132,13 @@ class RelationalPlanner:
     # -- unary ----------------------------------------------------------
 
     def _plan_Filter(self, op: L.Filter) -> RelationalOperator:
-        return FilterOp(self.process(op.in_op), op.predicate)
+        child = self.process(op.in_op)
+        fast = getattr(self.ctx.table_cls, "plan_filter_fastpath", None)
+        if fast is not None:
+            out = fast(self, op, child)
+            if out is not None:
+                return out
+        return FilterOp(child, op.predicate)
 
     def _plan_BindPath(self, op: L.BindPath) -> RelationalOperator:
         return PathBindOp(self.process(op.in_op), op.path_var, op.entities)
