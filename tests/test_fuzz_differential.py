@@ -127,9 +127,58 @@ def _gen_query(rng) -> str:
     )
 
 
+def _graph_args_adversarial(seed):
+    """Self-loops KEPT, plus duplicated (parallel) edges and fork-heavy
+    hubs — the graph class where relationship-uniqueness semantics bite
+    (round-3 regression: fork patterns overcounted on these shapes)."""
+    rng = np.random.default_rng(seed)
+    ids = np.arange(N, dtype=np.int64) * 7 + 5
+    src = rng.integers(0, N, E)
+    dst = rng.integers(0, N, E)
+    # hub bias: route one edge in five into a handful of shared endpoints
+    hub = rng.integers(0, 5, E)
+    dst = np.where(rng.random(E) < 0.2, hub, dst)
+    # parallel edges: duplicate a slice verbatim; self-loops: pin a few
+    src = np.concatenate([src, src[:30], np.arange(10)])
+    dst = np.concatenate([dst, dst[:30], np.arange(10)])
+    n_e = len(src)
+    nums = [_NUM_POOL[rng.integers(0, len(_NUM_POOL))] for _ in range(N)]
+    strs = [_STR_POOL[rng.integers(0, len(_STR_POOL))] for _ in range(N)]
+    ws = [None if rng.random() < 0.15 else int(rng.integers(0, 9)) for _ in range(n_e)]
+    return ids, src, dst, nums, strs, ws
+
+
+def _gen_uniqueness_query(rng) -> str:
+    """Shapes whose results differ between homomorphic and isomorphic
+    relationship matching: forks, cycles, closes, distinct-through-fork."""
+    return str(
+        rng.choice(
+            [
+                "MATCH (a)-[r1:R]->(b)<-[r2:R]-(c) RETURN count(*) AS c",
+                "MATCH (a)<-[r1:R]-(b)-[r2:R]->(c) RETURN count(*) AS c",
+                "MATCH (a:N)-[:R]->(b)-[:R]->(c) RETURN count(*) AS c",
+                "MATCH (a)-[:R]->(b)-[:R]->(a) RETURN count(*) AS c",
+                "MATCH (a)-[:R]->(b)-[:R]->(c)-[:R]->(a) RETURN count(*) AS c",
+                "MATCH (x)-[r1:R]->(y), (x)-[r2:R]->(y) RETURN count(*) AS c",
+                "MATCH (a)-[r1:R]->(b)<-[r2:R]-(c) WITH DISTINCT a, c "
+                "RETURN count(*) AS c",
+                "MATCH (a)-[r1:R]->(b)<-[r2:R]-(c) "
+                "RETURN id(r1) < id(r2) AS o, count(*) AS c ORDER BY o",
+                "MATCH (a:N)-[:R*1..2]->(b) RETURN count(*) AS c",
+            ]
+        )
+    )
+
+
 @pytest.fixture(scope="module")
 def fuzz_graphs():
     args = _graph_args(20260730)
+    return _build(CypherSession.local(), *args), _build(CypherSession.tpu(), *args)
+
+
+@pytest.fixture(scope="module")
+def fuzz_graphs_adversarial():
+    args = _graph_args_adversarial(20260731)
     return _build(CypherSession.local(), *args), _build(CypherSession.tpu(), *args)
 
 
@@ -139,6 +188,19 @@ def test_fuzz_differential(fuzz_graphs, qseed):
     rng = np.random.default_rng(1000 + qseed)
     for _ in range(8):
         q = str(_gen_query(rng))
+        want = gl.cypher(q).records.to_bag()
+        got = gt.cypher(q).records.to_bag()
+        assert got == want, f"\nquery: {q}\ntpu: {got!r}\nlocal: {want!r}"
+
+
+@pytest.mark.parametrize("qseed", range(4))
+def test_fuzz_differential_adversarial(fuzz_graphs_adversarial, qseed):
+    gl, gt = fuzz_graphs_adversarial
+    rng = np.random.default_rng(3000 + qseed)
+    for _ in range(6):
+        q = _gen_uniqueness_query(rng) if rng.random() < 0.7 else str(
+            _gen_query(rng)
+        )
         want = gl.cypher(q).records.to_bag()
         got = gt.cypher(q).records.to_bag()
         assert got == want, f"\nquery: {q}\ntpu: {got!r}\nlocal: {want!r}"

@@ -52,42 +52,70 @@ def test_close_count_differential(query):
 
 
 def test_close_count_self_loops_and_cycles():
-    # self-loops close onto themselves; both backends use homomorphic
-    # relationship matching, so the counts must agree exactly
-    for create in (
-        "CREATE (x:N)-[:K]->(x)",
-        "CREATE (x:N)-[:K]->(y:N), (y)-[:K]->(x), (x)-[:K]->(x)",
+    # self-loops can only close onto themselves; openCypher rel-isomorphism
+    # (pairwise-distinct relationships per MATCH) makes most of these 0 —
+    # both backends must agree exactly
+    for create, expected in (
+        ("CREATE (x:N)-[:K]->(x)", 0),
+        ("CREATE (x:N)-[:K]->(y:N), (y)-[:K]->(x), (x)-[:K]->(x)", 3),
     ):
         g_local, g_tpu = _pair(create)
         lv = [dict(r) for r in g_local.cypher(TRIANGLE).records.collect()]
         tv = [dict(r) for r in g_tpu.cypher(TRIANGLE).records.collect()]
-        assert tv == lv
+        assert tv == lv == [{"t": expected}]
 
 
-def test_close_count_uses_fused_program(monkeypatch):
-    """The triangle count(*) must go through into_close_count (no chain
-    materialization): assert the fused program runs and the materializing
-    into_probe does NOT."""
-    calls = {"close": 0, "probe": 0}
+@pytest.mark.parametrize("seed,loopy", [(3, True), (11, False)])
+def test_close_count_uses_fused_program(monkeypatch, seed, loopy):
+    """The triangle count(*) must go through a fused close-count program
+    (no chain materialization) WHETHER OR NOT the graph has self-loops:
+    loop-free graphs drop the uniqueness filters by proof and run
+    into_close_count; loopy graphs enforce them in-kernel via
+    into_close_count_unique. The materializing into_probe must not run."""
+    calls = {"close": 0, "unique": 0, "probe": 0}
     orig_close = J.into_close_count
+    orig_unique = J.into_close_count_unique
     orig_probe = J.into_probe
 
     def spy_close(*a, **k):
         calls["close"] += 1
         return orig_close(*a, **k)
 
+    def spy_unique(*a, **k):
+        calls["unique"] += 1
+        return orig_unique(*a, **k)
+
     def spy_probe(*a, **k):
         calls["probe"] += 1
         return orig_probe(*a, **k)
 
     monkeypatch.setattr(J, "into_close_count", spy_close)
+    monkeypatch.setattr(J, "into_close_count_unique", spy_unique)
     monkeypatch.setattr(J, "into_probe", spy_probe)
-    g = CypherSession.tpu().create_graph_from_create_query(
-        _random_create(3, 20, 80)
-    )
-    g.cypher(TRIANGLE).records.collect()
-    assert calls["close"] == 1
+    create = _random_create(seed, 20, 80)
+    if not loopy:
+        create = _random_create_loop_free(seed, 20, 80)
+    g_local = CypherSession.local().create_graph_from_create_query(create)
+    g_tpu = CypherSession.tpu().create_graph_from_create_query(create)
+    expected = [dict(r) for r in g_local.cypher(TRIANGLE).records.collect()]
+    got = [dict(r) for r in g_tpu.cypher(TRIANGLE).records.collect()]
+    assert got == expected
+    # loop-free graphs drop the filters by PROOF (plain kernel); loopy
+    # graphs must route through the in-kernel enforcement variant
+    if loopy:
+        assert (calls["close"], calls["unique"]) == (0, 1)
+    else:
+        assert (calls["close"], calls["unique"]) == (1, 0)
     assert calls["probe"] == 0
+
+
+def _random_create_loop_free(seed, n, e):
+    rng = np.random.default_rng(seed)
+    src = rng.integers(0, n, e)
+    dst = (src + 1 + rng.integers(0, n - 1, e)) % n
+    parts = [f"(n{i}:N)" for i in range(n)]
+    parts += [f"(n{s})-[:K]->(n{d})" for s, d in zip(src, dst)]
+    return "CREATE " + ", ".join(parts)
 
 
 def test_close_count_materializes_when_columns_needed():

@@ -1,0 +1,166 @@
+"""openCypher relationship-uniqueness (rel-isomorphism) semantics: the
+fixed-length pattern rewrite (``ir/builder.py`` — Neo4j's
+AddUniquenessPredicates analog) plus the TPU backend's two filter-resolution
+mechanisms:
+
+* PROOF: ``_rel_uniqueness_redundant`` drops filters whose violation would
+  force a self-loop of a loop-free type set (keeps SpMV count fusion);
+* ENFORCEMENT: ``enforced_pairs`` re-imposes undroppable filters inside the
+  fused programs (carried edge ids / probe-range subtraction) and via id
+  masks on materializing paths.
+
+Every case here is the round-3 regression class: fork patterns, shared
+endpoints, parallel edges, self-loops, mixed type sets — checked against
+the oracle AND against hand-computed expected values. Reference semantics:
+``VarLengthExpandPlanner.scala:107-165`` per-step ``id(r_i) <> id(r_j)``
+filters."""
+
+import pytest
+
+from tpu_cypher import CypherSession
+
+
+def _pair(create):
+    return (
+        CypherSession.local().create_graph_from_create_query(create),
+        CypherSession.tpu().create_graph_from_create_query(create),
+    )
+
+
+def _both(create, query):
+    gl, gt = _pair(create)
+    lv = [dict(r) for r in gl.cypher(query).records.collect()]
+    tv = [dict(r) for r in gt.cypher(query).records.collect()]
+    assert tv == lv, f"{query}: tpu {tv} vs oracle {lv}"
+    return tv
+
+
+FORK3 = (
+    "CREATE (x1:N)-[:K]->(y:N), (x2:N)-[:K]->(y), (x3:N)-[:K]->(y)"
+)
+
+CASES = [
+    # single edge: the shared-endpoint fork patterns can never bind two
+    # distinct relationships (ADVICE r3: TPU returned 1, oracle 0)
+    ("CREATE (a:N)-[:K]->(b:N)",
+     "MATCH (x)-[r1:K]->(y)<-[r2:K]-(z) RETURN count(*) AS c", 0),
+    ("CREATE (a:N)-[:K]->(b:N)",
+     "MATCH (x)<-[r1:K]-(y)-[r2:K]->(z) RETURN count(*) AS c", 0),
+    # 1-hop chain closed in the SAME orientation: same edge imposes no
+    # endpoint constraint at all, so the filter genuinely bites
+    ("CREATE (a:N)-[:K]->(b:N)",
+     "MATCH (x)-[r1:K]->(y), (x)-[r2:K]->(y) RETURN count(*) AS c", 0),
+    # ... but parallel edges satisfy it pairwise
+    ("CREATE (a:N)-[:K]->(b:N), (a)-[:K]->(b)",
+     "MATCH (x)-[r1:K]->(y), (x)-[r2:K]->(y) RETURN count(*) AS c", 2),
+    # 3-source fork: 9 homomorphic pairs, 6 with r1 <> r2 (the TCK
+    # MatchAcceptance3 shape that shipped wrong at round-3 HEAD)
+    (FORK3,
+     "MATCH (a)-[r1:K]->(b)<-[r2:K]-(c) RETURN count(*) AS c", 6),
+    (FORK3,
+     "MATCH (a)-->(b)<--(c) RETURN count(*) AS c", 6),
+    # mixed type sets: the forced self-loop belongs to the MIDDLE hop's
+    # type (L, which has one) — dropping id(r1)<>id(r3) by checking only
+    # K's loop-freeness overcounts (ADVICE r3 case)
+    ("CREATE (a:N)-[:K]->(b:N)-[:L]->(c:N), (a)-[:K]->(c), (b)-[:L]->(b)",
+     "MATCH (x)-[r1:K]->(y)-[r2:L]->(z), (x)-[r3:K]->(z) "
+     "RETURN count(*) AS c", 1),
+    # 4-cycle over a 2-cycle graph: needs 4 pairwise-distinct rels, only 2
+    # exist (homomorphic matching would count 2) — exercises NON-adjacent
+    # chain pairs (r1,r3) and deep close partners in the fused walk
+    ("CREATE (a:N)-[:K]->(b:N), (b)-[:K]->(a)",
+     "MATCH (x)-[:K]->(y)-[:K]->(z)-[:K]->(w)-[:K]->(x) "
+     "RETURN count(*) AS c", 0),
+    # triangle on a 3-cycle plus a self-loop: the loop cannot complete a
+    # triangle under isomorphism (it would have to serve two roles)
+    ("CREATE (a:N)-[:K]->(b:N)-[:K]->(c:N)-[:K]->(a), (a)-[:K]->(a)",
+     "MATCH (x)-[:K]->(y)-[:K]->(z)-[:K]->(x) RETURN count(*) AS c", 3),
+    # two loops at one node: a triangle needs 3 distinct, only 2 exist
+    ("CREATE (x:N)-[:K]->(x), (x)-[:K]->(x)",
+     "MATCH (a)-[:K]->(b)-[:K]->(c)-[:K]->(a) RETURN count(*) AS c", 0),
+    # three loops: 3! ordered triples
+    ("CREATE (x:N)-[:K]->(x), (x)-[:K]->(x), (x)-[:K]->(x)",
+     "MATCH (a)-[:K]->(b)-[:K]->(c)-[:K]->(a) RETURN count(*) AS c", 6),
+    # DISTINCT endpoints through an enforced fork: 6 ordered (a,c) pairs
+    # survive r1 <> r2 (homomorphic adds the 3 (x_i, x_i) pairs)
+    (FORK3,
+     "MATCH (a)-[r1:K]->(b)<-[r2:K]-(c) WITH DISTINCT a, c "
+     "RETURN count(*) AS c", 6),
+    # chain count on a graph WITH a self-loop: proof fails, walk enforces
+    ("CREATE (a:N)-[:K]->(b:N)-[:K]->(c:N), (b)-[:K]->(b)",
+     "MATCH (x)-[:K]->(y)-[:K]->(z) RETURN count(*) AS c", 3),
+    # predicates spanning MATCH clauses: (r1,r2) is NOT constrained (rel
+    # uniqueness is per MATCH), so r1=r2=the loop is legal and the one
+    # candidate closing edge must be excluded exactly ONCE — the fused
+    # probe subtraction must dedup same-edge close partners
+    ("CREATE (u:N)-[:K]->(u)",
+     "MATCH (a)-[r1:K]->(b) MATCH (b)-[r2:K]->(c) MATCH (a)-[r3:K]->(c) "
+     "WHERE id(r3) <> id(r1) AND id(r3) <> id(r2) RETURN count(*) AS c", 0),
+    ("CREATE (u:N)-[:K]->(u), (u)-[:K]->(u)",
+     "MATCH (a)-[r1:K]->(b) MATCH (b)-[r2:K]->(c) MATCH (a)-[r3:K]->(c) "
+     "WHERE id(r3) <> id(r1) AND id(r3) <> id(r2) RETURN count(*) AS c", 2),
+]
+
+
+@pytest.mark.parametrize("create,query,expected", CASES)
+def test_uniqueness_semantics(create, query, expected):
+    assert _both(create, query) == [{"c": expected}]
+
+
+def test_uniqueness_materializing_paths():
+    """Non-count consumers (RETURN of columns) run the materializing fused
+    paths, which enforce via element-id masks."""
+    q = (
+        "MATCH (a)-[r1:K]->(b)<-[r2:K]-(c) "
+        "RETURN id(a) AS x, id(c) AS z ORDER BY x, z"
+    )
+    rows = _both(FORK3, q)
+    assert len(rows) == 6
+    assert all(r["x"] != r["z"] for r in rows)
+
+
+def test_uniqueness_expand_into_materializing():
+    """ExpandInto materializing path with an enforced close pair."""
+    create = "CREATE (a:N)-[:K]->(b:N), (a)-[:K]->(b)"
+    q = (
+        "MATCH (x)-[r1:K]->(y), (x)-[r2:K]->(y) "
+        "RETURN id(r1) AS i, id(r2) AS j ORDER BY i, j"
+    )
+    rows = _both(create, q)
+    assert len(rows) == 2
+    assert all(r["i"] != r["j"] for r in rows)
+
+
+def test_proof_preserves_spmv_on_loop_free(monkeypatch):
+    """On a loop-free graph the adjacent-pair filters drop by PROOF, so the
+    2-hop count(*) keeps the whole-chain SpMV program (no edge-carrying
+    walk)."""
+    from tpu_cypher.backend.tpu import jit_ops as J
+
+    calls = {"spmv": 0, "walk": 0}
+    orig_chain = J.path_count_chain
+    orig_walk = J.chain_count_final_unique
+
+    def spy_chain(*a, **k):
+        calls["spmv"] += 1
+        return orig_chain(*a, **k)
+
+    def spy_walk(*a, **k):
+        calls["walk"] += 1
+        return orig_walk(*a, **k)
+
+    monkeypatch.setattr(J, "path_count_chain", spy_chain)
+    monkeypatch.setattr(J, "chain_count_final_unique", spy_walk)
+    g = CypherSession.tpu().create_graph_from_create_query(
+        "CREATE (a:N)-[:K]->(b:N)-[:K]->(c:N), (c)-[:K]->(a), (b)-[:K]->(a)"
+    )
+    got = [
+        dict(r)
+        for r in g.cypher(
+            "MATCH (x)-[:K]->(y)-[:K]->(z) RETURN count(*) AS c"
+        ).records.collect()
+    ]
+    # 2-hop paths: a->b->{c,a}, b->c->a, b->a->b, c->a->b
+    assert got == [{"c": 5}]
+    assert calls["spmv"] == 1
+    assert calls["walk"] == 0

@@ -44,32 +44,117 @@ def _owner_name(e: E.Expr) -> Optional[str]:
     return None
 
 
-def _fused_chain_walk(gi: GraphIndex, ctx, hops, id_col: Column, final):
+def _fused_chain_walk(
+    gi: GraphIndex, ctx, hops, id_col: Column, final,
+    carry_rels=frozenset(), mask_pairs=None,
+):
     """Walk a stacked expand chain carrying only (base endpoint key, current
     position, liveness) per partial path — the shared spine of the fused
     DISTINCT-endpoints count and the fused ExpandInto close count. Middle
     hops run ``distinct_hop_materialize``; at the OUTERMOST hop (``hops[0]``)
-    ``final(rp, ci, pos, deg, akey, mask, total)`` fuses the terminal
-    computation. Returns final's int, or 0 when any hop empties."""
+    ``final(rp, ci, eo, pos, deg, akey, mask, prevs, order, mask_idx,
+    total)`` fuses the terminal computation. Returns final's int, or 0 when
+    any hop empties.
+
+    Relationship uniqueness (openCypher isomorphism — the reference's
+    per-pair ``id(r_i) <> id(r_j)`` filters, Neo4j ``AddUniquenessPredicates``)
+    is enforced inside the walk: ``carry_rels`` names hops whose edge scan
+    rows ride along per partial path, and ``mask_pairs[late_rel]`` lists the
+    carried rels that hop's edge must differ from (violating paths die, as
+    in ``varlen_hop``). ``final`` receives the carried arrays (``prevs``,
+    name-sorted per ``order``) plus its own ``mask_idx``."""
     gi.node_ids(ctx)
     if gi.num_nodes == 0:
         return 0
     pos, present = gi.compact_of(id_col, ctx)
     akey = pos  # base endpoint key = its compact position
+    mask_pairs = mask_pairs or {}
+    carried: Dict[str, Any] = {}
     last = hops[0]
     for hop in reversed(hops):
-        rp, ci, _ = gi.csr(hop.types_key, hop.backwards, ctx)
+        rp, ci, eo = gi.csr(hop.types_key, hop.backwards, ctx)
         mask = gi.label_mask(hop.far_labels, ctx)
         deg, t_dev = J.expand_degrees_total(rp, pos, present)
         total = int(t_dev)
         if total == 0:
             return 0
+        order = tuple(sorted(carried))
+        prevs = tuple(carried[r] for r in order)
+        midx = tuple(order.index(r) for r in mask_pairs.get(hop.rel_fld, ()))
         if hop is last:
-            return final(rp, ci, pos, deg, akey, mask, total)
-        akey, pos, present = J.distinct_hop_materialize(
-            rp, ci, pos, deg, akey, mask, total=total
-        )
+            return final(
+                rp, ci, eo, pos, deg, akey, mask, prevs, order, midx, total
+            )
+        if order or hop.rel_fld in carry_rels:
+            akey, pos, orig, prevs_out, present = J.unique_hop_materialize(
+                rp, ci, eo, pos, deg, akey, mask, prevs,
+                total=total, mask_idx=midx,
+            )
+            carried = dict(zip(order, prevs_out))
+            if hop.rel_fld in carry_rels:
+                carried[hop.rel_fld] = orig
+        else:
+            akey, pos, present = J.distinct_hop_materialize(
+                rp, ci, pos, deg, akey, mask, total=total
+            )
     raise AssertionError("unreachable: loop always hits hops[0]")
+
+
+def _chain_enforcement_spec(hops, pairs, close_rel=None, close_types=None):
+    """Compile a set of rel-uniqueness pairs into walk enforcement:
+    ``(carry_rels, mask_pairs, close_partners)``, or None when any pair
+    cannot be enforced in the fused walk (undirected hops, duplicate rel
+    bindings, rels outside the subtree, or DIFFERENT type sets — carried
+    edge scan rows are only comparable within one canonical rel scan).
+
+    ``close_partners`` lists chain rels that must differ from the closing
+    relationship (``into_close_count_unique`` subtracts them from the probe
+    range); chain-chain pairs become a mask at the later-executed hop."""
+    if any(h.undirected for h in hops):
+        return None
+    exec_rels = [h.rel_fld for h in reversed(hops)]  # execution order
+    if len(set(exec_rels)) != len(exec_rels):
+        return None
+    types_of = {h.rel_fld: h.types_key for h in hops}
+    if close_rel is not None:
+        if close_rel in types_of:
+            return None
+        types_of[close_rel] = close_types
+    pos_of = {r: i for i, r in enumerate(exec_rels)}
+    carry = set()
+    mask_pairs: Dict[str, Tuple[str, ...]] = {}
+    close_partners = []
+    for ra, rb in pairs:
+        if ra == rb or ra not in types_of or rb not in types_of:
+            return None
+        if types_of[ra] != types_of[rb]:
+            return None
+        if close_rel is not None and close_rel in (ra, rb):
+            other = rb if ra == close_rel else ra
+            if other not in pos_of:
+                return None
+            if other not in close_partners:
+                close_partners.append(other)
+            if other != exec_rels[-1]:
+                carry.add(other)
+            continue
+        if ra not in pos_of or rb not in pos_of:
+            return None
+        early, late = (ra, rb) if pos_of[ra] < pos_of[rb] else (rb, ra)
+        if early not in mask_pairs.get(late, ()):
+            mask_pairs[late] = mask_pairs.get(late, ()) + (early,)
+        carry.add(early)
+    return frozenset(carry), mask_pairs, tuple(close_partners)
+
+
+def _collected_pairs(hops, extra=()):
+    """Deduplicated uniqueness pairs attached anywhere on a fused subtree."""
+    seen = []
+    for op in list(hops) + list(extra):
+        for p in getattr(op, "enforced_pairs", ()):
+            if p not in seen:
+                seen.append(p)
+    return tuple(seen)
 
 
 class _FusedExpandBase(RelationalOperator):
@@ -80,6 +165,71 @@ class _FusedExpandBase(RelationalOperator):
     ):
         super().__init__(in_plan, classic)
         self._graph_obj = graph_obj
+
+    def _with_pair(self, pair, predicate) -> "RelationalOperator":
+        """Clone with one relationship-uniqueness pair enforced INSIDE the
+        operator (``plan_filter_fastpath`` drops the filter). The classic
+        shadow keeps the dropped predicate as a real FilterOp, so every
+        fallback path stays bag-identical to the generic plan."""
+        from ...relational.ops import FilterOp
+
+        kw = self._ctor_kwargs()
+        kw["enforced_pairs"] = self.enforced_pairs + (tuple(sorted(pair)),)
+        return type(self)(
+            self.children[0],
+            FilterOp(self.children[1], predicate),
+            self._graph_obj,
+            **kw,
+        )
+
+    def _enforce_pair_ids(self, gi: GraphIndex, ctx, row, orig):
+        """Row-keep mask for the materializing path: for each enforced
+        pair, compare element ids — this op's own relationship reads the
+        canonical rel-scan id column at ``orig``; any other rel reads its
+        input-table id column at ``row`` (element ids are global, so the
+        comparison is sound across type sets and fallback paths)."""
+        in_op = self.children[0]
+        in_t = in_op.table
+        rel_cols, rel_header = gi.rel_scan(self.types_key, ctx)
+        canon_id = rel_header.id_expr(rel_header.var(CANON_REL))
+        own_ids = None
+
+        def ids_of(r):
+            nonlocal own_ids
+            if r == self.rel_fld:
+                if own_ids is None:
+                    own_ids = jnp.take(
+                        rel_cols[rel_header.column(canon_id)].data, orig
+                    )
+                return own_ids
+            h = in_op.header
+            try:
+                col = in_t._cols[h.column(h.id_expr(h.var(r)))]
+            except (KeyError, ValueError) as exc:
+                raise GraphIndexError(f"uniqueness rel {r!r} unmapped") from exc
+            return jnp.take(col.data, row)
+
+        keep = None
+        for ra, rb in self.enforced_pairs:
+            k = ids_of(ra) != ids_of(rb)
+            keep = k if keep is None else keep & k
+        return keep
+
+    def _apply_enforced_pairs(self, gi, ctx, row, orig, extras, n_out):
+        """Materializing-path enforcement: mask rows violating any enforced
+        pair and compact (``extras``: whatever arrays ride along — far
+        rows, swapped flags). Shared by the expand and expand-into
+        materializers so the keep/compact discipline cannot diverge."""
+        if not self.enforced_pairs or not n_out:
+            return row, orig, extras, n_out
+        keep = self._enforce_pair_ids(gi, ctx, row, orig)
+        n2 = int(J.mask_sum(keep))
+        if n2 != n_out:
+            idx = J.mask_nonzero(keep, size=n2)
+            taken = J.tree_take((row, orig) + tuple(extras), idx)
+            row, orig, extras = taken[0], taken[1], tuple(taken[2:])
+            n_out = n2
+        return row, orig, extras, n_out
 
     def _compute_header(self) -> RecordHeader:
         full = self.children[1].header
@@ -238,6 +388,7 @@ class CsrExpandOp(_FusedExpandBase):
         undirected: bool,
         backwards: bool,
         far_labels: Tuple[str, ...],
+        enforced_pairs: Tuple[Tuple[str, str], ...] = (),
     ):
         super().__init__(in_plan, classic, graph_obj)
         self.frontier_fld = frontier_fld
@@ -247,11 +398,28 @@ class CsrExpandOp(_FusedExpandBase):
         self.undirected = undirected
         self.backwards = backwards
         self.far_labels = far_labels
+        self.enforced_pairs = enforced_pairs
+
+    def _ctor_kwargs(self) -> Dict[str, Any]:
+        return dict(
+            frontier_fld=self.frontier_fld,
+            rel_fld=self.rel_fld,
+            far_fld=self.far_fld,
+            types_key=self.types_key,
+            undirected=self.undirected,
+            backwards=self.backwards,
+            far_labels=self.far_labels,
+        )
 
     def _show_inner(self) -> str:
         arrow = "-" if self.undirected else ("<-" if self.backwards else "->")
         t = "|".join(self.types_key) or "*"
-        return f"({self.frontier_fld}){arrow}[{self.rel_fld}:{t}]({self.far_fld})"
+        uniq = (
+            " uniq" + ",".join(f"({a}<>{b})" for a, b in self.enforced_pairs)
+            if self.enforced_pairs
+            else ""
+        )
+        return f"({self.frontier_fld}){arrow}[{self.rel_fld}:{t}]({self.far_fld}){uniq}"
 
     def _expand_half(self, gi: GraphIndex, pos, present, reverse: bool, drop_loops: bool):
         ctx = self.context
@@ -306,6 +474,29 @@ class CsrExpandOp(_FusedExpandBase):
         gi.node_ids(ctx)  # build the compact id space (validates the graph)
         if gi.num_nodes == 0:
             return 0
+        pairs = _collected_pairs(hops)
+        if pairs:
+            # rel-uniqueness enforced inside the count: the SpMV carries
+            # only per-node multiplicities (no edge identity), so unique
+            # chains count via the edge-carrying walk instead
+            spec = _chain_enforcement_spec(hops, pairs)
+            if spec is None:
+                raise GraphIndexError(
+                    "unenforceable uniqueness pairs: classic shadow counts"
+                )
+            carry, mask_pairs, _ = spec
+
+            def final(rp, ci, eo, pos, deg, akey, mask, prevs, order, midx, total):
+                return int(
+                    J.chain_count_final_unique(
+                        rp, ci, eo, pos, deg, mask, prevs,
+                        total=total, mask_idx=midx,
+                    )
+                )
+
+            return _fused_chain_walk(
+                gi, ctx, hops, id_col, final, carry, mask_pairs
+            )
         if len(hops) == 1 and not self.undirected and not self.far_labels:
             # single unrestricted hop: O(frontier) Pallas degree-sum (VMEM
             # tiling) beats the chain's O(edges) SpMV
@@ -392,9 +583,24 @@ class CsrExpandOp(_FusedExpandBase):
             gi.node_ids(ctx)
             if use_a and use_c and gi.num_nodes >= (1 << 30):
                 return None  # pos*V+pos pair key must stay below the sentinel
+            pairs = _collected_pairs(hops)
+            carry, mask_pairs = frozenset(), {}
+            if pairs:
+                spec = _chain_enforcement_spec(hops, pairs)
+                if spec is None:
+                    return None  # materialized path enforces via row masks
+                carry, mask_pairs, _ = spec
 
-            def final(rp, ci, pos, deg, akey, mask, total):
+            def final(rp, ci, eo, pos, deg, akey, mask, prevs, order, midx, total):
                 # final hop: fused materialize+sort+count
+                if midx:
+                    return int(
+                        J.distinct_pairs_count_final_unique(
+                            rp, ci, eo, pos, deg, akey, mask, prevs,
+                            total=total, use_a=use_a, use_c=use_c,
+                            num_nodes=gi.num_nodes, mask_idx=midx,
+                        )
+                    )
                 return int(
                     J.distinct_pairs_count_final(
                         rp, ci, pos, deg, akey, mask,
@@ -403,7 +609,9 @@ class CsrExpandOp(_FusedExpandBase):
                     )
                 )
 
-            return _fused_chain_walk(gi, ctx, hops, id_col, final)
+            return _fused_chain_walk(
+                gi, ctx, hops, id_col, final, carry, mask_pairs
+            )
         except (GraphIndexError, TpuBackendError):
             return None
 
@@ -458,6 +666,13 @@ class CsrExpandOp(_FusedExpandBase):
             row, orig = jnp.zeros(0, jnp.int64), jnp.zeros(0, jnp.int64)
             if swapped is not None:
                 swapped = jnp.zeros(0, bool)
+        extras = (far_rows,) if swapped is None else (far_rows, swapped)
+        row, orig, extras, n_out = self._apply_enforced_pairs(
+            gi, ctx, row, orig, extras, n_out
+        )
+        far_rows = extras[0]
+        if swapped is not None:
+            swapped = extras[1]
         return self._assemble(
             gi, row, orig, swapped, far_rows, self.far_labels,
             self.rel_fld, self.far_fld, n_out,
@@ -480,6 +695,7 @@ class CsrExpandIntoOp(_FusedExpandBase):
         target_fld: str,
         types_key: Tuple[str, ...],
         undirected: bool,
+        enforced_pairs: Tuple[Tuple[str, str], ...] = (),
     ):
         super().__init__(in_plan, classic, graph_obj)
         self.source_fld = source_fld
@@ -487,11 +703,29 @@ class CsrExpandIntoOp(_FusedExpandBase):
         self.target_fld = target_fld
         self.types_key = types_key
         self.undirected = undirected
+        self.enforced_pairs = enforced_pairs
+
+    def _ctor_kwargs(self) -> Dict[str, Any]:
+        return dict(
+            source_fld=self.source_fld,
+            rel_fld=self.rel_fld,
+            target_fld=self.target_fld,
+            types_key=self.types_key,
+            undirected=self.undirected,
+        )
 
     def _show_inner(self) -> str:
         arrow = "-" if self.undirected else "->"
         t = "|".join(self.types_key) or "*"
-        return f"({self.source_fld})-[{self.rel_fld}:{t}]{arrow}({self.target_fld}) into"
+        uniq = (
+            " uniq" + ",".join(f"({a}<>{b})" for a, b in self.enforced_pairs)
+            if self.enforced_pairs
+            else ""
+        )
+        return (
+            f"({self.source_fld})-[{self.rel_fld}:{t}]{arrow}"
+            f"({self.target_fld}) into{uniq}"
+        )
 
     def _probe(self, gi: GraphIndex, keys, s_pos, t_pos, ok, drop_loops: bool):
         ctx = self.context
@@ -546,8 +780,42 @@ class CsrExpandIntoOp(_FusedExpandBase):
                 return None  # src*N + dst probe key must fit int64
             keys = gi.edge_keys(self.types_key, ctx)
             src_is_base = self.source_fld == base.frontier_fld
+            pairs = _collected_pairs(hops, (self,))
+            if pairs:
+                if self.undirected:
+                    return None  # dual-orientation probe: materialize
+                spec = _chain_enforcement_spec(
+                    hops, pairs,
+                    close_rel=self.rel_fld, close_types=self.types_key,
+                )
+                if spec is None:
+                    return None  # materialized path enforces via row masks
+                carry, mask_pairs, close_partners = spec
+                kbo = gi.edge_keys_by_orig(self.types_key, ctx)
+                exec_last = hops[0].rel_fld
+                sub_cur = exec_last in close_partners
+                sub_rels = tuple(
+                    sorted(r for r in close_partners if r != exec_last)
+                )
 
-            def final(rp, ci, pos, deg, akey, mask, total):
+                def final_u(
+                    rp, ci, eo, pos, deg, akey, mask, prevs, order, midx, total
+                ):
+                    sub_idx = tuple(order.index(r) for r in sub_rels)
+                    return int(
+                        J.into_close_count_unique(
+                            rp, ci, eo, pos, deg, akey, mask, keys, kbo, prevs,
+                            total=total, src_is_base=src_is_base,
+                            num_nodes=gi.num_nodes,
+                            mask_idx=midx, sub_idx=sub_idx, sub_cur=sub_cur,
+                        )
+                    )
+
+                return _fused_chain_walk(
+                    gi, ctx, hops, id_col, final_u, carry, mask_pairs
+                )
+
+            def final(rp, ci, eo, pos, deg, akey, mask, prevs, order, midx, total):
                 return int(
                     J.into_close_count(
                         rp, ci, pos, deg, akey, mask, keys,
@@ -586,9 +854,15 @@ class CsrExpandIntoOp(_FusedExpandBase):
         if self.undirected:
             row2, orig2 = self._probe(gi, keys, t_pos, s_pos, ok, drop_loops=True)
             row, orig, swapped = J.concat_into_halves(row, orig, row2, orig2)
+        n_out = int(row.shape[0])
+        extras = () if swapped is None else (swapped,)
+        row, orig, extras, n_out = self._apply_enforced_pairs(
+            gi, ctx, row, orig, extras, n_out
+        )
+        if swapped is not None:
+            swapped = extras[0]
         return self._assemble(
-            gi, row, orig, swapped, None, (), self.rel_fld, None,
-            int(row.shape[0]),
+            gi, row, orig, swapped, None, (), self.rel_fld, None, n_out
         )
 
 
@@ -1004,78 +1278,128 @@ def _graph_loop_free(graph_obj, types_key, ctx) -> bool:
     return got
 
 
+def _chain_rel_ends(hops) -> Optional[Dict[str, Tuple[str, str, Tuple[str, ...]]]]:
+    """Per-rel GRAPH-direction endpoints ``rel -> (src_fld, dst_fld,
+    types_key)`` for a directed chain; None when any hop is undirected
+    (orientation-ambiguous) or a rel field repeats (re-bound rel)."""
+    out: Dict[str, Tuple[str, str, Tuple[str, ...]]] = {}
+    for h in hops:
+        if h.undirected or h.rel_fld in out:
+            return None
+        out[h.rel_fld] = (
+            (h.far_fld, h.frontier_fld, h.types_key)
+            if h.backwards
+            else (h.frontier_fld, h.far_fld, h.types_key)
+        )
+    return out
+
+
+def _rel_uniqueness_redundant(rel_ends, ra, rb, graph_obj, ctx) -> bool:
+    """Sound redundancy proof for a rel-uniqueness filter ``id(ra) <>
+    id(rb)`` over the subtree binding the relationships in ``rel_ends``.
+
+    If the two relationships were the SAME edge, their graph sources
+    coincide and their graph targets coincide. Propagating just those two
+    node equalities (union-find over endpoint fields — shared pattern
+    variables merge by name), any relationship whose endpoints land in one
+    equivalence class is forced to be a SELF-LOOP of its own type set; if
+    that type set is loop-free in this graph, the scenario is impossible,
+    the filter can never remove a row, and dropping it is sound.
+
+    Orientation-aware by construction: a forward/backward adjacent pair
+    merges the two OUTER endpoints and forces no loop — the exact shape the
+    round-3 proof dropped unsoundly (fork patterns returned 9 where
+    openCypher requires 6). The reference gets these semantics from
+    Neo4j's AddUniquenessPredicates + literal per-step filters
+    (``VarLengthExpandPlanner.scala:107-165``)."""
+    ea, eb = rel_ends.get(ra), rel_ends.get(rb)
+    if ea is None or eb is None:
+        return False
+    parent: Dict[str, str] = {}
+
+    def find(x: str) -> str:
+        parent.setdefault(x, x)
+        while parent[x] != x:
+            parent[x] = parent[parent[x]]
+            x = parent[x]
+        return x
+
+    parent[find(ea[0])] = find(eb[0])
+    parent[find(ea[1])] = find(eb[1])
+    for s, d, tk in rel_ends.values():
+        if find(s) == find(d) and _graph_loop_free(graph_obj, tk, ctx):
+            return True
+    return False
+
+
 def plan_filter_fastpath(planner, op, child) -> Optional[RelationalOperator]:
-    """Drop a relationship-uniqueness filter that is PROVABLY redundant over
-    a fused expand subtree, so count(*)/DISTINCT chains keep their whole-plan
-    fusion (the openCypher isomorphism predicates the IR now adds would
-    otherwise force the chain to materialize just to compare edge ids):
+    """Resolve a relationship-uniqueness filter over a fused expand subtree
+    so count(*)/DISTINCT chains keep their whole-plan fusion (the openCypher
+    isomorphism predicates ``ir.builder`` adds would otherwise force the
+    chain to materialize just to compare edge ids):
 
-    * adjacent DIRECTED chain hops: the same relationship at positions i and
-      i+1 requires a self-loop — redundant when both type sets are loop-free;
-    * an ExpandInto closing a directed chain's endpoints vs ANY chain rel:
-      edge identity forces all endpoints equal, i.e. a self-loop — same
-      loop-free condition.
+    1. PROOF: ``_rel_uniqueness_redundant`` — equality would force a
+       self-loop of a loop-free type set: drop the filter outright (the
+       SpMV count path stays available);
+    2. ENFORCEMENT: same type set on both rels — drop the filter and clone
+       the subtree's top operator with the pair recorded in
+       ``enforced_pairs``; every execution path re-imposes it (fused walks
+       via carried edge ids, materializing paths via id-column masks, the
+       classic shadow via a real FilterOp wrapped around it);
+    3. otherwise keep the generic FilterOp plan.
 
-    Anything else (non-adjacent chain pairs, undirected hops, loops present,
-    non-fused subtrees) keeps the filter. Returns the CHILD to drop the
-    filter, or None to keep the generic plan. The local oracle has no such
-    hook and evaluates every predicate literally — differential tests hold."""
+    The local oracle has no such hook and evaluates every predicate
+    literally — differential tests pin both mechanisms."""
     from ...relational.ops import CacheOp
 
     pair = _rel_neq_pair(op.predicate)
     if pair is None:
         return None
+    wraps = 0
     node = child
     while isinstance(node, CacheOp):
         node = node.children[0]
+        wraps += 1
 
-    def chain_adjacent_redundant(chain_op: "CsrExpandOp", ra: str, rb: str) -> bool:
-        hops = chain_op._chain_hops()
-        if any(h.undirected for h in hops):
-            return False
-        rels = [h.rel_fld for h in hops]
-        if ra not in rels or rb not in rels:
-            return False
-        i, j = sorted((rels.index(ra), rels.index(rb)))
-        if j != i + 1:
-            return False  # non-adjacent reuse needs only a cycle, not a loop
-        return _graph_loop_free(
-            chain_op._graph_obj, hops[i].types_key, chain_op.context
-        ) and _graph_loop_free(
-            chain_op._graph_obj, hops[j].types_key, chain_op.context
-        )
+    def rewrap(n: RelationalOperator) -> RelationalOperator:
+        for _ in range(wraps):
+            n = CacheOp(n)
+        return n
 
     if isinstance(node, CsrExpandIntoOp) and not node.undirected:
         in_op = node.children[0]
         while isinstance(in_op, CacheOp):
             in_op = in_op.children[0]
-        if isinstance(in_op, CsrExpandOp) and in_op._graph_obj is node._graph_obj:
-            hops = in_op._chain_hops()
-            rels = [h.rel_fld for h in hops]
-            base = hops[-1]
-            ends_ok = (
-                {node.source_fld, node.target_fld}
-                == {base.frontier_fld, in_op.far_fld}
-                and base.frontier_fld != in_op.far_fld
-            )
-            if node.rel_fld in pair and ends_ok and not any(
-                h.undirected for h in hops
-            ):
-                other = pair[0] if pair[1] == node.rel_fld else pair[1]
-                if other in rels:
-                    h_other = hops[rels.index(other)]
-                    if _graph_loop_free(
-                        node._graph_obj, node.types_key, node.context
-                    ) and _graph_loop_free(
-                        node._graph_obj, h_other.types_key, node.context
-                    ):
-                        return child
-            if set(pair) <= set(rels) and chain_adjacent_redundant(in_op, *pair):
-                return child
+        if not (
+            isinstance(in_op, CsrExpandOp)
+            and in_op._graph_obj is node._graph_obj
+        ):
+            return None
+        rel_ends = _chain_rel_ends(in_op._chain_hops())
+        if rel_ends is None or node.rel_fld in rel_ends:
+            return None
+        rel_ends[node.rel_fld] = (
+            node.source_fld, node.target_fld, node.types_key
+        )
+    elif isinstance(node, CsrExpandOp):
+        rel_ends = _chain_rel_ends(node._chain_hops())
+        if rel_ends is None:
+            return None
+    else:
         return None
-    if isinstance(node, CsrExpandOp):
-        if chain_adjacent_redundant(node, *pair):
-            return child
+    key = tuple(sorted(pair))
+    if not set(key) <= set(rel_ends):
+        return None
+    if key in node.enforced_pairs:
+        return child  # duplicate predicate: already enforced below
+    if _rel_uniqueness_redundant(
+        rel_ends, key[0], key[1], node._graph_obj, node.context
+    ):
+        return child
+    if rel_ends[key[0]][2] == rel_ends[key[1]][2]:
+        # carried edge scan rows are only comparable within one canonical
+        # rel scan, so in-op enforcement needs identical type sets
+        return rewrap(node._with_pair(key, op.predicate))
     return None
 
 

@@ -107,6 +107,9 @@ class GraphIndex:
         self._csr_max_deg: Dict[Tuple[Tuple[str, ...], bool], int] = {}
         # types_key -> sorted edge keys (src*N + dst), device int64
         self._edge_keys: Dict[Tuple[str, ...], Any] = {}
+        # types_key -> int64[num_rels] (src*N + dst) key per canonical
+        # rel-scan row (relationship-uniqueness probe subtraction)
+        self._keys_by_orig: Dict[Tuple[str, ...], Any] = {}
         # types_key -> device int64[num_nodes] self-loop counts (undirected
         # count chains subtract the double-counted loop contribution)
         self._loop_count: Dict[Tuple[str, ...], Any] = {}
@@ -307,6 +310,20 @@ class GraphIndex:
         if types_key not in self._edge_keys:
             self.csr(types_key, False, ctx)
         return self._edge_keys[types_key]
+
+    def edge_keys_by_orig(self, types_key: Tuple[str, ...], ctx):
+        """int64[num_rels] device array: the (src*N + dst) probe key of each
+        canonical rel-scan row. ``into_close_count_unique`` subtracts a
+        carried chain edge from a probe range exactly when its key equals
+        the probe key (same key <=> same endpoints; the range covers every
+        edge of the type set, so the carried edge is in it iff keys match)."""
+        got = self._keys_by_orig.get(types_key)
+        if got is None:
+            s, d, n = self._edge_endpoints(types_key, ctx)
+            got = self._keys_by_orig[types_key] = jnp.asarray(
+                s.astype(np.int64) * n + d.astype(np.int64)
+            )
+        return got
 
     def csr_max_degree(self, types_key: Tuple[str, ...], reverse: bool, ctx) -> int:
         """Host-cached max degree of one CSR orientation (computed at

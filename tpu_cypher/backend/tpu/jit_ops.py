@@ -292,6 +292,61 @@ def into_close_count(
     return cnt
 
 
+@partial(
+    jax.jit,
+    static_argnames=(
+        "total", "src_is_base", "num_nodes", "mask_idx", "sub_idx", "sub_cur",
+    ),
+)
+def into_close_count_unique(
+    rp, ci, eo, pos, deg, akey, mask, keys, keys_by_orig, prevs,
+    total: int, src_is_base: bool, num_nodes: int,
+    mask_idx: tuple, sub_idx: tuple, sub_cur: bool,
+):
+    """``into_close_count`` with openCypher relationship-uniqueness enforced
+    IN the fused program (the reference gets the same semantics from explicit
+    ``id(r_i) <> id(r_j)`` filters, Neo4j ``AddUniquenessPredicates``):
+
+    * ``prevs``: carried chain-edge scan rows per partial path (one array
+      per earlier hop whose rel participates in an enforced pair);
+    * ``mask_idx``: indices into ``prevs`` the CURRENT hop's edge must
+      differ from (adjacent/any chain-chain pairs) — equal rows are dead;
+    * ``sub_cur`` / ``sub_idx``: closing-rel-vs-chain-rel pairs. The probe
+      range counts every type-set edge with key (s,t); a chain edge is in
+      that range iff its own (src*N+dst) key equals the probe key, so
+      subtracting the key-match indicator removes exactly that edge from
+      the closing candidates. Two forbidden rels may bind the SAME edge
+      (nothing pairs them when the predicates span MATCH clauses or are
+      user-written), so each subtraction is gated on differing from every
+      already-subtracted edge — each distinct forbidden in-range edge
+      subtracts once (parallel edges keep distinct scan rows — exact)."""
+    row, edge = _expand_rows(jnp.take(rp, pos), deg, total)
+    nbr = jnp.take(ci, edge).astype(jnp.int64)
+    orig = jnp.take(eo, edge)
+    a = jnp.take(akey, row)
+    ok = jnp.take(mask, nbr) if mask is not None else jnp.ones(total, bool)
+    prevs_r = tuple(jnp.take(p, row) for p in prevs)
+    for i in mask_idx:
+        ok = ok & (orig != prevs_r[i])
+    s, t = (a, nbr) if src_is_base else (nbr, a)
+    probe = s * num_nodes + t
+    lo = jnp.searchsorted(keys, probe, side="left")
+    hi = jnp.searchsorted(keys, probe, side="right")
+    cnt = (hi - lo).astype(jnp.int64)
+    subbed = []
+    if sub_cur:
+        cnt = cnt - (jnp.take(keys_by_orig, orig) == probe).astype(jnp.int64)
+        subbed.append(orig)
+    for i in sub_idx:
+        p = prevs_r[i]
+        ind = jnp.take(keys_by_orig, p) == probe
+        for e in subbed:
+            ind = ind & (p != e)
+        cnt = cnt - ind.astype(jnp.int64)
+        subbed.append(p)
+    return jnp.sum(jnp.where(ok, cnt, 0))
+
+
 @partial(jax.jit, static_argnames=("total",))
 def into_materialize(eo, lo, counts, total: int):
     row, edge = _expand_rows(lo, counts, total)
@@ -557,6 +612,78 @@ def distinct_pairs_count_final(
         valid_n = jnp.sum(present.astype(jnp.int64))
     else:
         valid_n = jnp.asarray(total, jnp.int64)
+    s = jax.lax.sort(key)
+    if total == 0:
+        return jnp.asarray(0, jnp.int64)
+    bounds = jnp.sum(
+        ((s[1:] != s[:-1]) & (jnp.arange(1, total) < valid_n)).astype(jnp.int64)
+    )
+    return bounds + (valid_n > 0).astype(jnp.int64)
+
+
+@partial(jax.jit, static_argnames=("total", "mask_idx"))
+def unique_hop_materialize(
+    rp, ci, eo, pos, deg, akey, mask, prevs, total: int, mask_idx: tuple
+):
+    """``distinct_hop_materialize`` carrying walked-edge scan rows for
+    relationship uniqueness: expands into (akey', pos', edge', prevs',
+    present'). ``mask_idx`` names the carried arrays the new edge must
+    differ from; violating rows come out present'=False (their next-hop
+    degrees zero out — the fused analog of the planner's per-step
+    ``id(r_i) <> id(r_j)`` filters, same mechanism as ``varlen_hop``)."""
+    row, edge = _expand_rows(jnp.take(rp, pos), deg, total)
+    nbr = jnp.take(ci, edge).astype(jnp.int64)
+    orig = jnp.take(eo, edge)
+    akey_out = jnp.take(akey, row)
+    prevs_out = tuple(jnp.take(p, row) for p in prevs)
+    present = jnp.take(mask, nbr) if mask is not None else jnp.ones(total, bool)
+    for i in mask_idx:
+        present = present & (orig != prevs_out[i])
+    return akey_out, nbr, orig, prevs_out, present
+
+
+@partial(jax.jit, static_argnames=("total", "mask_idx"))
+def chain_count_final_unique(
+    rp, ci, eo, pos, deg, mask, prevs, total: int, mask_idx: tuple
+):
+    """Final hop of a rel-unique chain count(*): materialize the last
+    expansion's liveness only and sum it (the SpMV ``path_count_chain``
+    cannot express per-path edge identity, so unique chains count via the
+    walk)."""
+    row, edge = _expand_rows(jnp.take(rp, pos), deg, total)
+    nbr = jnp.take(ci, edge).astype(jnp.int64)
+    orig = jnp.take(eo, edge)
+    ok = jnp.take(mask, nbr) if mask is not None else jnp.ones(total, bool)
+    for i in mask_idx:
+        ok = ok & (orig != jnp.take(prevs[i], row))
+    return jnp.sum(ok.astype(jnp.int64))
+
+
+@partial(
+    jax.jit,
+    static_argnames=("total", "use_a", "use_c", "num_nodes", "mask_idx"),
+)
+def distinct_pairs_count_final_unique(
+    rp, ci, eo, pos, deg, akey, mask, prevs,
+    total: int, use_a: bool, use_c: bool, num_nodes: int, mask_idx: tuple,
+):
+    """``distinct_pairs_count_final`` with walked-edge uniqueness masks:
+    rows whose final edge equals a carried chain edge sort to the sentinel
+    tail (they are not paths under openCypher rel-isomorphism)."""
+    row, edge = _expand_rows(jnp.take(rp, pos), deg, total)
+    nbr = jnp.take(ci, edge).astype(jnp.int64)
+    orig = jnp.take(eo, edge)
+    if use_a and use_c:
+        key = jnp.take(akey, row) * num_nodes + nbr
+    elif use_a:
+        key = jnp.take(akey, row)
+    else:
+        key = nbr
+    present = jnp.take(mask, nbr) if mask is not None else jnp.ones(total, bool)
+    for i in mask_idx:
+        present = present & (orig != jnp.take(prevs[i], row))
+    key = jnp.where(present, key, _KEY_SENTINEL)
+    valid_n = jnp.sum(present.astype(jnp.int64))
     s = jax.lax.sort(key)
     if total == 0:
         return jnp.asarray(0, jnp.int64)

@@ -74,6 +74,20 @@ def _mention_tree(m: Set[E.Expr], e: E.Expr, h) -> None:
             _mention_var_exprs(m, h, s.name)
 
 
+def _mention_enforced_pairs(m: Set[E.Expr], op, h) -> None:
+    """A fused expand op with in-op relationship-uniqueness pairs reads the
+    partner rels' id columns from its input on the materializing path —
+    keep them alive through pruning."""
+    for pr in getattr(op, "enforced_pairs", ()):
+        for r in pr:
+            if r == getattr(op, "rel_fld", None):
+                continue
+            try:
+                m.add(h.id_expr(h.var(r)))
+            except Exception:
+                pass
+
+
 def _local_mentions(op: O.RelationalOperator) -> Set[E.Expr]:
     """What this operator itself reads from its children's tables."""
     from ..backend.tpu.expand_op import (
@@ -145,6 +159,7 @@ def _local_mentions(op: O.RelationalOperator) -> Set[E.Expr]:
             m.add(h.id_expr(h.var(op.frontier_fld)))
         except Exception:
             m.update(h.expressions)
+        _mention_enforced_pairs(m, op, h)
     elif isinstance(op, CsrExpandIntoOp):
         h = op.children[0].header
         for f in (op.source_fld, op.target_fld):
@@ -152,6 +167,7 @@ def _local_mentions(op: O.RelationalOperator) -> Set[E.Expr]:
                 m.add(h.id_expr(h.var(f)))
             except Exception:
                 m.update(h.expressions)
+        _mention_enforced_pairs(m, op, h)
     elif isinstance(op, CsrVarExpandOp):
         # the fused path reads only the source id, but the classic SHADOW
         # cascade ends in a SelectOp whose plan-time field list names every
