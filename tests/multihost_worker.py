@@ -1,0 +1,76 @@
+"""Worker entry for the 2-process ``jax.distributed`` CPU test: one OS
+process per simulated host, 4 virtual CPU devices each, coordinated over
+localhost — the degenerate-free version of SURVEY §2.3's multi-host
+orchestration (reference analog: one Spark/Flink worker JVM per host).
+
+Usage: python multihost_worker.py <process_id> <num_processes> <port>
+Prints one ``REPORT {...}`` JSON line from ``dryrun_multihost``.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+# env the workers must own (they set their own platform/devices/coordination)
+_WORKER_OWNED_ENV = ("XLA_FLAGS", "JAX_PLATFORMS", "JAX_COORDINATOR_ADDRESS")
+
+
+def spawn_two_process(port: int, timeout: float = 240):
+    """Spawn this worker twice (localhost coordinator) and return
+    ``[(returncode, output, report-dict-or-None), ...]`` for process 0 and 1.
+    Shared by the pytest two-process test and ``__graft_entry__``'s dryrun so
+    the spawn/REPORT protocol has exactly one implementation."""
+    worker = os.path.abspath(__file__)
+    env = {k: v for k, v in os.environ.items() if k not in _WORKER_OWNED_ENV}
+    procs = [
+        subprocess.Popen(
+            [sys.executable, worker, str(pid), "2", str(port)],
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+            text=True,
+            env=env,
+        )
+        for pid in (0, 1)
+    ]
+    results = []
+    for p in procs:
+        try:
+            out, _ = p.communicate(timeout=timeout)
+        except subprocess.TimeoutExpired:
+            p.kill()
+            out, _ = p.communicate()
+        lines = [l for l in out.splitlines() if l.startswith("REPORT ")]
+        report = json.loads(lines[-1][len("REPORT "):]) if lines else None
+        results.append((p.returncode, out, report))
+    return results
+
+
+def main() -> None:
+    pid, nproc, port = int(sys.argv[1]), int(sys.argv[2]), sys.argv[3]
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=4"
+        ).strip()
+    os.environ["JAX_ENABLE_X64"] = "1"
+    os.environ["JAX_COORDINATOR_ADDRESS"] = f"127.0.0.1:{port}"
+    os.environ["JAX_NUM_PROCESSES"] = str(nproc)
+    os.environ["JAX_PROCESS_ID"] = str(pid)
+
+    sys.path.insert(
+        0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    )
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    from tpu_cypher.parallel.multihost import dryrun_multihost
+
+    rep = dryrun_multihost()
+    print("REPORT " + json.dumps(rep), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -246,14 +246,24 @@ def test_distinct_endpoints_count_fused_matches_oracle(monkeypatch):
     from tpu_cypher import CypherSession
     from tpu_cypher.backend.tpu import jit_ops
 
+    from tpu_cypher import native
+
     calls = {"n": 0}
     orig = jit_ops.distinct_pairs_count_final
+    orig_native = native.two_hop_distinct_native
 
     def spy(*a, **kw):
         calls["n"] += 1
         return orig(*a, **kw)
 
+    def spy_native(*a, **kw):
+        got = orig_native(*a, **kw)
+        if got is not None:  # None falls through to the device kernel
+            calls["n"] += 1
+        return got
+
     monkeypatch.setattr(jit_ops, "distinct_pairs_count_final", spy)
+    monkeypatch.setattr(native, "two_hop_distinct_native", spy_native)
 
     rng = np.random.default_rng(11)
     n, e = 30, 120

@@ -1,8 +1,13 @@
 """Multi-host path (SURVEY §2.3 / BASELINE #5): the jax.distributed wiring
-exercised in its single-process degenerate form — initialize no-ops, the
-global mesh is the local 8-device mesh, ingest shards across it, and the
-host-0 gather is the identity. The pod run differs only by the coordinator
-environment variables."""
+exercised in its single-process degenerate form AND as a REAL two-process
+run — two OS processes (4 virtual CPU devices each) coordinate over a
+localhost ``jax.distributed`` service, build the 8-device global mesh,
+ingest row-sharded columns/CSR spanning both processes, run engine queries
+through GSPMD collectives, and assemble row results across process
+boundaries (``column.to_host`` allgather). The pod run differs only by the
+coordinator environment variables."""
+
+import os
 
 import numpy as np
 
@@ -39,3 +44,25 @@ def test_dryrun_multihost_engine_query():
     assert report["devices"] == len(jax.devices())
     assert report["host0"] is True
     assert report["two_hop"] > 0
+
+
+def test_two_process_distributed_engine_query():
+    """GENUINE multi-process run: spawn two workers, localhost coordinator,
+    4 virtual CPU devices each -> one 8-device global mesh. Both processes
+    must produce the same (asserted-correct) sharded 2-hop count AND the
+    same materialized row values; dryrun_multihost itself asserts both
+    against numpy ground truth, so a REPORT line means the engine ran
+    correctly across process boundaries."""
+    from multihost_worker import spawn_two_process
+
+    results = spawn_two_process(29600 + (os.getpid() % 200))
+    reports = []
+    for rc, out, report in results:
+        assert rc == 0, out[-2000:]
+        assert report is not None, out[-2000:]
+        reports.append(report)
+    assert [r["processes"] for r in reports] == [2, 2]
+    assert [r["devices"] for r in reports] == [8, 8]
+    assert reports[0]["two_hop"] == reports[1]["two_hop"]
+    assert reports[0]["rows"] == reports[1]["rows"]
+    assert {r["host0"] for r in reports} == {True, False}

@@ -416,10 +416,33 @@ _TYPE_ORDER = {
     "string": 5,
     "boolean": 6,
     "number": 7,
+    # temporal instants fall in the default "other" class (8; ISO strings
+    # order chronologically); durations get their own slot with an
+    # average-length key (below)
+    "duration": 9,
 }
+
+# duration order key basis: average-length microseconds with a month of
+# 30.4375 days (the reference compares CalendarIntervals by their converted
+# java.time.Duration, TemporalUdafs.scala; same constants as the device key
+# in backend/tpu/column.py). Ties are resolved by stability (first
+# occurrence) on BOTH backends, never by value.
+_DUR_MONTH_US = 2_629_800_000_000
+_DUR_DAY_US = 86_400_000_000
+
+
+def duration_order_us(v: "Duration") -> int:
+    return (
+        v.months * _DUR_MONTH_US
+        + v.days * _DUR_DAY_US
+        + v.seconds * 1_000_000
+        + v.microseconds
+    )
 
 
 def _order_class(v) -> str:
+    if isinstance(v, Duration):
+        return "duration"
     if isinstance(v, Node):
         return "node"
     if isinstance(v, Relationship):
@@ -466,6 +489,8 @@ def order_key(v):
         key = tuple(order_key(x) for x in v)
     elif cls == "map":
         key = tuple(sorted((k, order_key(x)) for k, x in v.items()))
+    elif cls == "duration":
+        key = duration_order_us(v)
     else:
         key = str(v)
     return (0, o, key)

@@ -27,6 +27,27 @@ from ...api import types as T
 from ...api.types import CypherType
 from ...parallel.mesh import padded_to_mesh
 
+def to_host(arr) -> np.ndarray:
+    """Device -> host pull that works across PROCESS boundaries: on a
+    multi-process runtime (``jax.distributed``), a row-sharded global array
+    is not fully addressable locally, so the full value is assembled with a
+    collective allgather — the engine-level analog of the reference's
+    collect-to-driver. Every process must reach this call symmetrically
+    (they run the same SPMD query program, so they do). Single-process:
+    plain ``np.asarray``."""
+    if isinstance(arr, np.ndarray):
+        return arr
+    if (
+        jax.process_count() > 1
+        and hasattr(arr, "is_fully_addressable")
+        and not arr.is_fully_addressable
+    ):
+        from jax.experimental import multihost_utils
+
+        arr = multihost_utils.process_allgather(arr, tiled=True)
+    return np.asarray(arr)
+
+
 # column kinds
 I64 = "i64"
 F64 = "f64"
@@ -34,7 +55,18 @@ BOOL = "bool"
 STR = "str"  # dictionary-encoded int32 codes
 DATE = "date"  # int32 days since 1970-01-01 (ref TemporalUdfs.scala:40-160)
 LDT = "ldt"  # int64 microseconds since 1970-01-01T00:00 (local, no zone)
+DUR = "dur"  # int64 (n, 3): months / days / total micros (seconds*1e6+us) —
+#              the reference's (months, days, seconds, nanos) Duration model
+#              (okapi-api Duration.scala) with the normalized sub-day pair
+#              collapsed into one microsecond count (bijective: 0 <= us < 1e6)
 OBJ = "obj"  # host-side Python objects (lists, elements) — not device resident
+
+# duration ORDER/min/max key: average-length microseconds (month = 30.4375
+# days, the reference's CalendarInterval comparison basis); ties keep first
+# occurrence on BOTH backends (stable sorts / first-match selection). The
+# constants live in api.values (the oracle's order key) — one definition.
+from ...api.values import _DUR_DAY_US as DUR_DAY_US  # noqa: E402
+from ...api.values import _DUR_MONTH_US as DUR_MONTH_US  # noqa: E402
 
 # temporal kinds share the integer device machinery (sort keys, joins,
 # distinct/group packing, min/max) — they differ only in decode + typing
@@ -239,6 +271,18 @@ class Column:
                 dtype=np.int32,
             )
             return build(DATE, data, 0)
+        from ...api.values import Duration
+
+        if all(isinstance(v, Duration) for v in non_null):
+            data = np.zeros((n, 3), dtype=np.int64)
+            for i, v in enumerate(values):
+                if v is not None:
+                    data[i] = (
+                        v.months,
+                        v.days,
+                        v.seconds * 1_000_000 + v.microseconds,
+                    )
+            return build(DUR, data, 0)
         # fallback: host objects
         return Column(OBJ, _obj_array(values), None)
 
@@ -274,13 +318,16 @@ class Column:
         if self.kind == OBJ:
             vals = list(self.data)
         else:
-            data = self._np_cache if self._np_cache is not None else np.asarray(self.data)
+            data = (
+                self._np_cache if self._np_cache is not None
+                else to_host(self.data)
+            )
             if self.valid is None:
                 valid = None
             elif self._np_valid is not None:
                 valid = self._np_valid
             else:
-                valid = np.asarray(self.valid)
+                valid = to_host(self.valid)
             if self.kind == I64:
                 vals = [
                     int(v) if (valid is None or valid[i]) else None
@@ -288,7 +335,7 @@ class Column:
                 ]
             elif self.kind == F64:
                 iflag = (
-                    np.asarray(self.int_flag) if self.int_flag is not None else None
+                    to_host(self.int_flag) if self.int_flag is not None else None
                 )
                 vals = [
                     (
@@ -325,6 +372,17 @@ class Column:
                     decode_ldt(v) if (valid is None or valid[i]) else None
                     for i, v in enumerate(data)
                 ]
+            elif self.kind == DUR:
+                from ...api.values import Duration
+
+                vals = [
+                    Duration(
+                        months=int(r[0]), days=int(r[1]), microseconds=int(r[2])
+                    )
+                    if (valid is None or valid[i])
+                    else None
+                    for i, r in enumerate(data)
+                ]
             else:  # pragma: no cover
                 raise TpuBackendError(self.kind)
         if row_mask is not None:
@@ -355,7 +413,7 @@ class Column:
             dtype = self.data.dtype
             return Column(
                 self.kind,
-                jnp.zeros(n, dtype),
+                jnp.zeros((n,) + self.data.shape[1:], dtype),
                 jnp.zeros(n, bool),
                 self.vocab,
             )
@@ -428,7 +486,7 @@ class Column:
         if self.kind == STR:
             data = jnp.full(n, _NULL_CODE, jnp.int32)
         else:
-            data = jnp.zeros(n, self.data.dtype)
+            data = jnp.zeros((n,) + self.data.shape[1:], self.data.dtype)
         return Column(self.kind, data, jnp.zeros(n, bool), self.vocab)
 
     def cast_f64(self) -> "Column":
@@ -495,6 +553,7 @@ class Column:
             STR: T.CTString,
             DATE: T.CTDate,
             LDT: T.CTLocalDateTime,
+            DUR: T.CTDuration,
             OBJ: T.CTAny,
         }[self.kind]
         if self.kind == F64 and self.int_flag is not None:
@@ -557,4 +616,16 @@ def constant_column(value: Any, n: int) -> Column:
         from .temporal import encode_date
 
         return Column(DATE, jnp.full(n, encode_date(value), jnp.int32), None)
+    from ...api.values import Duration
+
+    if isinstance(value, Duration):
+        row = jnp.asarray(
+            [
+                value.months,
+                value.days,
+                value.seconds * 1_000_000 + value.microseconds,
+            ],
+            jnp.int64,
+        )
+        return Column(DUR, jnp.broadcast_to(row, (n, 3)), None)
     return Column(OBJ, _obj_array([value] * n), None)

@@ -30,6 +30,7 @@ from ...ir import expr as E
 from .column import (
     BOOL,
     DATE,
+    DUR,
     F64,
     I64,
     LDT,
@@ -357,6 +358,8 @@ class TpuEvaluator:
             return self._in(expr)
         if isinstance(expr, E.Neg):
             inner = self.eval(expr.expr)
+            if inner.kind == DUR:
+                return Column(DUR, -inner.data, inner.valid)
             if inner.kind not in (I64, F64):
                 raise TpuUnsupportedExpr("negate non-numeric")
             return Column(inner.kind, -inner.data, inner.valid)
@@ -426,6 +429,25 @@ class TpuEvaluator:
             if out is None:
                 raise TpuUnsupportedExpr(f"datetime accessor {key!r}")
             return Column(I64, out, inner.valid)
+        if inner.kind == DUR:
+            # integer component functions of (months, days, total micros) —
+            # the device mirror of ir.functions.DURATION_ACCESSORS
+            m, d, us = inner.data[:, 0], inner.data[:, 1], inner.data[:, 2]
+            acc = {
+                "years": lambda: m // 12,
+                "months": lambda: m,
+                "monthsofyear": lambda: m % 12,
+                "weeks": lambda: d // 7,
+                "days": lambda: d,
+                "hours": lambda: us // (3_600 * 1_000_000),
+                "minutes": lambda: us // (60 * 1_000_000),
+                "seconds": lambda: us // 1_000_000,
+                "milliseconds": lambda: us // 1_000,
+                "microseconds": lambda: us,
+            }.get(k)
+            if acc is None:
+                raise TpuUnsupportedExpr(f"duration accessor {key!r}")
+            return Column(I64, acc().astype(jnp.int64), inner.valid)
         raise TpuUnsupportedExpr(f"property access on {inner.kind}")
 
     # -- vocab-space string ops -----------------------------------------
@@ -530,6 +552,15 @@ class TpuEvaluator:
         l, r = self.eval(expr.lhs), self.eval(expr.rhs)
         if OBJ in (l.kind, r.kind):
             raise TpuUnsupportedExpr("equality on object columns")
+        if l.kind == DUR and r.kind == DUR:
+            # component-wise (normalized storage makes this Duration.__eq__)
+            eq = jnp.all(l.data == r.data, axis=1)
+            valid = _and_valid(l, r)
+            return Column(BOOL, ~eq if isinstance(expr, E.Neq) else eq, valid)
+        if DUR in (l.kind, r.kind):
+            eq = jnp.zeros(self.n, bool)  # cross-kind equality is False
+            valid = _and_valid(l, r)
+            return Column(BOOL, ~eq if isinstance(expr, E.Neq) else eq, valid)
         try:
             l, r = self._coerce_pair(l, r)
             eq = l.data == r.data
@@ -612,6 +643,18 @@ class TpuEvaluator:
 
     def _arith(self, expr) -> Column:
         l, r = self.eval(expr.lhs), self.eval(expr.rhs)
+        if l.kind == DUR and r.kind == DUR:
+            # duration +/- duration: component-wise (reference
+            # CalendarInterval.add; the micros column renormalizes at
+            # decode via Duration.__init__)
+            if isinstance(expr, (E.Add, E.Subtract)):
+                out = (
+                    l.data + r.data
+                    if isinstance(expr, E.Add)
+                    else l.data - r.data
+                )
+                return Column(DUR, out, _and_valid(l, r))
+            raise TpuUnsupportedExpr(f"{type(expr).__name__} on durations")
         if l.kind not in (I64, F64) or r.kind not in (I64, F64):
             raise TpuUnsupportedExpr(f"arithmetic on {l.kind}/{r.kind}")
         valid = _and_valid(l, r)

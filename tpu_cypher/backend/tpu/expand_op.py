@@ -24,7 +24,9 @@ from __future__ import annotations
 
 from typing import Any, Dict, List, Optional, Tuple
 
+import jax
 import jax.numpy as jnp
+import numpy as np
 
 from ...ir import expr as E
 from ...parallel.mesh import current_mesh, mesh_size
@@ -590,15 +592,39 @@ class CsrExpandOp(_FusedExpandBase):
                 if spec is None:
                     return None  # materialized path enforces via row masks
                 carry, mask_pairs, _ = spec
+            elif (
+                len(hops) == 2
+                and jax.default_backend() == "cpu"
+                and current_mesh() is None
+            ):
+                # host tier: stamped one-pass count in C++ (native/) — no
+                # 20M-row materialize, no sort, O(N) cache-resident state
+                got = self._native_two_hop(
+                    gi, ctx, hops, id_col, use_a=use_a, use_c=use_c
+                )
+                if got is not None:
+                    return got
 
             def final(rp, ci, eo, pos, deg, akey, mask, prevs, order, midx, total):
-                # final hop: fused materialize+sort+count
+                # final hop: fused materialize + distinct count
                 if midx:
                     return int(
                         J.distinct_pairs_count_final_unique(
                             rp, ci, eo, pos, deg, akey, mask, prevs,
                             total=total, use_a=use_a, use_c=use_c,
                             num_nodes=gi.num_nodes, mask_idx=midx,
+                        )
+                    )
+                n = gi.num_nodes
+                cells = n * n if (use_a and use_c) else n
+                if jax.default_backend() == "cpu" and cells <= (1 << 30):
+                    # host: presence-bitmap scatter + popcount beats the
+                    # 20M-row sort by ~7x; TPU keeps the values-only sort
+                    return int(
+                        J.distinct_bitmap_final(
+                            rp, ci, pos, deg, akey, mask,
+                            total=total, use_a=use_a, use_c=use_c,
+                            num_nodes=n,
                         )
                     )
                 return int(
@@ -614,6 +640,28 @@ class CsrExpandOp(_FusedExpandBase):
             )
         except (GraphIndexError, TpuBackendError):
             return None
+
+    def _native_two_hop(self, gi, ctx, hops, id_col, *, use_a, use_c):
+        """Host-tier 2-hop DISTINCT count via the C++ stamping kernel
+        (``native/csr_builder.cpp``); None when the lib is unavailable or
+        the frontier isn't grouped by source."""
+        from ... import native
+
+        if native.get_lib() is None:
+            return None
+        pos, present = gi.compact_of(id_col, ctx)
+        fr = np.asarray(pos)[np.asarray(present)]
+        base, final_hop = hops[1], hops[0]
+        rp1, ci1, _ = gi.csr(base.types_key, base.backwards, ctx)
+        rp2, ci2, _ = gi.csr(final_hop.types_key, final_hop.backwards, ctx)
+        m1 = gi.label_mask(base.far_labels, ctx)
+        m2 = gi.label_mask(final_hop.far_labels, ctx)
+        return native.two_hop_distinct_native(
+            np.asarray(rp1), np.asarray(ci1), np.asarray(rp2), np.asarray(ci2),
+            fr, fr, gi.num_nodes, use_a, use_c,
+            None if m1 is None else np.asarray(m1),
+            None if m2 is None else np.asarray(m2),
+        )
 
     def _fused_table(self):
         gi = GraphIndex.of(self.graph)
@@ -780,6 +828,13 @@ class CsrExpandIntoOp(_FusedExpandBase):
                 return None  # src*N + dst probe key must fit int64
             keys = gi.edge_keys(self.types_key, ctx)
             src_is_base = self.source_fld == base.frontier_fld
+            dense = False
+            if jax.default_backend() == "cpu":
+                # host: one bitmap gather per probe replaces two binary
+                # searches over the sorted keys (~6x on the SF1 triangle)
+                bm = gi.edge_bitmap(self.types_key, ctx)
+                if bm is not None:
+                    keys, dense = bm, True
             pairs = _collected_pairs(hops, (self,))
             if pairs:
                 if self.undirected:
@@ -808,6 +863,7 @@ class CsrExpandIntoOp(_FusedExpandBase):
                             total=total, src_is_base=src_is_base,
                             num_nodes=gi.num_nodes,
                             mask_idx=midx, sub_idx=sub_idx, sub_cur=sub_cur,
+                            dense=dense,
                         )
                     )
 
@@ -815,19 +871,55 @@ class CsrExpandIntoOp(_FusedExpandBase):
                     gi, ctx, hops, id_col, final_u, carry, mask_pairs
                 )
 
+            if (
+                len(hops) == 2
+                and not self.undirected
+                and jax.default_backend() == "cpu"
+                and current_mesh() is None
+            ):
+                got = self._native_close_count(gi, ctx, hops, id_col, src_is_base)
+                if got is not None:
+                    return got
+
             def final(rp, ci, eo, pos, deg, akey, mask, prevs, order, midx, total):
                 return int(
                     J.into_close_count(
                         rp, ci, pos, deg, akey, mask, keys,
                         total=total, src_is_base=src_is_base,
                         num_nodes=gi.num_nodes,
-                        undirected=self.undirected,
+                        undirected=self.undirected, dense=dense,
                     )
                 )
 
             return _fused_chain_walk(gi, ctx, hops, id_col, final)
         except (GraphIndexError, TpuBackendError):
             return None
+
+    def _native_close_count(self, gi, ctx, hops, id_col, src_is_base):
+        """Host-tier triangle/cycle close count via the C++ stamping kernel
+        (``native/csr_builder.cpp``): pre-stamp each source's closing
+        endpoints, one multiplicity lookup per 2-hop path."""
+        from ... import native
+
+        if native.get_lib() is None:
+            return None
+        pos, present = gi.compact_of(id_col, ctx)
+        fr = np.asarray(pos)[np.asarray(present)]
+        base, final_hop = hops[1], hops[0]
+        rp1, ci1, _ = gi.csr(base.types_key, base.backwards, ctx)
+        rp2, ci2, _ = gi.csr(final_hop.types_key, final_hop.backwards, ctx)
+        # close CSR oriented FROM the walk's base endpoint a: probe (a, c)
+        # stamps a's forward close row, probe (c, a) its in-neighbors
+        rpc, cic, _ = gi.csr(self.types_key, not src_is_base, ctx)
+        m1 = gi.label_mask(base.far_labels, ctx)
+        m2 = gi.label_mask(final_hop.far_labels, ctx)
+        return native.two_hop_close_count_native(
+            np.asarray(rp1), np.asarray(ci1), np.asarray(rp2), np.asarray(ci2),
+            np.asarray(rpc), np.asarray(cic),
+            fr, fr, gi.num_nodes,
+            None if m1 is None else np.asarray(m1),
+            None if m2 is None else np.asarray(m2),
+        )
 
     def _fused_table(self):
         if not self.header.expressions:

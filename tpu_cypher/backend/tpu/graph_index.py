@@ -110,6 +110,9 @@ class GraphIndex:
         # types_key -> int64[num_rels] (src*N + dst) key per canonical
         # rel-scan row (relationship-uniqueness probe subtraction)
         self._keys_by_orig: Dict[Tuple[str, ...], Any] = {}
+        # types_key -> Optional dense bool[N*N] edge-presence bitmap (host
+        # backends probe closes by one gather instead of a binary search)
+        self._edge_bitmap: Dict[Tuple[str, ...], Optional[Any]] = {}
         # types_key -> device int64[num_nodes] self-loop counts (undirected
         # count chains subtract the double-counted loop contribution)
         self._loop_count: Dict[Tuple[str, ...], Any] = {}
@@ -324,6 +327,27 @@ class GraphIndex:
                 s.astype(np.int64) * n + d.astype(np.int64)
             )
         return got
+
+    def edge_bitmap(self, types_key: Tuple[str, ...], ctx) -> Optional[Any]:
+        """Dense int16[N*N] edge-MULTIPLICITY array for one type set (0 =
+        absent; parallel edges count), or None when N*N exceeds ~half a
+        billion cells (1GB int16) or a multiplicity overflows. Host
+        backends close triangles by ONE gather per probe instead of a 2x
+        binary search over the sorted edge keys (~12x on 20M probes); the
+        TPU keeps the searchsorted form (scatter-built dense state is the
+        slow path there, and HBM is better spent on the CSR)."""
+        if types_key not in self._edge_bitmap:
+            s, d, n = self._edge_endpoints(types_key, ctx)
+            out = None
+            if n and n * n <= (1 << 29):
+                keys = s.astype(np.int64) * n + d.astype(np.int64)
+                uniq, counts = np.unique(keys, return_counts=True)
+                if not len(counts) or counts.max() <= np.iinfo(np.int16).max:
+                    bm = np.zeros(n * n, dtype=np.int16)
+                    bm[uniq] = counts.astype(np.int16)
+                    out = jnp.asarray(bm)
+            self._edge_bitmap[types_key] = out
+        return self._edge_bitmap[types_key]
 
     def csr_max_degree(self, types_key: Tuple[str, ...], reverse: bool, ctx) -> int:
         """Host-cached max degree of one CSR orientation (computed at

@@ -33,6 +33,16 @@ I64 = "i64"
 F64 = "f64"
 BOOL = "bool"
 STR = "str"
+DUR = "dur"  # int64 (n, 3): months / days / total micros (column.DUR)
+
+# duration order key basis — ONE definition, shared with the oracle
+# (api.values.duration_order_us) so device and host ordering can never drift
+from ...api.values import _DUR_DAY_US as DUR_DAY_US  # noqa: E402
+from ...api.values import _DUR_MONTH_US as DUR_MONTH_US  # noqa: E402
+
+
+def _dur_order_key(d2):
+    return d2[:, 0] * DUR_MONTH_US + d2[:, 1] * DUR_DAY_US + d2[:, 2]
 
 
 def _exclusive_cumsum(x):
@@ -260,11 +270,12 @@ def into_probe(keys, s_pos, t_pos, ok, n, drop_loops: bool):
 
 @partial(
     jax.jit,
-    static_argnames=("total", "src_is_base", "num_nodes", "undirected"),
+    static_argnames=("total", "src_is_base", "num_nodes", "undirected", "dense"),
 )
 def into_close_count(
     rp, ci, pos, deg, akey, mask, keys,
     total: int, src_is_base: bool, num_nodes: int, undirected: bool,
+    dense: bool = False,
 ):
     """Final hop of a count(*) triangle/cycle chain: expand the last hop's
     (base key, far position) pairs and, INSTEAD of materializing columns,
@@ -273,7 +284,13 @@ def into_close_count(
     program (BASELINE config #3's workload; the materialized path needs the
     full 2-hop row set on device first). Mirrors ``into_probe`` semantics
     exactly, including the swapped-orientation half with loops dropped for
-    undirected closes."""
+    undirected closes.
+
+    ``dense``: ``keys`` is an int16[N*N] edge-MULTIPLICITY array instead of
+    the sorted key array (``GraphIndex.edge_bitmap``) — one gather per probe
+    replaces two binary searches on host backends. Parallel edges are
+    supported: the gathered value IS the count, summed exactly like the
+    searchsorted hi-lo range."""
     row, edge = _expand_rows(jnp.take(rp, pos), deg, total)
     nbr = jnp.take(ci, edge).astype(jnp.int64)
     a = jnp.take(akey, row)
@@ -282,6 +299,9 @@ def into_close_count(
 
     def probe_count(s, t, ok):
         probe = s * num_nodes + t
+        if dense:
+            got = jnp.take(keys, probe).astype(jnp.int64)
+            return jnp.sum(jnp.where(ok, got, 0))
         lo = jnp.searchsorted(keys, probe, side="left")
         hi = jnp.searchsorted(keys, probe, side="right")
         return jnp.sum(jnp.where(ok, hi - lo, 0).astype(jnp.int64))
@@ -296,12 +316,13 @@ def into_close_count(
     jax.jit,
     static_argnames=(
         "total", "src_is_base", "num_nodes", "mask_idx", "sub_idx", "sub_cur",
+        "dense",
     ),
 )
 def into_close_count_unique(
     rp, ci, eo, pos, deg, akey, mask, keys, keys_by_orig, prevs,
     total: int, src_is_base: bool, num_nodes: int,
-    mask_idx: tuple, sub_idx: tuple, sub_cur: bool,
+    mask_idx: tuple, sub_idx: tuple, sub_cur: bool, dense: bool = False,
 ):
     """``into_close_count`` with openCypher relationship-uniqueness enforced
     IN the fused program (the reference gets the same semantics from explicit
@@ -330,9 +351,12 @@ def into_close_count_unique(
         ok = ok & (orig != prevs_r[i])
     s, t = (a, nbr) if src_is_base else (nbr, a)
     probe = s * num_nodes + t
-    lo = jnp.searchsorted(keys, probe, side="left")
-    hi = jnp.searchsorted(keys, probe, side="right")
-    cnt = (hi - lo).astype(jnp.int64)
+    if dense:
+        cnt = jnp.take(keys, probe).astype(jnp.int64)
+    else:
+        lo = jnp.searchsorted(keys, probe, side="left")
+        hi = jnp.searchsorted(keys, probe, side="right")
+        cnt = (hi - lo).astype(jnp.int64)
     subbed = []
     if sub_cur:
         cnt = cnt - (jnp.take(keys_by_orig, orig) == probe).astype(jnp.int64)
@@ -621,6 +645,35 @@ def distinct_pairs_count_final(
     return bounds + (valid_n > 0).astype(jnp.int64)
 
 
+@partial(jax.jit, static_argnames=("total", "use_a", "use_c", "num_nodes"))
+def distinct_bitmap_final(
+    rp, ci, pos, deg, akey, mask,
+    total: int, use_a: bool, use_c: bool, num_nodes: int,
+):
+    """Host-backend variant of ``distinct_pairs_count_final``: scatter the
+    packed endpoint keys into a presence bitmap and popcount — one random
+    write per row beats the values-only sort's log(n) compare-exchange
+    passes on CPU (SF1: ~20M rows sorted in ~2s vs ~0.3s scattered). The
+    TPU keeps the sort form (``lax.sort`` is fast there, scatter is not).
+    Masked rows land in a spill slot past the counted range."""
+    row, edge = _expand_rows(jnp.take(rp, pos), deg, total)
+    nbr = jnp.take(ci, edge).astype(jnp.int64)
+    if use_a and use_c:
+        key = jnp.take(akey, row) * num_nodes + nbr
+        size = num_nodes * num_nodes
+    elif use_a:
+        key = jnp.take(akey, row)
+        size = num_nodes
+    else:
+        key = nbr
+        size = num_nodes
+    if mask is not None:
+        present = jnp.take(mask, nbr)
+        key = jnp.where(present, key, size)
+    bitmap = jnp.zeros(size + 1, bool).at[key].set(True)
+    return jnp.sum(bitmap[:size].astype(jnp.int64))
+
+
 @partial(jax.jit, static_argnames=("total", "mask_idx"))
 def unique_hop_materialize(
     rp, ci, eo, pos, deg, akey, mask, prevs, total: int, mask_idx: tuple
@@ -721,6 +774,15 @@ def _equivalence_keys_traced(datas, valids, kinds):
     keys implement ``=`` semantics instead (NaN never matches)."""
     keys = []
     for d, v, k in zip(datas, valids, kinds):
+        if k == DUR:
+            # one key per component: row equality == Duration.__eq__ (the
+            # storage is normalized, so the triple is canonical)
+            for j in range(3):
+                cj = d[:, j]
+                keys.append(cj if v is None else jnp.where(v, cj, 0))
+            if v is not None:
+                keys.append(~v)
+            continue
         if k == F64:
             valid = v if v is not None else jnp.ones(d.shape[0], bool)
             nan = jnp.isnan(d) & valid
@@ -756,6 +818,51 @@ def equivalence_minmax(datas, valids, extra_keys, kinds):
         jnp.stack([k.min() for k in ints]),
         jnp.stack([k.max() for k in ints]),
     )
+
+
+@partial(jax.jit, static_argnames=("k", "name"))
+def segment_duration_agg(data, valid, seg, k: int, name: str):
+    """Duration aggregates over the (months, days, micros) device triple —
+    the TPU analog of the reference's CalendarInterval UDAFs
+    (``TemporalUdafs.scala``): sum/avg component-wise (avg floors the
+    NORMALIZED seconds/micros split separately, matching the oracle's
+    ``Duration(m//k, d//k, s//k, us//k)``), min/max by average-length key
+    with first-occurrence tie selection (== Python ``min``/``max``).
+    Returns (out_data (k,3) int64, any_valid (k,) bool, cnt (k,) int64)."""
+    n = data.shape[0]
+    v = valid if valid is not None else jnp.ones(n, bool)
+    cnt = jax.ops.segment_sum(v.astype(jnp.int64), seg, num_segments=k)
+    any_valid = cnt > 0
+    if name in ("sum", "avg"):
+        zd = jnp.where(v[:, None], data, 0)
+        m = jax.ops.segment_sum(zd[:, 0], seg, num_segments=k)
+        d = jax.ops.segment_sum(zd[:, 1], seg, num_segments=k)
+        us = jax.ops.segment_sum(zd[:, 2], seg, num_segments=k)
+        if name == "sum":
+            return jnp.stack([m, d, us], axis=1), any_valid, cnt
+        c = jnp.maximum(cnt, 1)
+        s_n, us_n = us // 1_000_000, us % 1_000_000
+        out = jnp.stack(
+            [m // c, d // c, (s_n // c) * 1_000_000 + us_n // c], axis=1
+        )
+        return out, any_valid, cnt
+    key = _dur_order_key(data)
+    big = jnp.iinfo(jnp.int64).max
+    if name == "min":
+        best = jax.ops.segment_min(
+            jnp.where(v, key, big), seg, num_segments=k
+        )
+    else:
+        best = jax.ops.segment_max(
+            jnp.where(v, key, -big), seg, num_segments=k
+        )
+    hit = v & (key == jnp.take(best, seg))
+    rows = jnp.arange(n, dtype=jnp.int64)
+    first = jax.ops.segment_min(
+        jnp.where(hit, rows, n), seg, num_segments=k
+    )
+    out = jnp.take(data, jnp.clip(first, 0, max(n - 1, 0)), axis=0)
+    return out, any_valid, cnt
 
 
 @partial(jax.jit, static_argnames=("kinds", "pack"))
@@ -814,6 +921,10 @@ def order_permutation(datas, valids, kinds, ascs):
         null = (
             ~v if v is not None else jnp.zeros(d.shape[0], bool)
         )
+        if k == DUR:
+            # average-length key; equal keys keep original order (stable
+            # lexsort) — same tie policy as the oracle's order_key
+            d = _dur_order_key(d)
         if k == BOOL:
             d = d.astype(jnp.int8)
         if k == F64:

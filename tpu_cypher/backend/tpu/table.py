@@ -46,6 +46,7 @@ from . import jit_ops as J
 from .column import (
     BOOL,
     DATE,
+    DUR,
     F64,
     I64,
     INTEGRAL_KINDS,
@@ -835,6 +836,29 @@ class TpuTable(Table):
             from .column import _obj_array
 
             return Column(OBJ, _obj_array(lists), None)
+        if kind == DUR:
+            # device duration aggregates (reference TemporalUdafs.scala)
+            if name not in ("count", "sum", "avg", "min", "max"):
+                raise TpuUnsupportedExpr(f"{name} over durations")
+            if n == 0:
+                if name == "count":
+                    return Column(I64, jnp.zeros(k, jnp.int64), None)
+                if name == "sum":
+                    # empty duration sum is INTEGER 0 in the oracle — a
+                    # kind the device duration column cannot hold
+                    raise TpuUnsupportedExpr("sum over empty duration group")
+                return Column(
+                    DUR, jnp.zeros((k, 3), jnp.int64), jnp.zeros(k, bool)
+                )
+            out_data, any_valid, cnt = J.segment_duration_agg(
+                data, col.valid, seg_j, k=k, name=name
+            )
+            if name == "count":
+                return Column(I64, cnt, None)
+            all_valid = int(J.mask_sum(any_valid)) == k
+            if name == "sum" and not all_valid:
+                raise TpuUnsupportedExpr("sum over empty duration group")
+            return Column(DUR, out_data, None if all_valid else any_valid)
         if name in ("sum", "avg", "stdev", "stdevp") and kind not in (I64, F64):
             raise TpuUnsupportedExpr(f"{name} over {kind}")
         if name in ("percentilecont", "percentiledisc"):
@@ -870,7 +894,7 @@ class TpuTable(Table):
             raise TpuUnsupportedExpr("percentile fraction out of range")
         p = float(p)
         data, kind, vocab = col.data, col.kind, col.vocab
-        if kind in (OBJ, BOOL, DATE, LDT):
+        if kind in (OBJ, BOOL, DATE, LDT, DUR):
             # STR stays: percentileDisc over order-preserving dictionary
             # codes is a device sort+gather; temporal kinds keep the
             # oracle's type-error semantics

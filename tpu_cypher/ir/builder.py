@@ -253,11 +253,56 @@ class IRBuilder:
                 rp: A.RelPattern = elems[j]
                 nxt = node_field(elems[j + 1])
                 rname = rp.var or self.fresh_name("r")
-                if rname in env or rname in ir.rel_types or rname in ir.node_types:
+                if rname in ir.rel_types or rname in ir.node_types:
                     # openCypher: a relationship variable cannot be re-bound
+                    # within one pattern
                     raise IRBuildError(
                         f"Relationship variable {rname!r} bound more than once"
                     )
+                bound_prev = env.get(rname)
+                if bound_prev is not None:
+                    # pre-bound relationship variable: plan the pattern step
+                    # with a hidden fresh variable and JOIN it back on
+                    # identity (the reference's bound-relationship planning;
+                    # its failing_blacklist VarLengthAcceptance2 marks the
+                    # var-length form — here the walked rel LIST must equal
+                    # the bound value, [r] for a single pre-bound rel)
+                    base = bound_prev.material
+                    outer = E.Var(rname).with_type(bound_prev)
+                    hidden = self.fresh_name("r")
+                    is_varlen = rp.length is not None and rp.length != (1, 1)
+                    if isinstance(base, T.CTRelationshipType) and not is_varlen:
+                        inner_t = T.CTRelationshipType(rp.types)
+                        predicates.append(
+                            E.Equals(
+                                E.Id(E.Var(hidden).with_type(inner_t)).with_type(
+                                    T.CTInteger
+                                ),
+                                E.Id(outer).with_type(T.CTInteger),
+                            ).with_type(T.CTBoolean)
+                        )
+                    elif isinstance(
+                        base, (T.CTRelationshipType, T.CTListType)
+                    ) and is_varlen:
+                        inner_t = T.CTListType(
+                            T.CTRelationshipType(rp.types)
+                        )
+                        rhs = (
+                            E.ListLit((outer,)).with_type(inner_t)
+                            if isinstance(base, T.CTRelationshipType)
+                            else outer
+                        )
+                        predicates.append(
+                            E.Equals(
+                                E.Var(hidden).with_type(inner_t), rhs
+                            ).with_type(T.CTBoolean.nullable)
+                        )
+                    else:
+                        raise IRBuildError(
+                            f"Variable {rname!r} already bound to {base!r}, "
+                            "cannot re-bind as relationship"
+                        )
+                    rname = hidden
                 rt = T.CTRelationshipType(rp.types)
                 ir.rel_types[rname] = rt
                 if rp.direction == INCOMING:

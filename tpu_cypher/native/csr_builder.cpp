@@ -134,4 +134,93 @@ int32_t build_csr(const int64_t* node_ids, int64_t n,
     return 0;
 }
 
+// Stamped 2-hop DISTINCT-endpoints count: the host-tier replacement for
+// materialize-20M-rows-then-sort (engine analog of a merge-free boolean
+// SpGEMM row count). One pass over the path space with an O(N) timestamp
+// array that lives in cache: stamp[c] == a marks pair (a, c) as seen.
+// PRECONDITION (checked by the ctypes wrapper): equal akeys are contiguous
+// (each source one run), so a stamp from an earlier source can never be
+// confused with the current one.
+//   rp1/ci1: hop-1 CSR (frontier -> b), rp2/ci2: hop-2 CSR (b -> c)
+//   frontier/akeys: compact position + distinct-group key per input row
+//   mask1/mask2: optional bool masks on b / c (null = unrestricted)
+//   use_a/use_c: which endpoints the DISTINCT covers
+int64_t two_hop_distinct(const int32_t* rp1, const int32_t* ci1,
+                         const int32_t* rp2, const int32_t* ci2,
+                         const int64_t* frontier, const int64_t* akeys,
+                         int64_t nf, int64_t n, int32_t use_a, int32_t use_c,
+                         const uint8_t* mask1, const uint8_t* mask2) {
+    std::vector<int64_t> stamp(n, -1);
+    int64_t cnt = 0;
+    int64_t last_counted_a = -1;
+    for (int64_t i = 0; i < nf; i++) {
+        int64_t a = use_a ? akeys[i] : 0;  // !use_a: one global dedup group
+        if (!use_c && use_a && a == last_counted_a) continue;
+        int64_t p = frontier[i];
+        bool found = false;
+        for (int32_t e1 = rp1[p]; e1 < rp1[p + 1] && !(found && !use_c); e1++) {
+            int32_t b = ci1[e1];
+            if (mask1 && !mask1[b]) continue;
+            for (int32_t e2 = rp2[b]; e2 < rp2[b + 1]; e2++) {
+                int32_t c = ci2[e2];
+                if (mask2 && !mask2[c]) continue;
+                if (!use_c) { found = true; break; }
+                if (stamp[c] != a) {
+                    stamp[c] = a;
+                    cnt++;
+                }
+            }
+        }
+        if (!use_c && found) {
+            cnt++;
+            last_counted_a = a;
+        }
+    }
+    return cnt;
+}
+
+// Stamped 2-hop + ExpandInto close count (directed triangles / 2-hop
+// cycles): per source a, pre-stamp the closing endpoints x reachable by a
+// closing edge (rpc/cic = the close CSR oriented FROM a) with their edge
+// multiplicities, then every surviving 2-hop path (a, b, c) adds the
+// multiplicity of closing edges at c. Matches the searchsorted probe's
+// hi-lo semantics exactly, parallel edges included. Same grouped-akeys
+// precondition as two_hop_distinct.
+int64_t two_hop_close_count(const int32_t* rp1, const int32_t* ci1,
+                            const int32_t* rp2, const int32_t* ci2,
+                            const int32_t* rpc, const int32_t* cic,
+                            const int64_t* frontier, const int64_t* akeys,
+                            int64_t nf, int64_t n,
+                            const uint8_t* mask1, const uint8_t* mask2) {
+    std::vector<int64_t> stamp(n, -1);
+    std::vector<int32_t> mult(n, 0);
+    int64_t cnt = 0;
+    int64_t stamped_a = -1;
+    for (int64_t i = 0; i < nf; i++) {
+        int64_t a = akeys[i];
+        if (a != stamped_a) {
+            for (int32_t e = rpc[a]; e < rpc[a + 1]; e++) {
+                int32_t x = cic[e];
+                if (stamp[x] != a) {
+                    stamp[x] = a;
+                    mult[x] = 0;
+                }
+                mult[x]++;
+            }
+            stamped_a = a;
+        }
+        int64_t p = frontier[i];
+        for (int32_t e1 = rp1[p]; e1 < rp1[p + 1]; e1++) {
+            int32_t b = ci1[e1];
+            if (mask1 && !mask1[b]) continue;
+            for (int32_t e2 = rp2[b]; e2 < rp2[b + 1]; e2++) {
+                int32_t c = ci2[e2];
+                if (mask2 && !mask2[c]) continue;
+                if (stamp[c] == a) cnt += mult[c];
+            }
+        }
+    }
+    return cnt;
+}
+
 }  // extern "C"

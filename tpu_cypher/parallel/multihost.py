@@ -161,14 +161,24 @@ def dryrun_multihost() -> dict:
         got = g.cypher(
             "MATCH (a:P)-[:K]->(b)-[:K]->(c) RETURN count(*) AS c"
         ).records.collect()
+        # row-returning query: materializes SHARDED columns, so the host
+        # pull must assemble shards across processes (column.to_host's
+        # collective allgather — the collect-to-driver step)
+        rows = g.cypher(
+            "MATCH (a:P)-[:K]->(b) RETURN id(a) AS x ORDER BY x LIMIT 5"
+        ).records.collect()
     outdeg = np.bincount(np.searchsorted(np.sort(ids), ids[src]), minlength=n)
     expected = int(outdeg[np.searchsorted(np.sort(ids), ids[dst])].sum())
     count = int(got[0]["c"])
     assert count == expected, (count, expected)
+    expected_rows = sorted(int(i) for i in ids[src])[:5]
+    got_rows = [int(r["x"]) for r in rows]
+    assert got_rows == expected_rows, (got_rows, expected_rows)
     return {
         "processes": process_count(),
         "devices": len(jax.devices()),
         "mesh_axes": dict(mesh.shape),
         "two_hop": count,
+        "rows": got_rows,
         "host0": is_host0(),
     }

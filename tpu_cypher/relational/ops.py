@@ -10,6 +10,8 @@ reference keeps inside its graph implementations."""
 
 from __future__ import annotations
 
+import math
+
 from dataclasses import dataclass, field as dc_field
 from functools import cached_property
 from typing import Any, Dict, List, Optional, Sequence, Tuple
@@ -658,6 +660,10 @@ class UnionAllOp(RelationalOperator):
 
 
 def _static_value(expr: E.Expr, params: Dict[str, Any]):
+    """Constant-fold a variable-free SKIP/LIMIT expression (literals,
+    parameters, and arithmetic over them — ``SKIP 1 + 1``; reference
+    ``SkipLimitAcceptance``). Anything mentioning a variable stays an
+    error, matching openCypher's static requirement."""
     if isinstance(expr, E.Lit):
         return expr.value
     if isinstance(expr, E.Param):
@@ -665,6 +671,33 @@ def _static_value(expr: E.Expr, params: Dict[str, Any]):
     if isinstance(expr, E.Neg):
         v = _static_value(expr.expr, params)
         return -v if v is not None else None
+    if isinstance(expr, E.ArithmeticExpr) and isinstance(
+        expr, (E.Add, E.Subtract, E.Multiply, E.Divide, E.Modulo)
+    ):
+        l = _static_value(expr.lhs, params)
+        r = _static_value(expr.rhs, params)
+        if l is None or r is None:
+            return None
+        if isinstance(expr, E.Add):
+            return l + r
+        if isinstance(expr, E.Subtract):
+            return l - r
+        if isinstance(expr, E.Multiply):
+            return l * r
+        both_int = isinstance(l, int) and isinstance(r, int)
+        if isinstance(expr, E.Divide):
+            if both_int:
+                if r == 0:
+                    raise RelationalError("/ by zero")
+                q = abs(l) // abs(r)  # Cypher int division truncates to zero
+                return q if (l >= 0) == (r >= 0) else -q
+            return l / r
+        if both_int:
+            if r == 0:
+                raise RelationalError("% by zero")
+            m = abs(l) % abs(r)
+            return m if l >= 0 else -m
+        return math.fmod(l, r)
     raise RelationalError(
         f"Expected a literal or parameter, got {expr.pretty_expr()}"
     )
