@@ -1,0 +1,246 @@
+Feature: AggregationAcceptance2
+
+  Scenario: Implicit grouping keys come from non-aggregated columns
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:N {g: 'a', v: 1}), (:N {g: 'a', v: 2}), (:N {g: 'b', v: 3})
+      """
+    When executing query:
+      """
+      MATCH (n:N) RETURN n.g AS g, sum(n.v) AS s, count(*) AS c ORDER BY g
+      """
+    Then the result should be, in order:
+      | g   | s | c |
+      | 'a' | 3 | 2 |
+      | 'b' | 3 | 1 |
+    And no side effects
+
+  Scenario: Null group keys form their own group
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:N {v: 1}), (:N {g: 'a', v: 2}), (:N {v: 3})
+      """
+    When executing query:
+      """
+      MATCH (n:N) RETURN n.g AS g, sum(n.v) AS s ORDER BY g
+      """
+    Then the result should be, in order:
+      | g    | s |
+      | 'a'  | 2 |
+      | null | 4 |
+    And no side effects
+
+  Scenario: Aggregates over no rows
+    Given an empty graph
+    When executing query:
+      """
+      MATCH (n:Missing)
+      RETURN count(n) AS c, sum(n.v) AS s, min(n.v) AS lo, collect(n) AS l
+      """
+    Then the result should be, in any order:
+      | c | s | lo   | l  |
+      | 0 | 0 | null | [] |
+    And no side effects
+
+  Scenario: avg of integers can be fractional
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:N {v: 1}), (:N {v: 2})
+      """
+    When executing query:
+      """
+      MATCH (n:N) RETURN avg(n.v) AS a
+      """
+    Then the result should be, in any order:
+      | a   |
+      | 1.5 |
+    And no side effects
+
+  Scenario: min and max skip nulls but keep zero
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:N {v: 0}), (:N), (:N {v: -1})
+      """
+    When executing query:
+      """
+      MATCH (n:N) RETURN min(n.v) AS lo, max(n.v) AS hi
+      """
+    Then the result should be, in any order:
+      | lo | hi |
+      | -1 | 0  |
+    And no side effects
+
+  Scenario: count DISTINCT versus plain count
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:N {v: 1}), (:N {v: 1}), (:N {v: 2}), (:N)
+      """
+    When executing query:
+      """
+      MATCH (n:N)
+      RETURN count(n.v) AS c, count(DISTINCT n.v) AS d, count(*) AS all
+      """
+    Then the result should be, in any order:
+      | c | d | all |
+      | 3 | 2 | 4   |
+    And no side effects
+
+  Scenario: sum DISTINCT adds each value once
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:N {v: 5}), (:N {v: 5}), (:N {v: 2})
+      """
+    When executing query:
+      """
+      MATCH (n:N) RETURN sum(DISTINCT n.v) AS s
+      """
+    Then the result should be, in any order:
+      | s |
+      | 7 |
+    And no side effects
+
+  Scenario: collect DISTINCT preserves first-appearance order
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:N {i: 1, v: 'b'}), (:N {i: 2, v: 'a'}), (:N {i: 3, v: 'b'})
+      """
+    When executing query:
+      """
+      MATCH (n:N) WITH n.v AS v ORDER BY n.i
+      RETURN collect(DISTINCT v) AS l
+      """
+    Then the result should be, in any order:
+      | l          |
+      | ['b', 'a'] |
+    And no side effects
+
+  Scenario: min over strings is lexicographic
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:N {s: 'pear'}), (:N {s: 'apple'}), (:N {s: 'fig'})
+      """
+    When executing query:
+      """
+      MATCH (n:N) RETURN min(n.s) AS lo, max(n.s) AS hi
+      """
+    Then the result should be, in any order:
+      | lo      | hi     |
+      | 'apple' | 'pear' |
+    And no side effects
+
+  Scenario: Aggregation after WITH aggregation chains
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:N {g: 'a', v: 1}), (:N {g: 'a', v: 2}), (:N {g: 'b', v: 5})
+      """
+    When executing query:
+      """
+      MATCH (n:N) WITH n.g AS g, sum(n.v) AS s
+      RETURN max(s) AS top, count(*) AS groups
+      """
+    Then the result should be, in any order:
+      | top | groups |
+      | 5   | 2      |
+    And no side effects
+
+  Scenario: WHERE after WITH aggregation filters groups
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:N {g: 'a', v: 1}), (:N {g: 'a', v: 2}), (:N {g: 'b', v: 5})
+      """
+    When executing query:
+      """
+      MATCH (n:N) WITH n.g AS g, count(*) AS c WHERE c > 1
+      RETURN g, c
+      """
+    Then the result should be, in any order:
+      | g   | c |
+      | 'a' | 2 |
+    And no side effects
+
+  Scenario: stdev of a singleton group is zero
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:N {v: 4})
+      """
+    When executing query:
+      """
+      MATCH (n:N) RETURN stdev(n.v) AS s
+      """
+    Then the result should be, in any order:
+      | s   |
+      | 0.0 |
+    And no side effects
+
+  Scenario: percentileDisc picks an actual value
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:N {v: 10}), (:N {v: 20}), (:N {v: 30})
+      """
+    When executing query:
+      """
+      MATCH (n:N)
+      RETURN percentileDisc(n.v, 0.5) AS med, percentileDisc(n.v, 0.0) AS lo
+      """
+    Then the result should be, in any order:
+      | med | lo |
+      | 20  | 10 |
+    And no side effects
+
+  Scenario: Aggregating booleans with count
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:N {f: true}), (:N {f: false}), (:N {f: true}), (:N)
+      """
+    When executing query:
+      """
+      MATCH (n:N) WHERE n.f RETURN count(*) AS c
+      """
+    Then the result should be, in any order:
+      | c |
+      | 2 |
+    And no side effects
+
+  Scenario: Grouping by two keys
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:N {a: 1, b: 'x'}), (:N {a: 1, b: 'y'}), (:N {a: 1, b: 'x'})
+      """
+    When executing query:
+      """
+      MATCH (n:N) RETURN n.a AS a, n.b AS b, count(*) AS c ORDER BY b
+      """
+    Then the result should be, in order:
+      | a | b   | c |
+      | 1 | 'x' | 2 |
+      | 1 | 'y' | 1 |
+    And no side effects
+
+  Scenario: max of mixed int and float compares numerically
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:N {v: 2}), (:N {v: 2.5})
+      """
+    When executing query:
+      """
+      MATCH (n:N) RETURN max(n.v) AS hi, min(n.v) AS lo
+      """
+    Then the result should be, in any order:
+      | hi  | lo |
+      | 2.5 | 2  |
+    And no side effects

@@ -1,0 +1,186 @@
+Feature: BoundRelationships
+
+  Scenario: Rebinding a relationship variable keeps its identity
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:A {n: 1})-[:R {w: 7}]->(:B {n: 2})
+      """
+    When executing query:
+      """
+      MATCH ()-[r:R]->() WITH r MATCH (x)-[r]->(y)
+      RETURN x.n AS xn, r.w AS w, y.n AS yn
+      """
+    Then the result should be, in any order:
+      | xn | w | yn |
+      | 1  | 7 | 2  |
+    And no side effects
+
+  Scenario: Rebinding selects only the matching relationship
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:A)-[:R {w: 1}]->(:B), (:A)-[:R {w: 2}]->(:B)
+      """
+    When executing query:
+      """
+      MATCH ()-[r:R {w: 1}]->() WITH r MATCH (x)-[r]->(y) RETURN count(*) AS c
+      """
+    Then the result should be, in any order:
+      | c |
+      | 1 |
+    And no side effects
+
+  Scenario: Rebinding without WITH joins within one query
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:A)-[:R]->(:B), (:A)-[:R]->(:B)
+      """
+    When executing query:
+      """
+      MATCH ()-[r:R]->() MATCH (x)-[r]->(y) RETURN count(*) AS c
+      """
+    Then the result should be, in any order:
+      | c |
+      | 2 |
+    And no side effects
+
+  Scenario: A bound relationship in a var-length pattern pins the path
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:S)-[:R]->(:E)
+      """
+    When executing query:
+      """
+      MATCH ()-[r:R]->() MATCH (a)-[r*1..2]->(b) RETURN count(*) AS c
+      """
+    Then the result should be, in any order:
+      | c |
+      | 1 |
+    And no side effects
+
+  Scenario: A bound single relationship never matches longer paths
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:S)-[:R]->(:M)-[:R]->(:E)
+      """
+    When executing query:
+      """
+      MATCH (:S)-[r:R]->() MATCH (a)-[r*2..2]->(b) RETURN count(*) AS c
+      """
+    Then the result should be, in any order:
+      | c |
+      | 0 |
+    And no side effects
+
+  Scenario: Rebinding a var-length list variable pins the whole walk
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (a:S)-[:R]->(b:M)-[:R]->(c:E)
+      """
+    When executing query:
+      """
+      MATCH (s:S)-[r*1..2]->(e:E) WITH r, e MATCH (s2)-[r*1..2]->(e)
+      RETURN count(*) AS c
+      """
+    Then the result should be, in any order:
+      | c |
+      | 1 |
+    And no side effects
+
+  Scenario: Rebinding respects the direction of the new pattern
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:A {n: 1})-[:R]->(:B {n: 2})
+      """
+    When executing query:
+      """
+      MATCH ()-[r:R]->() WITH r MATCH (x)<-[r]-(y)
+      RETURN x.n AS xn, y.n AS yn
+      """
+    Then the result should be, in any order:
+      | xn | yn |
+      | 2  | 1  |
+    And no side effects
+
+  Scenario: Rebinding with a disjoint type restriction matches nothing
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:A)-[:R]->(:B)
+      """
+    When executing query:
+      """
+      MATCH ()-[r:R]->() WITH r MATCH (x)-[r:OTHER]->(y) RETURN count(*) AS c
+      """
+    Then the result should be, in any order:
+      | c |
+      | 0 |
+    And no side effects
+
+  Scenario: Rebinding an OPTIONAL MATCH relationship variable
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:A {n: 1})-[:R {w: 3}]->(:B)
+      """
+    When executing query:
+      """
+      MATCH ()-[r:R]->() WITH r MATCH (x)-[r]->() WHERE x.n = 1
+      RETURN r.w AS w
+      """
+    Then the result should be, in any order:
+      | w |
+      | 3 |
+    And no side effects
+
+  Scenario: Two bound relationships joined in one later pattern
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (a:A)-[:R {w: 1}]->(b:B)-[:R {w: 2}]->(c:C)
+      """
+    When executing query:
+      """
+      MATCH ()-[r1:R {w: 1}]->() MATCH ()-[r2:R {w: 2}]->()
+      MATCH (x)-[r1]->(y)-[r2]->(z)
+      RETURN x.n IS NULL AS xn, count(*) AS c
+      """
+    Then the result should be, in any order:
+      | xn   | c |
+      | true | 1 |
+    And no side effects
+
+  Scenario: Rebinding a node variable as a relationship is an error
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:A)-[:R]->(:B)
+      """
+    When executing query:
+      """
+      MATCH (n:A) WITH n MATCH ()-[n]->() RETURN n
+      """
+    Then a SyntaxError should be raised at compile time: VariableTypeConflict
+    And no side effects
+
+  Scenario: Bound relationship endpoints constrain node bindings
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:A {n: 1})-[:R]->(:B {n: 2}), (:A {n: 3})-[:R]->(:B {n: 4})
+      """
+    When executing query:
+      """
+      MATCH (s {n: 1})-[r:R]->() WITH r MATCH (x)-[r]->(y)
+      RETURN x.n AS xn, y.n AS yn
+      """
+    Then the result should be, in any order:
+      | xn | yn |
+      | 1  | 2  |
+    And no side effects

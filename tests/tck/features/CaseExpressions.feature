@@ -1,0 +1,124 @@
+Feature: CaseExpressions
+
+  Scenario: Simple CASE dispatches on value
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:N {v: 1}), (:N {v: 2}), (:N {v: 3})
+      """
+    When executing query:
+      """
+      MATCH (n:N)
+      RETURN n.v AS v,
+             CASE n.v WHEN 1 THEN 'one' WHEN 2 THEN 'two' ELSE 'many' END AS w
+      ORDER BY v
+      """
+    Then the result should be, in order:
+      | v | w      |
+      | 1 | 'one'  |
+      | 2 | 'two'  |
+      | 3 | 'many' |
+    And no side effects
+
+  Scenario: Simple CASE without ELSE yields null
+    Given an empty graph
+    When executing query:
+      """
+      RETURN CASE 5 WHEN 1 THEN 'one' END AS v
+      """
+    Then the result should be, in any order:
+      | v    |
+      | null |
+    And no side effects
+
+  Scenario: Searched CASE takes the first true branch
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:N {v: 15})
+      """
+    When executing query:
+      """
+      MATCH (n:N)
+      RETURN CASE WHEN n.v > 10 THEN 'big' WHEN n.v > 0 THEN 'small' END AS s
+      """
+    Then the result should be, in any order:
+      | s     |
+      | 'big' |
+    And no side effects
+
+  Scenario: Searched CASE null conditions are not taken
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:N)
+      """
+    When executing query:
+      """
+      MATCH (n:N)
+      RETURN CASE WHEN n.v > 0 THEN 'pos' ELSE 'other' END AS s
+      """
+    Then the result should be, in any order:
+      | s       |
+      | 'other' |
+    And no side effects
+
+  Scenario: CASE branches may produce different types
+    Given an empty graph
+    When executing query:
+      """
+      UNWIND [1, 2] AS x
+      RETURN CASE x WHEN 1 THEN 'one' ELSE x END AS v
+      """
+    Then the result should be, in any order:
+      | v     |
+      | 'one' |
+      | 2     |
+    And no side effects
+
+  Scenario: CASE nests inside aggregation
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:N {v: 1}), (:N {v: 5}), (:N {v: 9})
+      """
+    When executing query:
+      """
+      MATCH (n:N)
+      RETURN sum(CASE WHEN n.v > 4 THEN 1 ELSE 0 END) AS bigs
+      """
+    Then the result should be, in any order:
+      | bigs |
+      | 2    |
+    And no side effects
+
+  Scenario: CASE on a null operand matches no WHEN
+    Given an empty graph
+    When executing query:
+      """
+      WITH null AS x
+      RETURN CASE x WHEN 1 THEN 'one' ELSE 'dunno' END AS v
+      """
+    Then the result should be, in any order:
+      | v       |
+      | 'dunno' |
+    And no side effects
+
+  Scenario: CASE result feeds ORDER BY
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:N {s: 'b'}), (:N {s: 'a'}), (:N {s: 'c'})
+      """
+    When executing query:
+      """
+      MATCH (n:N)
+      RETURN n.s AS s
+      ORDER BY CASE n.s WHEN 'c' THEN 0 ELSE 1 END, s
+      """
+    Then the result should be, in order:
+      | s   |
+      | 'c' |
+      | 'a' |
+      | 'b' |
+    And no side effects

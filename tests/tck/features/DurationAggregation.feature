@@ -1,0 +1,256 @@
+Feature: DurationAggregation
+
+  Scenario: Sum of durations adds component-wise
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:E {d: duration('P1M2D')}), (:E {d: duration('P2M3DT4H')})
+      """
+    When executing query:
+      """
+      MATCH (e:E) RETURN toString(sum(e.d)) AS s
+      """
+    Then the result should be, in any order:
+      | s           |
+      | 'P3M5DT4H'  |
+    And no side effects
+
+  Scenario: Min and max order durations by average length
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:E {d: duration('P1M')}), (:E {d: duration('P40D')}),
+             (:E {d: duration('PT1H')})
+      """
+    When executing query:
+      """
+      MATCH (e:E) RETURN toString(min(e.d)) AS lo, toString(max(e.d)) AS hi
+      """
+    Then the result should be, in any order:
+      | lo     | hi     |
+      | 'PT1H' | 'P40D' |
+    And no side effects
+
+  Scenario: Average of durations floors each component
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:E {d: duration('P3M3D')}), (:E {d: duration('P0D')})
+      """
+    When executing query:
+      """
+      MATCH (e:E) RETURN toString(avg(e.d)) AS a
+      """
+    Then the result should be, in any order:
+      | a       |
+      | 'P1M1D' |
+    And no side effects
+
+  Scenario: Aggregation skips null durations
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:E {d: duration('P2D'), k: 1}), (:E {k: 1})
+      """
+    When executing query:
+      """
+      MATCH (e:E) RETURN count(e.d) AS c, toString(min(e.d)) AS lo
+      """
+    Then the result should be, in any order:
+      | c | lo    |
+      | 1 | 'P2D' |
+    And no side effects
+
+  Scenario: Grouped duration aggregates per key
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:E {k: 1, d: duration('P1D')}), (:E {k: 1, d: duration('P3D')}),
+             (:E {k: 2, d: duration('PT6H')})
+      """
+    When executing query:
+      """
+      MATCH (e:E) RETURN e.k AS k, toString(sum(e.d)) AS s ORDER BY k
+      """
+    Then the result should be, in order:
+      | k | s      |
+      | 1 | 'P4D'  |
+      | 2 | 'PT6H' |
+    And no side effects
+
+  Scenario: Min of an all-null duration group is null
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:E {k: 1})
+      """
+    When executing query:
+      """
+      MATCH (e:E) RETURN e.k AS k, min(e.d) AS lo
+      """
+    Then the result should be, in any order:
+      | k | lo   |
+      | 1 | null |
+    And no side effects
+
+  Scenario: DISTINCT count of equal durations collapses
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:E {d: duration('P1D')}), (:E {d: duration('P1D')}),
+             (:E {d: duration('PT24H')})
+      """
+    When executing query:
+      """
+      MATCH (e:E) RETURN count(DISTINCT e.d) AS c
+      """
+    Then the result should be, in any order:
+      | c |
+      | 2 |
+    And no side effects
+
+  Scenario: Durations group as keys component-wise
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:E {d: duration('P1M')}), (:E {d: duration('P30D')}),
+             (:E {d: duration('P1M')})
+      """
+    When executing query:
+      """
+      MATCH (e:E) RETURN toString(e.d) AS d, count(*) AS c ORDER BY c DESC
+      """
+    Then the result should be, in order:
+      | d      | c |
+      | 'P1M'  | 2 |
+      | 'P30D' | 1 |
+    And no side effects
+
+  Scenario: ORDER BY duration uses average length ascending
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:E {d: duration('P1M5D')}), (:E {d: duration('P20D')}),
+             (:E {d: duration('-P1D')})
+      """
+    When executing query:
+      """
+      MATCH (e:E) RETURN toString(e.d) AS d ORDER BY e.d
+      """
+    Then the result should be, in order:
+      | d       |
+      | 'P-1D'  |
+      | 'P20D'  |
+      | 'P1M5D' |
+    And no side effects
+
+  Scenario: ORDER BY duration descending with nulls first
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:E {d: duration('P2D'), k: 1}), (:E {k: 2}),
+             (:E {d: duration('P1D'), k: 3})
+      """
+    When executing query:
+      """
+      MATCH (e:E) RETURN e.k AS k ORDER BY e.d DESC
+      """
+    Then the result should be, in order:
+      | k |
+      | 2 |
+      | 1 |
+      | 3 |
+    And no side effects
+
+  Scenario: Collect gathers durations in row order
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:E {i: 1, d: duration('P1D')}), (:E {i: 2, d: duration('P2D')})
+      """
+    When executing query:
+      """
+      MATCH (e:E) WITH e.d AS d ORDER BY e.i
+      RETURN toString(head(collect(d))) AS first
+      """
+    Then the result should be, in any order:
+      | first |
+      | 'P1D' |
+    And no side effects
+
+  Scenario: Sum of duration plus duration expression
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:E {d: duration('P1D')}), (:E {d: duration('P2D')})
+      """
+    When executing query:
+      """
+      MATCH (e:E) RETURN toString(sum(e.d + duration('PT1H'))) AS s
+      """
+    Then the result should be, in any order:
+      | s        |
+      | 'P3DT2H' |
+    And no side effects
+
+  Scenario: Negated durations aggregate correctly
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:E {d: duration('P3D')}), (:E {d: duration('P1D')})
+      """
+    When executing query:
+      """
+      MATCH (e:E) RETURN toString(min(-e.d)) AS lo
+      """
+    Then the result should be, in any order:
+      | lo     |
+      | 'P-3D' |
+    And no side effects
+
+  Scenario: Duration equality filter on device columns
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:E {d: duration('P1M')}), (:E {d: duration('P30D')}),
+             (:E {d: duration('P1M')})
+      """
+    When executing query:
+      """
+      MATCH (e:E) WHERE e.d = duration('P1M') RETURN count(*) AS c
+      """
+    Then the result should be, in any order:
+      | c |
+      | 2 |
+    And no side effects
+
+  Scenario: Duration accessors on aggregated results
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:E {d: duration('P1M10D')}), (:E {d: duration('P2M20D')})
+      """
+    When executing query:
+      """
+      MATCH (e:E) WITH sum(e.d) AS total
+      RETURN total.months AS m, total.days AS dd
+      """
+    Then the result should be, in any order:
+      | m | dd |
+      | 3 | 30 |
+    And no side effects
+
+  Scenario: Mixed sign duration sum cancels
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:E {d: duration('P5D')}), (:E {d: duration('-P2D')})
+      """
+    When executing query:
+      """
+      MATCH (e:E) RETURN toString(sum(e.d)) AS s
+      """
+    Then the result should be, in any order:
+      | s     |
+      | 'P3D' |
+    And no side effects

@@ -1,0 +1,177 @@
+Feature: ListOperations3
+
+  Scenario: Range with default and explicit step
+    Given an empty graph
+    When executing query:
+      """
+      RETURN range(1, 4) AS a, range(0, 10, 5) AS b, range(3, 1, -1) AS c
+      """
+    Then the result should be, in any order:
+      | a            | b          | c         |
+      | [1, 2, 3, 4] | [0, 5, 10] | [3, 2, 1] |
+    And no side effects
+
+  Scenario: Head last and size of lists
+    Given an empty graph
+    When executing query:
+      """
+      WITH [5, 6, 7] AS l
+      RETURN head(l) AS h, last(l) AS t, size(l) AS s
+      """
+    Then the result should be, in any order:
+      | h | t | s |
+      | 5 | 7 | 3 |
+    And no side effects
+
+  Scenario: Head and last of an empty list are null
+    Given an empty graph
+    When executing query:
+      """
+      WITH [] AS l
+      RETURN head(l) AS h, last(l) AS t, size(l) AS s
+      """
+    Then the result should be, in any order:
+      | h    | t    | s |
+      | null | null | 0 |
+    And no side effects
+
+  Scenario: List indexing with positive and negative indices
+    Given an empty graph
+    When executing query:
+      """
+      WITH ['a', 'b', 'c'] AS l
+      RETURN l[0] AS f, l[2] AS t, l[-1] AS n, l[9] AS m
+      """
+    Then the result should be, in any order:
+      | f   | t   | n   | m    |
+      | 'a' | 'c' | 'c' | null |
+    And no side effects
+
+  Scenario: List slicing
+    Given an empty graph
+    When executing query:
+      """
+      WITH [1, 2, 3, 4, 5] AS l
+      RETURN l[1..3] AS a, l[..2] AS b, l[3..] AS c, l[-2..] AS d
+      """
+    Then the result should be, in any order:
+      | a      | b      | c      | d      |
+      | [2, 3] | [1, 2] | [4, 5] | [4, 5] |
+    And no side effects
+
+  Scenario: Reverse a list and a string
+    Given an empty graph
+    When executing query:
+      """
+      RETURN reverse([1, 2, 3]) AS l, reverse('abc') AS s
+      """
+    Then the result should be, in any order:
+      | l         | s     |
+      | [3, 2, 1] | 'cba' |
+    And no side effects
+
+  Scenario: List concatenation with plus
+    Given an empty graph
+    When executing query:
+      """
+      RETURN [1, 2] + [3] AS a, [] + [1] AS b, [1] + [] AS c
+      """
+    Then the result should be, in any order:
+      | a         | b   | c   |
+      | [1, 2, 3] | [1] | [1] |
+    And no side effects
+
+  Scenario: Nested lists preserve structure
+    Given an empty graph
+    When executing query:
+      """
+      WITH [[1, 2], [3]] AS l
+      RETURN l[0] AS a, l[1] AS b, size(l) AS s
+      """
+    Then the result should be, in any order:
+      | a      | b   | s |
+      | [1, 2] | [3] | 2 |
+    And no side effects
+
+  Scenario: UNWIND a literal list and re-collect
+    Given an empty graph
+    When executing query:
+      """
+      UNWIND [3, 1, 2] AS x
+      WITH x ORDER BY x
+      RETURN collect(x) AS l
+      """
+    Then the result should be, in any order:
+      | l         |
+      | [1, 2, 3] |
+    And no side effects
+
+  Scenario: UNWIND of an empty list produces no rows
+    Given an empty graph
+    When executing query:
+      """
+      UNWIND [] AS x RETURN x
+      """
+    Then the result should be empty
+    And no side effects
+
+  Scenario: UNWIND of null produces no rows
+    Given an empty graph
+    When executing query:
+      """
+      UNWIND null AS x RETURN x
+      """
+    Then the result should be empty
+    And no side effects
+
+  Scenario: Doubly nested UNWIND flattens
+    Given an empty graph
+    When executing query:
+      """
+      UNWIND [[1, 2], [3]] AS inner
+      UNWIND inner AS x
+      RETURN collect(x) AS l
+      """
+    Then the result should be, in any order:
+      | l         |
+      | [1, 2, 3] |
+    And no side effects
+
+  Scenario: Lists with nulls keep them
+    Given an empty graph
+    When executing query:
+      """
+      WITH [1, null, 3] AS l
+      RETURN size(l) AS s, l[1] AS mid
+      """
+    Then the result should be, in any order:
+      | s | mid  |
+      | 3 | null |
+    And no side effects
+
+  Scenario: size of a string counts characters
+    Given an empty graph
+    When executing query:
+      """
+      RETURN size('hello') AS a, size('') AS b
+      """
+    Then the result should be, in any order:
+      | a | b |
+      | 5 | 0 |
+    And no side effects
+
+  Scenario: Collected node properties form value lists
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:N {v: 2}), (:N {v: 1}), (:N)
+      """
+    When executing query:
+      """
+      MATCH (n:N) WITH n.v AS v ORDER BY v
+      RETURN collect(v) AS l
+      """
+    Then the result should be, in any order:
+      | l      |
+      | [1, 2] |
+    And no side effects

@@ -1,0 +1,154 @@
+Feature: MapAndProperties
+
+  Scenario: Map literal access by key
+    Given an empty graph
+    When executing query:
+      """
+      WITH {a: 1, b: 'two'} AS m
+      RETURN m.a AS a, m.b AS b, m.missing AS c
+      """
+    Then the result should be, in any order:
+      | a | b     | c    |
+      | 1 | 'two' | null |
+    And no side effects
+
+  Scenario: Nested map access chains
+    Given an empty graph
+    When executing query:
+      """
+      WITH {outer: {inner: 7}} AS m
+      RETURN m.outer.inner AS v
+      """
+    Then the result should be, in any order:
+      | v |
+      | 7 |
+    And no side effects
+
+  Scenario: keys of a map literal
+    Given an empty graph
+    When executing query:
+      """
+      WITH {b: 1, a: 2} AS m
+      RETURN keys(m) AS k
+      """
+    Then the result should be (ignoring element order for lists):
+      | k          |
+      | ['a', 'b'] |
+    And no side effects
+
+  Scenario: keys and properties of a node
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:P {name: 'n', age: 3})
+      """
+    When executing query:
+      """
+      MATCH (p:P) RETURN keys(p) AS k, properties(p) AS m
+      """
+    Then the result should be (ignoring element order for lists):
+      | k               | m                    |
+      | ['age', 'name'] | {age: 3, name: 'n'}  |
+    And no side effects
+
+  Scenario: properties of a relationship
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:A)-[:R {w: 2, s: 'x'}]->(:B)
+      """
+    When executing query:
+      """
+      MATCH ()-[r:R]->() RETURN properties(r) AS m
+      """
+    Then the result should be, in any order:
+      | m               |
+      | {s: 'x', w: 2}  |
+    And no side effects
+
+  Scenario: Map equality is structural
+    Given an empty graph
+    When executing query:
+      """
+      RETURN {a: 1, b: 2} = {b: 2, a: 1} AS eq, {a: 1} = {a: 2} AS ne
+      """
+    Then the result should be, in any order:
+      | eq   | ne    |
+      | true | false |
+    And no side effects
+
+  Scenario: Maps in lists round trip
+    Given an empty graph
+    When executing query:
+      """
+      WITH [{v: 1}, {v: 2}] AS l
+      RETURN l[1].v AS second, size(l) AS s
+      """
+    Then the result should be, in any order:
+      | second | s |
+      | 2      | 2 |
+    And no side effects
+
+  Scenario: Parameters carry maps
+    Given an empty graph
+    And parameters are:
+      | m | {lo: 1, hi: 9} |
+    When executing query:
+      """
+      RETURN $m.lo AS lo, $m.hi AS hi
+      """
+    Then the result should be, in any order:
+      | lo | hi |
+      | 1  | 9  |
+    And no side effects
+
+  Scenario: Property access on null is null
+    Given an empty graph
+    When executing query:
+      """
+      WITH null AS m
+      RETURN m.anything AS v
+      """
+    Then the result should be, in any order:
+      | v    |
+      | null |
+    And no side effects
+
+  Scenario: keys of an empty map is an empty list
+    Given an empty graph
+    When executing query:
+      """
+      RETURN keys({}) AS k, size(keys({})) AS s
+      """
+    Then the result should be, in any order:
+      | k  | s |
+      | [] | 0 |
+    And no side effects
+
+  Scenario: Map values may be lists and nulls
+    Given an empty graph
+    When executing query:
+      """
+      WITH {l: [1, 2], n: null} AS m
+      RETURN m.l AS l, m.n AS n
+      """
+    Then the result should be, in any order:
+      | l      | n    |
+      | [1, 2] | null |
+    And no side effects
+
+  Scenario: Collecting maps groups structurally
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:P {g: 1, v: 2}), (:P {g: 1, v: 3})
+      """
+    When executing query:
+      """
+      MATCH (p:P) WITH p.g AS g, p.v AS v ORDER BY v
+      RETURN collect({val: v}) AS l
+      """
+    Then the result should be, in any order:
+      | l                      |
+      | [{val: 2}, {val: 3}]   |
+    And no side effects

@@ -1,0 +1,236 @@
+Feature: MatchAcceptance4
+
+  Scenario: Multiple comma patterns form a cross product when disconnected
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:A {n: 1}), (:A {n: 2}), (:B {m: 10})
+      """
+    When executing query:
+      """
+      MATCH (a:A), (b:B) RETURN count(*) AS c
+      """
+    Then the result should be, in any order:
+      | c |
+      | 2 |
+    And no side effects
+
+  Scenario: Label conjunction requires every label
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:X:Y {n: 1}), (:X {n: 2}), (:Y {n: 3})
+      """
+    When executing query:
+      """
+      MATCH (n:X:Y) RETURN n.n AS v
+      """
+    Then the result should be, in any order:
+      | v |
+      | 1 |
+    And no side effects
+
+  Scenario: Property map in MATCH filters exactly
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:P {a: 1, b: 2}), (:P {a: 1}), (:P {a: 2, b: 2})
+      """
+    When executing query:
+      """
+      MATCH (p:P {a: 1, b: 2}) RETURN count(*) AS c
+      """
+    Then the result should be, in any order:
+      | c |
+      | 1 |
+    And no side effects
+
+  Scenario: Relationship property map in MATCH
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:A)-[:R {w: 1}]->(:B), (:A)-[:R {w: 2}]->(:B)
+      """
+    When executing query:
+      """
+      MATCH ()-[r:R {w: 2}]->() RETURN count(*) AS c
+      """
+    Then the result should be, in any order:
+      | c |
+      | 1 |
+    And no side effects
+
+  Scenario: Shared node variable connects comma patterns
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (h:Hub), (:X {n: 1})-[:K]->(h), (:X {n: 2})-[:K]->(h),
+             (h)-[:L]->(:Y {n: 3})
+      """
+    When executing query:
+      """
+      MATCH (x)-[:K]->(h), (h)-[:L]->(y) RETURN x.n AS xn, y.n AS yn
+      ORDER BY xn
+      """
+    Then the result should be, in order:
+      | xn | yn |
+      | 1  | 3  |
+      | 2  | 3  |
+    And no side effects
+
+  Scenario: Type disjunction matches either relationship type
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:A {n: 1})-[:R]->(:B), (:A {n: 2})-[:S]->(:B),
+             (:A {n: 3})-[:T]->(:B)
+      """
+    When executing query:
+      """
+      MATCH (a)-[:R|S]->() RETURN a.n AS n ORDER BY n
+      """
+    Then the result should be, in order:
+      | n |
+      | 1 |
+      | 2 |
+    And no side effects
+
+  Scenario: Undirected single-hop matches both orientations
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:A {n: 1})-[:R]->(:A {n: 2})
+      """
+    When executing query:
+      """
+      MATCH (x)-[:R]-(y) RETURN x.n AS xn, y.n AS yn ORDER BY xn
+      """
+    Then the result should be, in order:
+      | xn | yn |
+      | 1  | 2  |
+      | 2  | 1  |
+    And no side effects
+
+  Scenario: Undirected self-loop matches once
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (x:A {n: 1})-[:R]->(x)
+      """
+    When executing query:
+      """
+      MATCH (a)-[:R]-(b) RETURN count(*) AS c
+      """
+    Then the result should be, in any order:
+      | c |
+      | 1 |
+    And no side effects
+
+  Scenario: Matching keeps duplicates from the driving table
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:A {n: 1})-[:R]->(:B), (:A {n: 1})-[:R]->(:B)
+      """
+    When executing query:
+      """
+      MATCH (a:A {n: 1}) MATCH (a)-[:R]->(b) RETURN count(*) AS c
+      """
+    Then the result should be, in any order:
+      | c |
+      | 2 |
+    And no side effects
+
+  Scenario: A WHERE with pattern predicate restricts matches
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:P {n: 1})-[:K]->(:Q), (:P {n: 2})
+      """
+    When executing query:
+      """
+      MATCH (p:P) WHERE exists((p)-[:K]->()) RETURN p.n AS n
+      """
+    Then the result should be, in any order:
+      | n |
+      | 1 |
+    And no side effects
+
+  Scenario: Negated pattern predicate
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:P {n: 1})-[:K]->(:Q), (:P {n: 2})
+      """
+    When executing query:
+      """
+      MATCH (p:P) WHERE NOT exists((p)-[:K]->()) RETURN p.n AS n
+      """
+    Then the result should be, in any order:
+      | n |
+      | 2 |
+    And no side effects
+
+  Scenario: Long chain across five nodes
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:C {n: 1})-[:K]->(:C {n: 2})-[:K]->(:C {n: 3})-[:K]->
+             (:C {n: 4})-[:K]->(:C {n: 5})
+      """
+    When executing query:
+      """
+      MATCH (a)-[:K]->()-[:K]->()-[:K]->()-[:K]->(e)
+      RETURN a.n AS an, e.n AS en
+      """
+    Then the result should be, in any order:
+      | an | en |
+      | 1  | 5  |
+    And no side effects
+
+  Scenario: Match on node by id function
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:A {n: 1})-[:R]->(:A {n: 2})
+      """
+    When executing query:
+      """
+      MATCH (a:A)-[:R]->(b) WHERE id(a) <> id(b) RETURN count(*) AS c
+      """
+    Then the result should be, in any order:
+      | c |
+      | 1 |
+    And no side effects
+
+  Scenario: labels and type functions reflect the match
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:Only {n: 1})-[:REL]->(:Other)
+      """
+    When executing query:
+      """
+      MATCH (a)-[r]->() WHERE a.n = 1
+      RETURN labels(a) AS l, type(r) AS t
+      """
+    Then the result should be, in any order:
+      | l        | t     |
+      | ['Only'] | 'REL' |
+    And no side effects
+
+  Scenario: Zero-length var-length binds source as target
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:Z {n: 1})-[:K]->(:Z {n: 2})
+      """
+    When executing query:
+      """
+      MATCH (a:Z {n: 1})-[:K*0..1]->(b) RETURN b.n AS bn ORDER BY bn
+      """
+    Then the result should be, in order:
+      | bn |
+      | 1  |
+      | 2  |
+    And no side effects

@@ -1,0 +1,188 @@
+Feature: OptionalMatchAcceptance2
+
+  Scenario: Unmatched optional rows carry nulls
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:P {n: 1})-[:K]->(:P {n: 2}), (:P {n: 3})
+      """
+    When executing query:
+      """
+      MATCH (a:P) OPTIONAL MATCH (a)-[:K]->(b)
+      RETURN a.n AS an, b.n AS bn ORDER BY an
+      """
+    Then the result should be, in order:
+      | an | bn   |
+      | 1  | 2    |
+      | 2  | null |
+      | 3  | null |
+    And no side effects
+
+  Scenario: Optional match with a label that never matches
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:P {n: 1})
+      """
+    When executing query:
+      """
+      MATCH (a:P) OPTIONAL MATCH (b:Q) RETURN a.n AS an, b AS b
+      """
+    Then the result should be, in any order:
+      | an | b    |
+      | 1  | null |
+    And no side effects
+
+  Scenario: WHERE inside OPTIONAL MATCH filters the optional side only
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:P {n: 1})-[:K]->(:P {n: 2}), (:P {n: 3})-[:K]->(:P {n: 4})
+      """
+    When executing query:
+      """
+      MATCH (a:P) WHERE a.n IN [1, 3]
+      OPTIONAL MATCH (a)-[:K]->(b) WHERE b.n = 2
+      RETURN a.n AS an, b.n AS bn ORDER BY an
+      """
+    Then the result should be, in order:
+      | an | bn   |
+      | 1  | 2    |
+      | 3  | null |
+    And no side effects
+
+  Scenario: Chained optional matches preserve earlier nulls
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:P {n: 1})-[:K]->(:P {n: 2})-[:K]->(:P {n: 3}), (:P {n: 9})
+      """
+    When executing query:
+      """
+      MATCH (a:P) WHERE a.n IN [1, 9]
+      OPTIONAL MATCH (a)-[:K]->(b)
+      OPTIONAL MATCH (b)-[:K]->(c)
+      RETURN a.n AS an, b.n AS bn, c.n AS cn ORDER BY an
+      """
+    Then the result should be, in order:
+      | an | bn   | cn   |
+      | 1  | 2    | 3    |
+      | 9  | null | null |
+    And no side effects
+
+  Scenario: Aggregation over optional nulls counts only matches
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:P {n: 1})-[:K]->(:P {n: 2}), (:P {n: 3})
+      """
+    When executing query:
+      """
+      MATCH (a:P) OPTIONAL MATCH (a)-[r:K]->()
+      RETURN count(a) AS ca, count(r) AS cr
+      """
+    Then the result should be, in any order:
+      | ca | cr |
+      | 3  | 1  |
+    And no side effects
+
+  Scenario: Optional var-length expansion
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:P {n: 1})-[:K]->(:P {n: 2})-[:K]->(:P {n: 3}), (:P {n: 9})
+      """
+    When executing query:
+      """
+      MATCH (a:P) WHERE a.n IN [1, 9]
+      OPTIONAL MATCH (a)-[:K*1..2]->(b)
+      RETURN a.n AS an, b.n AS bn ORDER BY an, bn
+      """
+    Then the result should be, in order:
+      | an | bn   |
+      | 1  | 2    |
+      | 1  | 3    |
+      | 9  | null |
+    And no side effects
+
+  Scenario: Optional match on a bound node preserves multiplicity
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (a:P {n: 1})-[:K]->(:Q), (a)-[:K]->(:Q)
+      """
+    When executing query:
+      """
+      MATCH (a:P) OPTIONAL MATCH (a)-[:K]->(b:Q) RETURN count(*) AS c
+      """
+    Then the result should be, in any order:
+      | c |
+      | 2 |
+    And no side effects
+
+  Scenario: Properties of optional nulls are null
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:P {n: 1})
+      """
+    When executing query:
+      """
+      MATCH (a:P) OPTIONAL MATCH (a)-[:K]->(b)
+      RETURN b.missing AS m, id(b) AS i
+      """
+    Then the result should be, in any order:
+      | m    | i    |
+      | null | null |
+    And no side effects
+
+  Scenario: Optional match filtered away by later WHERE
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:P {n: 1})-[:K]->(:P {n: 2}), (:P {n: 3})
+      """
+    When executing query:
+      """
+      MATCH (a:P) OPTIONAL MATCH (a)-[:K]->(b)
+      WITH a, b WHERE b IS NOT NULL
+      RETURN a.n AS an, b.n AS bn
+      """
+    Then the result should be, in any order:
+      | an | bn |
+      | 1  | 2  |
+    And no side effects
+
+  Scenario: Optional incoming direction
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:P {n: 1})-[:K]->(:P {n: 2})
+      """
+    When executing query:
+      """
+      MATCH (a:P) OPTIONAL MATCH (a)<-[:K]-(b)
+      RETURN a.n AS an, b.n AS bn ORDER BY an
+      """
+    Then the result should be, in order:
+      | an | bn   |
+      | 1  | null |
+      | 2  | 1    |
+    And no side effects
+
+  Scenario: Two optional matches joined on the same variable
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:P {n: 1})-[:K]->(m:M {n: 5})<-[:K]-(:P {n: 2})
+      """
+    When executing query:
+      """
+      MATCH (a:P {n: 1}) OPTIONAL MATCH (a)-[:K]->(m)
+      OPTIONAL MATCH (m)<-[:K]-(other:P) WHERE other.n <> 1
+      RETURN m.n AS mn, other.n AS rn
+      """
+    Then the result should be, in any order:
+      | mn | rn |
+      | 5  | 2  |
+    And no side effects

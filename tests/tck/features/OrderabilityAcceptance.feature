@@ -1,0 +1,208 @@
+Feature: OrderabilityAcceptance
+
+  Scenario: Integers and floats order numerically together
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:N {v: 2}), (:N {v: 1.5}), (:N {v: 3}), (:N {v: 2.5})
+      """
+    When executing query:
+      """
+      MATCH (n:N) RETURN n.v AS v ORDER BY v
+      """
+    Then the result should be, in order:
+      | v   |
+      | 1.5 |
+      | 2   |
+      | 2.5 |
+      | 3   |
+    And no side effects
+
+  Scenario: Nulls sort last ascending
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:N {k: 1, v: 2}), (:N {k: 2}), (:N {k: 3, v: 1})
+      """
+    When executing query:
+      """
+      MATCH (n:N) RETURN n.k AS k ORDER BY n.v
+      """
+    Then the result should be, in order:
+      | k |
+      | 3 |
+      | 1 |
+      | 2 |
+    And no side effects
+
+  Scenario: Nulls sort first descending
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:N {k: 1, v: 2}), (:N {k: 2}), (:N {k: 3, v: 1})
+      """
+    When executing query:
+      """
+      MATCH (n:N) RETURN n.k AS k ORDER BY n.v DESC
+      """
+    Then the result should be, in order:
+      | k |
+      | 2 |
+      | 1 |
+      | 3 |
+    And no side effects
+
+  Scenario: NaN sorts after all numbers and before null
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:N {k: 1, v: 1.0}), (:N {k: 2, v: 0.0}), (:N {k: 3})
+      """
+    When executing query:
+      """
+      MATCH (n:N) RETURN n.k AS k ORDER BY n.v / n.v
+      """
+    Then the result should be, in order:
+      | k |
+      | 1 |
+      | 2 |
+      | 3 |
+    And no side effects
+
+  Scenario: Booleans order false before true
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:N {k: 1, v: true}), (:N {k: 2, v: false}), (:N {k: 3, v: true})
+      """
+    When executing query:
+      """
+      MATCH (n:N) RETURN n.k AS k ORDER BY n.v, k
+      """
+    Then the result should be, in order:
+      | k |
+      | 2 |
+      | 1 |
+      | 3 |
+    And no side effects
+
+  Scenario: Strings order lexicographically
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:N {s: 'b'}), (:N {s: 'A'}), (:N {s: 'a'}), (:N {s: ''})
+      """
+    When executing query:
+      """
+      MATCH (n:N) RETURN n.s AS s ORDER BY s
+      """
+    Then the result should be, in order:
+      | s   |
+      | ''  |
+      | 'A' |
+      | 'a' |
+      | 'b' |
+    And no side effects
+
+  Scenario: Dates order chronologically
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:N {d: date('2020-03-01')}), (:N {d: date('1999-12-31')}),
+             (:N {d: date('2020-02-29')})
+      """
+    When executing query:
+      """
+      MATCH (n:N) RETURN toString(n.d) AS d ORDER BY n.d
+      """
+    Then the result should be, in order:
+      | d            |
+      | '1999-12-31' |
+      | '2020-02-29' |
+      | '2020-03-01' |
+    And no side effects
+
+  Scenario: Multiple sort keys apply in priority order
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:N {a: 1, b: 2}), (:N {a: 2, b: 1}), (:N {a: 1, b: 1})
+      """
+    When executing query:
+      """
+      MATCH (n:N) RETURN n.a AS a, n.b AS b ORDER BY a, b DESC
+      """
+    Then the result should be, in order:
+      | a | b |
+      | 1 | 2 |
+      | 1 | 1 |
+      | 2 | 1 |
+    And no side effects
+
+  Scenario: ORDER BY an expression not in the projection
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:N {v: 5}), (:N {v: -7}), (:N {v: 2})
+      """
+    When executing query:
+      """
+      MATCH (n:N) RETURN n.v AS v ORDER BY abs(n.v)
+      """
+    Then the result should be, in order:
+      | v  |
+      | 2  |
+      | 5  |
+      | -7 |
+    And no side effects
+
+  Scenario: ORDER BY applies before SKIP and LIMIT
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:N {v: 4}), (:N {v: 1}), (:N {v: 3}), (:N {v: 2})
+      """
+    When executing query:
+      """
+      MATCH (n:N) RETURN n.v AS v ORDER BY v DESC SKIP 1 LIMIT 2
+      """
+    Then the result should be, in order:
+      | v |
+      | 3 |
+      | 2 |
+    And no side effects
+
+  Scenario: Sorting is stable for equal keys
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:N {i: 1, g: 1}), (:N {i: 2, g: 1}), (:N {i: 3, g: 0})
+      """
+    When executing query:
+      """
+      MATCH (n:N) WITH n.i AS i, n.g AS g ORDER BY i
+      RETURN i, g ORDER BY g
+      """
+    Then the result should be, in order:
+      | i | g |
+      | 3 | 0 |
+      | 1 | 1 |
+      | 2 | 1 |
+    And no side effects
+
+  Scenario: Mixed-type column orders by type then value
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:N {k: 1, v: 'a'}), (:N {k: 2, v: 1}), (:N {k: 3, v: true})
+      """
+    When executing query:
+      """
+      MATCH (n:N) RETURN n.k AS k ORDER BY n.v
+      """
+    Then the result should be, in order:
+      | k |
+      | 1 |
+      | 3 |
+      | 2 |
+    And no side effects

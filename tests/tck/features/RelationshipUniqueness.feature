@@ -1,0 +1,336 @@
+Feature: RelationshipUniqueness
+
+  Scenario: Fork with two in-edges yields ordered distinct pairs
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:X {n: 1})-[:K]->(y:Y), (:X {n: 2})-[:K]->(y)
+      """
+    When executing query:
+      """
+      MATCH (a)-[r1:K]->(b)<-[r2:K]-(c) RETURN count(*) AS c
+      """
+    Then the result should be, in any order:
+      | c |
+      | 2 |
+    And no side effects
+
+  Scenario: Three-source fork counts six not nine
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:X)-[:K]->(y:Y), (:X)-[:K]->(y), (:X)-[:K]->(y)
+      """
+    When executing query:
+      """
+      MATCH (a)-[r1:K]->(b)<-[r2:K]-(c) RETURN count(*) AS c
+      """
+    Then the result should be, in any order:
+      | c |
+      | 6 |
+    And no side effects
+
+  Scenario: Anonymous relationships are pairwise distinct in forks
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:X)-[:K]->(y:Y), (:X)-[:K]->(y), (:X)-[:K]->(y)
+      """
+    When executing query:
+      """
+      MATCH (a)-->(b)<--(c) RETURN count(*) AS c
+      """
+    Then the result should be, in any order:
+      | c |
+      | 6 |
+    And no side effects
+
+  Scenario: Common-source fork excludes the same relationship twice
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (a:X)-[:K]->(:Y), (a)-[:K]->(:Y)
+      """
+    When executing query:
+      """
+      MATCH (p)<-[r1:K]-(q)-[r2:K]->(s) RETURN count(*) AS c
+      """
+    Then the result should be, in any order:
+      | c |
+      | 2 |
+    And no side effects
+
+  Scenario: A single edge cannot bind a shared-endpoint fork
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:A)-[:K]->(:B)
+      """
+    When executing query:
+      """
+      MATCH (x)-[r1:K]->(y)<-[r2:K]-(z) RETURN count(*) AS c
+      """
+    Then the result should be, in any order:
+      | c |
+      | 0 |
+    And no side effects
+
+  Scenario: Parallel relationships satisfy a two-rel pattern pairwise
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (a:A)-[:K]->(b:B), (a)-[:K]->(b)
+      """
+    When executing query:
+      """
+      MATCH (x)-[r1:K]->(y), (x)-[r2:K]->(y) RETURN count(*) AS c
+      """
+    Then the result should be, in any order:
+      | c |
+      | 2 |
+    And no side effects
+
+  Scenario: Same-orientation one-hop close needs two distinct edges
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:A)-[:K]->(:B)
+      """
+    When executing query:
+      """
+      MATCH (x)-[r1:K]->(y), (x)-[r2:K]->(y) RETURN count(*) AS c
+      """
+    Then the result should be, in any order:
+      | c |
+      | 0 |
+    And no side effects
+
+  Scenario: Triangle over a three-cycle counts each rotation
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (a:N)-[:K]->(b:N)-[:K]->(c:N)-[:K]->(a)
+      """
+    When executing query:
+      """
+      MATCH (x)-[:K]->(y)-[:K]->(z)-[:K]->(x) RETURN count(*) AS c
+      """
+    Then the result should be, in any order:
+      | c |
+      | 3 |
+    And no side effects
+
+  Scenario: A self-loop cannot serve two pattern roles
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (x:N)-[:K]->(x)
+      """
+    When executing query:
+      """
+      MATCH (a)-[:K]->(b)-[:K]->(c)-[:K]->(a) RETURN count(*) AS c
+      """
+    Then the result should be, in any order:
+      | c |
+      | 0 |
+    And no side effects
+
+  Scenario: Three self-loops make six ordered loop triangles
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (x:N)-[:K]->(x), (x)-[:K]->(x), (x)-[:K]->(x)
+      """
+    When executing query:
+      """
+      MATCH (a)-[:K]->(b)-[:K]->(c)-[:K]->(a) RETURN count(*) AS c
+      """
+    Then the result should be, in any order:
+      | c |
+      | 6 |
+    And no side effects
+
+  Scenario: Two-hop chain may not reuse its single edge backwards
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (a:N)-[:K]->(b:N), (b)-[:K]->(a)
+      """
+    When executing query:
+      """
+      MATCH (x)-[:K]->(y)-[:K]->(z) RETURN count(*) AS c
+      """
+    Then the result should be, in any order:
+      | c |
+      | 2 |
+    And no side effects
+
+  Scenario: Four-cycle needs four pairwise distinct relationships
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (a:N)-[:K]->(b:N), (b)-[:K]->(a)
+      """
+    When executing query:
+      """
+      MATCH (w)-[:K]->(x)-[:K]->(y)-[:K]->(z)-[:K]->(w) RETURN count(*) AS c
+      """
+    Then the result should be, in any order:
+      | c |
+      | 0 |
+    And no side effects
+
+  Scenario: Uniqueness applies per MATCH clause not across clauses
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:A)-[:K {w: 5}]->(:B)
+      """
+    When executing query:
+      """
+      MATCH (a)-[r1:K]->(b) MATCH (c)-[r2:K]->(d)
+      RETURN r1.w AS w1, r2.w AS w2
+      """
+    Then the result should be, in any order:
+      | w1 | w2 |
+      | 5  | 5  |
+    And no side effects
+
+  Scenario: Mixed type sets only exclude genuinely shareable pairs
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (a:N)-[:K]->(b:N)-[:L]->(c:N), (a)-[:K]->(c), (b)-[:L]->(b)
+      """
+    When executing query:
+      """
+      MATCH (x)-[r1:K]->(y)-[r2:L]->(z), (x)-[r3:K]->(z)
+      RETURN count(*) AS c
+      """
+    Then the result should be, in any order:
+      | c |
+      | 1 |
+    And no side effects
+
+  Scenario: DISTINCT endpoints through an enforced fork
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:X {n: 1})-[:K]->(y:Y), (:X {n: 2})-[:K]->(y), (:X {n: 3})-[:K]->(y)
+      """
+    When executing query:
+      """
+      MATCH (a)-[r1:K]->(b)<-[r2:K]-(c) WITH DISTINCT a, c RETURN count(*) AS c
+      """
+    Then the result should be, in any order:
+      | c |
+      | 6 |
+    And no side effects
+
+  Scenario: Undirected two-rel pattern still binds distinct relationships
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (a:N)-[:K]->(b:N)
+      """
+    When executing query:
+      """
+      MATCH (x)-[r1:K]-(y)-[r2:K]-(z) RETURN count(*) AS c
+      """
+    Then the result should be, in any order:
+      | c |
+      | 0 |
+    And no side effects
+
+  Scenario: Undirected chain over two edges walks both ways
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (a:N)-[:K]->(b:N), (b)-[:K]->(c:N)
+      """
+    When executing query:
+      """
+      MATCH (x)-[r1:K]-(y)-[r2:K]-(z) RETURN count(*) AS c
+      """
+    Then the result should be, in any order:
+      | c |
+      | 2 |
+    And no side effects
+
+  Scenario: Longer chain skips the middle edge when reused
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (a:N)-[:K]->(b:N)-[:K]->(c:N), (b)-[:K]->(b)
+      """
+    When executing query:
+      """
+      MATCH (x)-[:K]->(y)-[:K]->(z) RETURN count(*) AS c
+      """
+    Then the result should be, in any order:
+      | c |
+      | 3 |
+    And no side effects
+
+  Scenario: Var-length paths never reuse an edge
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (a:N)-[:K]->(b:N), (b)-[:K]->(a)
+      """
+    When executing query:
+      """
+      MATCH (x)-[:K*2..2]->(x) RETURN count(*) AS c
+      """
+    Then the result should be, in any order:
+      | c |
+      | 2 |
+    And no side effects
+
+  Scenario: Fixed rel and var-length rel in one MATCH stay distinct
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (a:N)-[:K]->(b:N), (b)-[:K]->(c:N)
+      """
+    When executing query:
+      """
+      MATCH (x)-[r:K]->(y)-[rs:K*1..1]->(z) RETURN count(*) AS c
+      """
+    Then the result should be, in any order:
+      | c |
+      | 1 |
+    And no side effects
+
+  Scenario: Returned relationship ids in a fork are really different
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:X {n: 1})-[:K]->(y:Y), (:X {n: 2})-[:K]->(y)
+      """
+    When executing query:
+      """
+      MATCH (a)-[r1:K]->(b)<-[r2:K]-(c)
+      RETURN a.n AS an, c.n AS cn, id(r1) = id(r2) AS same ORDER BY an, cn
+      """
+    Then the result should be, in order:
+      | an | cn | same  |
+      | 1  | 2  | false |
+      | 2  | 1  | false |
+    And no side effects
+
+  Scenario: Diamond pattern counts all distinct-edge combinations
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (s:S)-[:K]->(m1:M)-[:K]->(t:T), (s)-[:K]->(m2:M)-[:K]->(t)
+      """
+    When executing query:
+      """
+      MATCH (a:S)-[:K]->(b)-[:K]->(c:T)<-[:K]-(d)<-[:K]-(e:S)
+      RETURN count(*) AS c
+      """
+    Then the result should be, in any order:
+      | c |
+      | 2 |
+    And no side effects

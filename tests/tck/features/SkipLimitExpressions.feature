@@ -1,0 +1,148 @@
+Feature: SkipLimitExpressions
+
+  Scenario: SKIP with an additive expression
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:N {v: 1}), (:N {v: 2}), (:N {v: 3}), (:N {v: 4})
+      """
+    When executing query:
+      """
+      MATCH (n:N) RETURN n.v AS v ORDER BY v SKIP 1 + 1
+      """
+    Then the result should be, in order:
+      | v |
+      | 3 |
+      | 4 |
+    And no side effects
+
+  Scenario: LIMIT with a multiplicative expression
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:N {v: 1}), (:N {v: 2}), (:N {v: 3}), (:N {v: 4})
+      """
+    When executing query:
+      """
+      MATCH (n:N) RETURN n.v AS v ORDER BY v LIMIT 2 * 1
+      """
+    Then the result should be, in order:
+      | v |
+      | 1 |
+      | 2 |
+    And no side effects
+
+  Scenario: SKIP and LIMIT expressions combine
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:N {v: 1}), (:N {v: 2}), (:N {v: 3}), (:N {v: 4}), (:N {v: 5})
+      """
+    When executing query:
+      """
+      MATCH (n:N) RETURN n.v AS v ORDER BY v SKIP 3 - 2 LIMIT 6 / 2
+      """
+    Then the result should be, in order:
+      | v |
+      | 2 |
+      | 3 |
+      | 4 |
+    And no side effects
+
+  Scenario: SKIP with a parameter inside an expression
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:N {v: 1}), (:N {v: 2}), (:N {v: 3})
+      """
+    And parameters are:
+      | s | 1 |
+    When executing query:
+      """
+      MATCH (n:N) RETURN n.v AS v ORDER BY v SKIP $s + 1
+      """
+    Then the result should be, in order:
+      | v |
+      | 3 |
+    And no side effects
+
+  Scenario: LIMIT with a modulo expression
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:N {v: 1}), (:N {v: 2}), (:N {v: 3})
+      """
+    When executing query:
+      """
+      MATCH (n:N) RETURN n.v AS v ORDER BY v LIMIT 5 % 3
+      """
+    Then the result should be, in order:
+      | v |
+      | 1 |
+      | 2 |
+    And no side effects
+
+  Scenario: LIMIT zero from an expression yields no rows
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:N {v: 1})
+      """
+    When executing query:
+      """
+      MATCH (n:N) RETURN n.v AS v LIMIT 1 - 1
+      """
+    Then the result should be empty
+    And no side effects
+
+  Scenario: Negative SKIP expression is an error
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:N {v: 1})
+      """
+    When executing query:
+      """
+      MATCH (n:N) RETURN n.v AS v SKIP 1 - 2
+      """
+    Then a SyntaxError should be raised at compile time: NegativeIntegerArgument
+    And no side effects
+
+  Scenario: SKIP referencing a variable is an error
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:N {v: 1})
+      """
+    When executing query:
+      """
+      MATCH (n:N) RETURN n.v AS v SKIP n.v
+      """
+    Then a SyntaxError should be raised at compile time: NonConstantExpression
+    And no side effects
+
+  Scenario: Float LIMIT expression is an error
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:N {v: 1})
+      """
+    When executing query:
+      """
+      MATCH (n:N) RETURN n.v AS v LIMIT 1.5
+      """
+    Then a SyntaxError should be raised at compile time: InvalidArgumentType
+    And no side effects
+
+  Scenario: SKIP past the end yields no rows
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:N {v: 1}), (:N {v: 2})
+      """
+    When executing query:
+      """
+      MATCH (n:N) RETURN n.v AS v ORDER BY v SKIP 2 + 3
+      """
+    Then the result should be empty
+    And no side effects

@@ -1,0 +1,159 @@
+Feature: StringFunctions2
+
+  Scenario: Case conversion round trips
+    Given an empty graph
+    When executing query:
+      """
+      RETURN toUpper('MiXeD') AS u, toLower('MiXeD') AS l
+      """
+    Then the result should be, in any order:
+      | u       | l       |
+      | 'MIXED' | 'mixed' |
+    And no side effects
+
+  Scenario: Trim variants strip the right sides
+    Given an empty graph
+    When executing query:
+      """
+      RETURN trim('  pad  ') AS t, ltrim('  pad  ') AS l, rtrim('  pad  ') AS r
+      """
+    Then the result should be, in any order:
+      | t     | l       | r       |
+      | 'pad' | 'pad  ' | '  pad' |
+    And no side effects
+
+  Scenario: Substring with and without length
+    Given an empty graph
+    When executing query:
+      """
+      RETURN substring('hello', 1) AS a, substring('hello', 1, 3) AS b,
+             substring('hello', 0, 0) AS c
+      """
+    Then the result should be, in any order:
+      | a      | b     | c  |
+      | 'ello' | 'ell' | '' |
+    And no side effects
+
+  Scenario: Left and right take prefixes and suffixes
+    Given an empty graph
+    When executing query:
+      """
+      RETURN left('hello', 2) AS l, right('hello', 2) AS r, left('ab', 5) AS o
+      """
+    Then the result should be, in any order:
+      | l    | r    | o    |
+      | 'he' | 'lo' | 'ab' |
+    And no side effects
+
+  Scenario: Replace swaps every occurrence
+    Given an empty graph
+    When executing query:
+      """
+      RETURN replace('aXbXc', 'X', '-') AS a, replace('aaa', 'aa', 'b') AS b,
+             replace('abc', 'z', 'q') AS c
+      """
+    Then the result should be, in any order:
+      | a       | b    | c     |
+      | 'a-b-c' | 'ba' | 'abc' |
+    And no side effects
+
+  Scenario: Split produces string lists
+    Given an empty graph
+    When executing query:
+      """
+      RETURN split('a,b,c', ',') AS l, split('abc', 'z') AS whole
+      """
+    Then the result should be, in any order:
+      | l               | whole   |
+      | ['a', 'b', 'c'] | ['abc'] |
+    And no side effects
+
+  Scenario: toString of numbers and booleans
+    Given an empty graph
+    When executing query:
+      """
+      RETURN toString(42) AS i, toString(true) AS b, toString(1.5) AS f
+      """
+    Then the result should be, in any order:
+      | i    | b      | f     |
+      | '42' | 'true' | '1.5' |
+    And no side effects
+
+  Scenario: String concatenation with plus
+    Given an empty graph
+    When executing query:
+      """
+      RETURN 'ab' + 'cd' AS s, 'v=' + toString(7) AS t
+      """
+    Then the result should be, in any order:
+      | s      | t    |
+      | 'abcd' | 'v=7' |
+    And no side effects
+
+  Scenario: CONTAINS ENDS WITH STARTS WITH on properties
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:N {s: 'banana'}), (:N {s: 'apple'}), (:N {s: 'bandana'})
+      """
+    When executing query:
+      """
+      MATCH (n:N) WHERE n.s STARTS WITH 'ban' AND n.s CONTAINS 'ana'
+      RETURN n.s AS s ORDER BY s
+      """
+    Then the result should be, in order:
+      | s         |
+      | 'banana'  |
+      | 'bandana' |
+    And no side effects
+
+  Scenario: String functions over null are null
+    Given an empty graph
+    When executing query:
+      """
+      RETURN toUpper(null) AS a, trim(null) AS b, split(null, ',') AS c
+      """
+    Then the result should be, in any order:
+      | a    | b    | c    |
+      | null | null | null |
+    And no side effects
+
+  Scenario: toInteger and toFloat parse strings
+    Given an empty graph
+    When executing query:
+      """
+      RETURN toInteger('42') AS i, toFloat('2.5') AS f,
+             toInteger('nope') AS bad, toInteger(3.9) AS tr
+      """
+    Then the result should be, in any order:
+      | i  | f   | bad  | tr |
+      | 42 | 2.5 | null | 3  |
+    And no side effects
+
+  Scenario: toBoolean parses true false and rejects others
+    Given an empty graph
+    When executing query:
+      """
+      RETURN toBoolean('true') AS t, toBoolean('FALSE') AS f,
+             toBoolean('x') AS bad
+      """
+    Then the result should be, in any order:
+      | t    | f     | bad  |
+      | true | false | null |
+    And no side effects
+
+  Scenario: Dictionary-coded string ordering survives functions
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:N {s: 'b'}), (:N {s: 'a'}), (:N {s: 'c'})
+      """
+    When executing query:
+      """
+      MATCH (n:N) WHERE n.s < 'c' RETURN toUpper(n.s) AS u ORDER BY u DESC
+      """
+    Then the result should be, in order:
+      | u   |
+      | 'B' |
+      | 'A' |
+    And no side effects

@@ -1,0 +1,197 @@
+Feature: TernaryLogicAcceptance
+
+  Scenario: AND truth table with null
+    Given an empty graph
+    When executing query:
+      """
+      RETURN (true AND null) AS a, (false AND null) AS b,
+             (null AND null) AS c, (true AND true) AS d,
+             (true AND false) AS e
+      """
+    Then the result should be, in any order:
+      | a    | b     | c    | d    | e     |
+      | null | false | null | true | false |
+    And no side effects
+
+  Scenario: OR truth table with null
+    Given an empty graph
+    When executing query:
+      """
+      RETURN (true OR null) AS a, (false OR null) AS b,
+             (null OR null) AS c, (false OR false) AS d
+      """
+    Then the result should be, in any order:
+      | a    | b    | c    | d     |
+      | true | null | null | false |
+    And no side effects
+
+  Scenario: XOR truth table with null
+    Given an empty graph
+    When executing query:
+      """
+      RETURN (true XOR null) AS a, (false XOR null) AS b,
+             (true XOR false) AS c, (true XOR true) AS d
+      """
+    Then the result should be, in any order:
+      | a    | b    | c    | d     |
+      | null | null | true | false |
+    And no side effects
+
+  Scenario: NOT of null is null
+    Given an empty graph
+    When executing query:
+      """
+      RETURN NOT null AS a, NOT true AS b, NOT false AS c
+      """
+    Then the result should be, in any order:
+      | a    | b     | c    |
+      | null | false | true |
+    And no side effects
+
+  Scenario: Comparison with null is null
+    Given an empty graph
+    When executing query:
+      """
+      RETURN (1 < null) AS a, (null = null) AS b, (null <> null) AS c,
+             ('a' > null) AS d
+      """
+    Then the result should be, in any order:
+      | a    | b    | c    | d    |
+      | null | null | null | null |
+    And no side effects
+
+  Scenario: WHERE treats null as false
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:N {v: 1}), (:N {v: 2}), (:N)
+      """
+    When executing query:
+      """
+      MATCH (n:N) WHERE n.v > 1 RETURN count(*) AS c
+      """
+    Then the result should be, in any order:
+      | c |
+      | 1 |
+    And no side effects
+
+  Scenario: WHERE NOT excludes null predicates too
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:N {v: 1}), (:N {v: 2}), (:N)
+      """
+    When executing query:
+      """
+      MATCH (n:N) WHERE NOT n.v > 1 RETURN count(*) AS c
+      """
+    Then the result should be, in any order:
+      | c |
+      | 1 |
+    And no side effects
+
+  Scenario: IN with null element and missing value
+    Given an empty graph
+    When executing query:
+      """
+      RETURN 3 IN [1, 2, null] AS a, 1 IN [1, null] AS b,
+             null IN [1, 2] AS c, null IN [] AS d
+      """
+    Then the result should be, in any order:
+      | a    | b    | c    | d     |
+      | null | true | null | false |
+    And no side effects
+
+  Scenario: Three-valued logic short circuits correctly in filters
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:N {a: 1}), (:N {b: 1}), (:N {a: 1, b: 1})
+      """
+    When executing query:
+      """
+      MATCH (n:N) WHERE n.a = 1 OR n.b = 1 RETURN count(*) AS c
+      """
+    Then the result should be, in any order:
+      | c |
+      | 3 |
+    And no side effects
+
+  Scenario: Equality of different value types is false not null
+    Given an empty graph
+    When executing query:
+      """
+      RETURN 1 = 'a' AS a, true = 1 AS b, 'x' = false AS c
+      """
+    Then the result should be, in any order:
+      | a     | b     | c     |
+      | false | false | false |
+    And no side effects
+
+  Scenario: Integer and float equality crosses representation
+    Given an empty graph
+    When executing query:
+      """
+      RETURN 1 = 1.0 AS a, 0 = -0.0 AS b, 2 = 2.5 AS c
+      """
+    Then the result should be, in any order:
+      | a    | b    | c     |
+      | true | true | false |
+    And no side effects
+
+  Scenario: NaN is not equal to itself
+    Given an empty graph
+    When executing query:
+      """
+      WITH 0.0 / 0.0 AS nan
+      RETURN nan = nan AS a, nan <> nan AS b
+      """
+    Then the result should be, in any order:
+      | a     | b    |
+      | false | true |
+    And no side effects
+
+  Scenario: IS NULL and IS NOT NULL are two-valued
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:N {v: 1}), (:N)
+      """
+    When executing query:
+      """
+      MATCH (n:N)
+      RETURN n.v IS NULL AS isn, n.v IS NOT NULL AS nn ORDER BY nn
+      """
+    Then the result should be, in order:
+      | isn   | nn    |
+      | true  | false |
+      | false | true  |
+    And no side effects
+
+  Scenario: Arithmetic with null propagates
+    Given an empty graph
+    When executing query:
+      """
+      RETURN 1 + null AS a, null * 2 AS b, -null AS c
+      """
+    Then the result should be, in any order:
+      | a    | b    | c    |
+      | null | null | null |
+    And no side effects
+
+  Scenario: String predicates with null operands are null
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:N {s: 'abc'}), (:N)
+      """
+    When executing query:
+      """
+      MATCH (n:N)
+      RETURN n.s STARTS WITH 'a' AS sw ORDER BY sw
+      """
+    Then the result should be, in order:
+      | sw   |
+      | true |
+      | null |
+    And no side effects

@@ -1,0 +1,153 @@
+Feature: UnionQueries
+
+  Scenario: UNION ALL keeps duplicates
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:A {v: 1}), (:B {v: 1})
+      """
+    When executing query:
+      """
+      MATCH (a:A) RETURN a.v AS v
+      UNION ALL
+      MATCH (b:B) RETURN b.v AS v
+      """
+    Then the result should be, in any order:
+      | v |
+      | 1 |
+      | 1 |
+    And no side effects
+
+  Scenario: UNION removes duplicate rows
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:A {v: 1}), (:B {v: 1}), (:B {v: 2})
+      """
+    When executing query:
+      """
+      MATCH (a:A) RETURN a.v AS v
+      UNION
+      MATCH (b:B) RETURN b.v AS v
+      """
+    Then the result should be, in any order:
+      | v |
+      | 1 |
+      | 2 |
+    And no side effects
+
+  Scenario: UNION of three branches
+    Given an empty graph
+    When executing query:
+      """
+      RETURN 1 AS x
+      UNION
+      RETURN 2 AS x
+      UNION
+      RETURN 1 AS x
+      """
+    Then the result should be, in any order:
+      | x |
+      | 1 |
+      | 2 |
+    And no side effects
+
+  Scenario: UNION ALL of literal rows preserves multiplicity
+    Given an empty graph
+    When executing query:
+      """
+      RETURN 'a' AS s
+      UNION ALL
+      RETURN 'a' AS s
+      UNION ALL
+      RETURN 'b' AS s
+      """
+    Then the result should be, in any order:
+      | s   |
+      | 'a' |
+      | 'a' |
+      | 'b' |
+    And no side effects
+
+  Scenario: UNION dedups on whole rows not single columns
+    Given an empty graph
+    When executing query:
+      """
+      RETURN 1 AS a, 2 AS b
+      UNION
+      RETURN 1 AS a, 3 AS b
+      """
+    Then the result should be, in any order:
+      | a | b |
+      | 1 | 2 |
+      | 1 | 3 |
+    And no side effects
+
+  Scenario: UNION with different types in one column
+    Given an empty graph
+    When executing query:
+      """
+      RETURN 1 AS v
+      UNION
+      RETURN 'one' AS v
+      """
+    Then the result should be, in any order:
+      | v     |
+      | 1     |
+      | 'one' |
+    And no side effects
+
+  Scenario: UNION with nulls dedups null rows
+    Given an empty graph
+    When executing query:
+      """
+      RETURN null AS v
+      UNION
+      RETURN null AS v
+      """
+    Then the result should be, in any order:
+      | v    |
+      | null |
+    And no side effects
+
+  Scenario: Aggregates run per branch before the union
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:A {v: 1}), (:A {v: 2}), (:B {v: 5})
+      """
+    When executing query:
+      """
+      MATCH (a:A) RETURN sum(a.v) AS s
+      UNION ALL
+      MATCH (b:B) RETURN sum(b.v) AS s
+      """
+    Then the result should be, in any order:
+      | s |
+      | 3 |
+      | 5 |
+    And no side effects
+
+  Scenario: Mixing UNION and UNION ALL is an error
+    Given an empty graph
+    When executing query:
+      """
+      RETURN 1 AS x
+      UNION
+      RETURN 2 AS x
+      UNION ALL
+      RETURN 3 AS x
+      """
+    Then a SyntaxError should be raised at compile time: InvalidClauseComposition
+    And no side effects
+
+  Scenario: UNION branches must share column names
+    Given an empty graph
+    When executing query:
+      """
+      RETURN 1 AS x
+      UNION
+      RETURN 2 AS y
+      """
+    Then a SyntaxError should be raised at compile time: DifferentColumnsInUnion
+    And no side effects

@@ -1,0 +1,207 @@
+Feature: WithAcceptance2
+
+  Scenario: WITH narrows the visible variables
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:A {n: 1})-[:R]->(:B {m: 2})
+      """
+    When executing query:
+      """
+      MATCH (a:A)-[:R]->(b) WITH b RETURN b.m AS m
+      """
+    Then the result should be, in any order:
+      | m |
+      | 2 |
+    And no side effects
+
+  Scenario: WITH DISTINCT dedups whole rows
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:A {g: 1})-[:R]->(:B), (:A {g: 1})-[:R]->(:B)
+      """
+    When executing query:
+      """
+      MATCH (a:A)-[:R]->() WITH DISTINCT a.g AS g RETURN g
+      """
+    Then the result should be, in any order:
+      | g |
+      | 1 |
+    And no side effects
+
+  Scenario: WITH can rename and recompute
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:N {v: 3})
+      """
+    When executing query:
+      """
+      MATCH (n:N) WITH n.v AS original, n.v * 2 AS doubled
+      RETURN original, doubled
+      """
+    Then the result should be, in any order:
+      | original | doubled |
+      | 3        | 6       |
+    And no side effects
+
+  Scenario: WITH ORDER BY LIMIT creates a top-k window
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:N {v: 5}), (:N {v: 1}), (:N {v: 4}), (:N {v: 2})
+      """
+    When executing query:
+      """
+      MATCH (n:N) WITH n.v AS v ORDER BY v DESC LIMIT 2
+      RETURN sum(v) AS s
+      """
+    Then the result should be, in any order:
+      | s |
+      | 9 |
+    And no side effects
+
+  Scenario: WHERE after WITH filters computed values
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:N {v: 1}), (:N {v: 2}), (:N {v: 3})
+      """
+    When executing query:
+      """
+      MATCH (n:N) WITH n.v * 10 AS x WHERE x > 15
+      RETURN collect(x) AS l
+      """
+    Then the result should be (ignoring element order for lists):
+      | l        |
+      | [20, 30] |
+    And no side effects
+
+  Scenario: Chained WITH clauses compose
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:N {v: 1}), (:N {v: 2}), (:N {v: 3}), (:N {v: 4})
+      """
+    When executing query:
+      """
+      MATCH (n:N) WITH n.v AS v WHERE v > 1
+      WITH v WHERE v < 4
+      RETURN collect(v) AS l
+      """
+    Then the result should be (ignoring element order for lists):
+      | l      |
+      | [2, 3] |
+    And no side effects
+
+  Scenario: WITH star keeps everything and adds
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:N {v: 2})
+      """
+    When executing query:
+      """
+      MATCH (n:N) WITH *, n.v AS v RETURN n.v AS nv, v
+      """
+    Then the result should be, in any order:
+      | nv | v |
+      | 2  | 2 |
+    And no side effects
+
+  Scenario: MATCH after WITH expands from carried nodes
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:A {n: 1})-[:R]->(:B {m: 5})-[:S]->(:C {k: 9})
+      """
+    When executing query:
+      """
+      MATCH (a:A)-[:R]->(b) WITH b
+      MATCH (b)-[:S]->(c)
+      RETURN b.m AS m, c.k AS k
+      """
+    Then the result should be, in any order:
+      | m | k |
+      | 5 | 9 |
+    And no side effects
+
+  Scenario: Aliased aggregate feeds later arithmetic
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:N {v: 1}), (:N {v: 2})
+      """
+    When executing query:
+      """
+      MATCH (n:N) WITH count(*) AS c
+      RETURN c * 10 AS scaled
+      """
+    Then the result should be, in any order:
+      | scaled |
+      | 20     |
+    And no side effects
+
+  Scenario: UNWIND after WITH multiplies rows
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:N {v: 1}), (:N {v: 2})
+      """
+    When executing query:
+      """
+      MATCH (n:N) WITH n.v AS v
+      UNWIND [1, 2] AS u
+      RETURN count(*) AS c
+      """
+    Then the result should be, in any order:
+      | c |
+      | 4 |
+    And no side effects
+
+  Scenario: Shadowing a variable name after WITH is allowed
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:A {n: 1}), (:B {n: 9})
+      """
+    When executing query:
+      """
+      MATCH (x:A) WITH x.n AS n
+      MATCH (x:B)
+      RETURN n, x.n AS bn
+      """
+    Then the result should be, in any order:
+      | n | bn |
+      | 1 | 9  |
+    And no side effects
+
+  Scenario: WITH SKIP slides the window
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:N {v: 1}), (:N {v: 2}), (:N {v: 3})
+      """
+    When executing query:
+      """
+      MATCH (n:N) WITH n.v AS v ORDER BY v SKIP 1
+      RETURN collect(v) AS l
+      """
+    Then the result should be, in any order:
+      | l      |
+      | [2, 3] |
+    And no side effects
+
+  Scenario: Referring to a dropped variable is an error
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:A {n: 1})-[:R]->(:B)
+      """
+    When executing query:
+      """
+      MATCH (a:A)-[:R]->(b) WITH b RETURN a.n AS n
+      """
+    Then a SyntaxError should be raised at compile time: UndefinedVariable
+    And no side effects

@@ -61,12 +61,11 @@ DUR = "dur"  # int64 (n, 3): months / days / total micros (seconds*1e6+us) —
 #              collapsed into one microsecond count (bijective: 0 <= us < 1e6)
 OBJ = "obj"  # host-side Python objects (lists, elements) — not device resident
 
-# duration ORDER/min/max key: average-length microseconds (month = 30.4375
-# days, the reference's CalendarInterval comparison basis); ties keep first
-# occurrence on BOTH backends (stable sorts / first-match selection). The
-# constants live in api.values (the oracle's order key) — one definition.
-from ...api.values import _DUR_DAY_US as DUR_DAY_US  # noqa: E402
-from ...api.values import _DUR_MONTH_US as DUR_MONTH_US  # noqa: E402
+# duration ORDER/min/max keys use average-length microseconds (month =
+# 30.4375 days, the reference's CalendarInterval comparison basis); ties
+# keep first occurrence on BOTH backends (stable sorts / first-match
+# selection). One definition: api.values (the oracle's order key), consumed
+# on device by jit_ops._dur_order_key.
 
 # temporal kinds share the integer device machinery (sort keys, joins,
 # distinct/group packing, min/max) — they differ only in decode + typing

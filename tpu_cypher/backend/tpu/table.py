@@ -26,10 +26,12 @@ fused kernels use under jit:
 * skip/limit    = contiguous device slices (no gather)
 * with_columns  = compiled expressions
 
-Operations with no device representation (list values, regex, string concat,
-exotic functions, object columns) and the remaining aggregators (collect,
-stdev, percentiles, DISTINCT variants) transparently fall back to the local
-oracle backend, keeping full Cypher semantics."""
+Aggregators run on device too: count/sum/avg/min/max (numeric, temporal,
+and duration columns), stdev/stdevp, percentileCont/Disc, collect, and the
+DISTINCT variants via a device pre-dedup (``_DEVICE_AGGS``). Operations with
+no device representation (list values, regex, string concat, exotic
+functions, object columns) transparently fall back to the local oracle
+backend per expression, keeping full Cypher semantics."""
 
 from __future__ import annotations
 

@@ -671,9 +671,7 @@ def _static_value(expr: E.Expr, params: Dict[str, Any]):
     if isinstance(expr, E.Neg):
         v = _static_value(expr.expr, params)
         return -v if v is not None else None
-    if isinstance(expr, E.ArithmeticExpr) and isinstance(
-        expr, (E.Add, E.Subtract, E.Multiply, E.Divide, E.Modulo)
-    ):
+    if isinstance(expr, (E.Add, E.Subtract, E.Multiply, E.Divide, E.Modulo)):
         l = _static_value(expr.lhs, params)
         r = _static_value(expr.rhs, params)
         if l is None or r is None:
