@@ -1,0 +1,158 @@
+"""Explicit hash-repartition join on the mesh (SURVEY §2.3 distributed
+join / VERDICT r3 missing #3): each device buckets its keys by value, ONE
+all_to_all per side meets equal keys on one shard, and the join runs
+locally per shard — the deliberate analog of the engines' shuffled hash
+join (``SparkTable.scala:178``). Differential vs host ground truth and vs
+the whole-engine pipeline under the 8-device CPU mesh."""
+
+from collections import Counter
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from tpu_cypher import CypherSession
+from tpu_cypher.api.mapping import NodeMappingBuilder, RelationshipMappingBuilder
+from tpu_cypher.parallel import shuffle as SH
+from tpu_cypher.parallel.mesh import make_row_mesh, use_mesh
+from tpu_cypher.relational.graphs import ElementTable
+
+
+def _ground_truth(lk, lv, rk, rv):
+    rmap = {}
+    for j, (k, v) in enumerate(zip(rk, rv)):
+        if v:
+            rmap.setdefault(int(k), []).append(j)
+    want = Counter()
+    for i, (k, v) in enumerate(zip(lk, lv)):
+        if v:
+            for j in rmap.get(int(k), []):
+                want[(i, j)] += 1
+    return want
+
+
+@pytest.mark.parametrize(
+    "seed,n_l,n_r,lo,hi",
+    [
+        (0, 1003, 777, 0, 500),      # non-divisible sizes, duplicates
+        (1, 64, 64, 0, 8),           # heavy duplication, small key space
+        (2, 500, 3, 0, 1000),        # tiny build side
+        (3, 257, 999, 10_000, 10_050),  # dense collisions, offset ids
+    ],
+)
+def test_hash_repartition_join_matches_ground_truth(seed, n_l, n_r, lo, hi):
+    rng = np.random.default_rng(seed)
+    lk = rng.integers(lo, hi, n_l)
+    rk = rng.integers(lo, hi, n_r)
+    lv = rng.random(n_l) > 0.15
+    rv = rng.random(n_r) > 0.15
+    with use_mesh(make_row_mesh()):
+        got = SH.hash_repartition_join(
+            jnp.asarray(lk), jnp.asarray(lv), jnp.asarray(rk), jnp.asarray(rv)
+        )
+    assert got is not None
+    got_c = Counter(
+        zip(np.asarray(got[0]).tolist(), np.asarray(got[1]).tolist())
+    )
+    assert got_c == _ground_truth(lk, lv, rk, rv)
+
+
+def test_negative_keys_join_correctly():
+    """Property-value joins can carry negative int64 keys; the even-key
+    namespace keeps them first-class (the round-4 review caught a sentinel
+    scheme that silently dropped them)."""
+    lk = np.array([-5, -5, 0, 3, -(2**61)], dtype=np.int64)
+    rk = np.array([-5, 3, -1, 0, -(2**61)], dtype=np.int64)
+    with use_mesh(make_row_mesh()):
+        got = SH.hash_repartition_join(
+            jnp.asarray(lk), None, jnp.asarray(rk), None
+        )
+    assert got is not None
+    got_c = Counter(
+        zip(np.asarray(got[0]).tolist(), np.asarray(got[1]).tolist())
+    )
+    assert got_c == _ground_truth(lk, [True] * 5, rk, [True] * 5)
+
+
+def test_oversized_keys_fall_back_to_none():
+    lk = jnp.asarray(np.array([1 << 62], dtype=np.int64))
+    with use_mesh(make_row_mesh()):
+        assert SH.hash_repartition_join(lk, None, lk, None) is None
+
+
+def test_skew_overflow_falls_back_to_none():
+    """One hot key routes every row to one bucket: the static capacity
+    overflows and the helper reports None (caller keeps the global join)."""
+    n = 4096
+    lk = jnp.zeros(n, jnp.int64)  # all rows hash to shard 0
+    rk = jnp.zeros(n, jnp.int64)
+    with use_mesh(make_row_mesh()):
+        got = SH.hash_repartition_join(lk, None, rk, None)
+    assert got is None
+
+
+def test_engine_join_on_mesh_uses_shuffle(monkeypatch):
+    """An engine query whose plan genuinely JOINS (dangling edge endpoints
+    make the CSR index bail, so Expand runs as the classic scan+join
+    cascade) routes the mesh join through hash_repartition_join and
+    matches the oracle."""
+    calls = {"n": 0}
+    orig = SH.hash_repartition_join
+
+    def spy(*a, **k):
+        out = orig(*a, **k)
+        if out is not None:
+            calls["n"] += 1
+        return out
+
+    monkeypatch.setattr(SH, "hash_repartition_join", spy)
+
+    rng = np.random.default_rng(5)
+    n, e = 120, 400
+    ids = np.arange(n, dtype=np.int64) * 7 + 3
+    src = rng.integers(0, n, e)
+    dst = rng.integers(0, n, e)
+    ages = (np.arange(n) % 9).tolist()
+    s_ids = ids[src].tolist()
+    t_ids = ids[dst].tolist()
+    # dangling endpoints: ids outside the node set force the classic
+    # scan+join expand cascade (the CSR index requires closed topology)
+    s_ids[0] = 999_999
+    t_ids[1] = 999_998
+
+    def build(session):
+        nt = session.table_cls.from_columns({"id": ids.tolist(), "age": ages})
+        nm = (
+            NodeMappingBuilder.on("id")
+            .with_implied_label("P")
+            .with_property_key("age")
+            .build()
+        )
+        rt = session.table_cls.from_columns(
+            {
+                "rid": (np.arange(e, dtype=np.int64) + 100_000).tolist(),
+                "s": s_ids,
+                "t": t_ids,
+            }
+        )
+        rm = (
+            RelationshipMappingBuilder.on("rid")
+            .from_("s")
+            .to("t")
+            .with_relationship_type("K")
+            .build()
+        )
+        return session.read_from(ElementTable(nm, nt), ElementTable(rm, rt))
+
+    q = (
+        "MATCH (a:P)-[:K]->(b:P) "
+        "RETURN b.age AS g, count(*) AS c ORDER BY g, c"
+    )
+    g_local = build(CypherSession.local())
+    want = [dict(r) for r in g_local.cypher(q).records.collect()]
+    with use_mesh(make_row_mesh()):
+        g_tpu = build(CypherSession.tpu())
+        got = [dict(r) for r in g_tpu.cypher(q).records.collect()]
+    assert got == want
+    assert calls["n"] >= 1, "mesh join did not route through the shuffle"

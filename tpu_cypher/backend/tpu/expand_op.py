@@ -37,6 +37,32 @@ from .column import OBJ, Column, TpuBackendError, mask_to_idx as _mask_to_idx
 from .graph_index import CANON_NODE, CANON_REL, GraphIndex, GraphIndexError, rekey_element_expr
 
 
+def _mxu_dense_mode() -> bool:
+    """Route 2-hop counts through the MXU dense tier (blocked bf16 A @ A,
+    ``jit_ops.mxu_close_count``/``mxu_distinct_pairs``)? Defaults to ON for
+    accelerator backends (matmuls are where the TPU's FLOPs live) and OFF
+    for CPU (the native stamping kernels win there; dense N^3 does not).
+    ``TPU_CYPHER_MXU_DENSE=force`` enables it anywhere (correctness tests),
+    ``=0`` disables."""
+    import os
+
+    mode = os.environ.get("TPU_CYPHER_MXU_DENSE", "auto")
+    if mode == "0":
+        return False
+    if mode in ("1", "force"):
+        return True
+    return jax.default_backend() != "cpu"
+
+
+def _pad_mask(mask, npad: int):
+    """Optional bool[num_nodes] label mask -> bf16 0/1[(npad,)] or None."""
+    if mask is None:
+        return None
+    return jnp.pad(
+        mask.astype(jnp.bfloat16), (0, npad - mask.shape[0])
+    )
+
+
 def _owner_name(e: E.Expr) -> Optional[str]:
     if isinstance(e, E.Var):
         return e.name
@@ -592,16 +618,19 @@ class CsrExpandOp(_FusedExpandBase):
                 if spec is None:
                     return None  # materialized path enforces via row masks
                 carry, mask_pairs, _ = spec
-            elif (
-                len(hops) == 2
-                and jax.default_backend() == "cpu"
-                and current_mesh() is None
-            ):
-                # host tier: stamped one-pass count in C++ (native/) — no
-                # 20M-row materialize, no sort, O(N) cache-resident state
-                got = self._native_two_hop(
-                    gi, ctx, hops, id_col, use_a=use_a, use_c=use_c
-                )
+            elif len(hops) == 2 and current_mesh() is None:
+                got = None
+                if use_a and use_c and _mxu_dense_mode():
+                    # MXU tier: nonzero count of the blocked bf16 boolean
+                    # product — one matmul chain instead of 20M-row state
+                    got = self._mxu_distinct_pairs(gi, ctx, hops, id_col)
+                if got is None and jax.default_backend() == "cpu":
+                    # host tier: stamped one-pass count in C++ (native/) —
+                    # no 20M-row materialize, no sort, O(N) cache-resident
+                    # state
+                    got = self._native_two_hop(
+                        gi, ctx, hops, id_col, use_a=use_a, use_c=use_c
+                    )
                 if got is not None:
                     return got
 
@@ -640,6 +669,30 @@ class CsrExpandOp(_FusedExpandBase):
             )
         except (GraphIndexError, TpuBackendError):
             return None
+
+    def _mxu_distinct_pairs(self, gi, ctx, hops, id_col):
+        """count(DISTINCT a, c) as the nonzero count of the blocked bf16
+        boolean matmul chain (``jit_ops.mxu_distinct_pairs``); None when
+        the dense tier doesn't apply."""
+        base, final_hop = hops[1], hops[0]
+        got1 = gi.dense_adj(base.types_key, base.backwards, ctx)
+        got2 = gi.dense_adj(final_hop.types_key, final_hop.backwards, ctx)
+        if got1 is None or got2 is None:
+            return None
+        a1, _, rowsum1 = got1
+        a2, entry2, _ = got2
+        if rowsum1 * entry2 > (1 << 24):
+            return None  # >0.5 test needs the f32 cell to stay nonzero-exact
+        pos, present = gi.compact_of(id_col, ctx)
+        npad = int(a1.shape[0])
+        pres = J.frontier_multiplicity(pos, present, n=npad) > 0
+        m_b = _pad_mask(gi.label_mask(base.far_labels, ctx), npad)
+        m_c = _pad_mask(gi.label_mask(final_hop.far_labels, ctx), npad)
+        return int(
+            J.mxu_distinct_pairs(
+                a1, a2, pres, m_b, m_c, block=GraphIndex.DENSE_BLOCK
+            )
+        )
 
     def _native_two_hop(self, gi, ctx, hops, id_col, *, use_a, use_c):
         """Host-tier 2-hop DISTINCT count via the C++ stamping kernel
@@ -874,12 +927,20 @@ class CsrExpandIntoOp(_FusedExpandBase):
             if (
                 len(hops) == 2
                 and not self.undirected
-                and jax.default_backend() == "cpu"
                 and current_mesh() is None
             ):
-                got = self._native_close_count(gi, ctx, hops, id_col, src_is_base)
-                if got is not None:
-                    return got
+                if _mxu_dense_mode():
+                    got = self._mxu_close_count(
+                        gi, ctx, hops, id_col, src_is_base
+                    )
+                    if got is not None:
+                        return got
+                if jax.default_backend() == "cpu":
+                    got = self._native_close_count(
+                        gi, ctx, hops, id_col, src_is_base
+                    )
+                    if got is not None:
+                        return got
 
             def final(rp, ci, eo, pos, deg, akey, mask, prevs, order, midx, total):
                 return int(
@@ -894,6 +955,37 @@ class CsrExpandIntoOp(_FusedExpandBase):
             return _fused_chain_walk(gi, ctx, hops, id_col, final)
         except (GraphIndexError, TpuBackendError):
             return None
+
+    def _mxu_close_count(self, gi, ctx, hops, id_col, src_is_base):
+        """Triangle/cycle close count as blocked bf16 matmuls on the MXU:
+        tri = sum_a mult[a] * sum_c (A1 @ A2)[a, c] * C[a, c]. The closing
+        adjacency C is oriented FROM the walk's base endpoint (probe (a, c)
+        uses the forward matrix, probe (c, a) the reverse). None when the
+        dense form doesn't apply (graph too large, multiplicity > bf16's
+        exact range)."""
+        base, final_hop = hops[1], hops[0]
+        got1 = gi.dense_adj(base.types_key, base.backwards, ctx)
+        got2 = gi.dense_adj(final_hop.types_key, final_hop.backwards, ctx)
+        gotc = gi.dense_adj(self.types_key, not src_is_base, ctx)
+        if got1 is None or got2 is None or gotc is None:
+            return None
+        a1, _, rowsum1 = got1
+        a2, entry2, _ = got2
+        cm, _, _ = gotc
+        if rowsum1 * entry2 > (1 << 24):
+            # a single 2-path cell could pass f32's exact-integer range
+            # inside the matmul accumulator — keep the walk path
+            return None
+        pos, present = gi.compact_of(id_col, ctx)
+        npad = int(a1.shape[0])
+        mult = J.frontier_multiplicity(pos, present, n=npad)
+        m_b = _pad_mask(gi.label_mask(base.far_labels, ctx), npad)
+        m_c = _pad_mask(gi.label_mask(final_hop.far_labels, ctx), npad)
+        return int(
+            J.mxu_close_count(
+                a1, a2, cm, mult, m_b, m_c, block=GraphIndex.DENSE_BLOCK
+            )
+        )
 
     def _native_close_count(self, gi, ctx, hops, id_col, src_is_base):
         """Host-tier triangle/cycle close count via the C++ stamping kernel

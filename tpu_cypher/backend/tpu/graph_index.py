@@ -113,6 +113,9 @@ class GraphIndex:
         # types_key -> Optional dense bool[N*N] edge-presence bitmap (host
         # backends probe closes by one gather instead of a binary search)
         self._edge_bitmap: Dict[Tuple[str, ...], Optional[Any]] = {}
+        # (types_key, reverse) -> Optional (Npad, Npad) bf16 dense adjacency
+        # with edge MULTIPLICITY entries (MXU matmul tier; Npad = block pad)
+        self._dense_adj: Dict[Tuple[Tuple[str, ...], bool], Optional[Any]] = {}
         # types_key -> device int64[num_nodes] self-loop counts (undirected
         # count chains subtract the double-counted loop contribution)
         self._loop_count: Dict[Tuple[str, ...], Any] = {}
@@ -348,6 +351,48 @@ class GraphIndex:
                     out = jnp.asarray(bm)
             self._edge_bitmap[types_key] = out
         return self._edge_bitmap[types_key]
+
+    DENSE_BLOCK = 256  # MXU tile-friendly row-block / pad quantum
+
+    def dense_adj(
+        self, types_key: Tuple[str, ...], reverse: bool, ctx,
+        max_nodes: int = 16384,
+    ) -> Optional[Tuple[Any, int, int]]:
+        """Dense bf16[(Npad, Npad)] adjacency with edge-MULTIPLICITY
+        entries for the MXU matmul tier (``jit_ops.mxu_close_count`` /
+        ``mxu_distinct_pairs``): path counting as blocked ``A @ A`` on the
+        systolic array — where the TPU's FLOPs actually are — instead of
+        gather/searchsorted streams. Returns ``(matrix, max_entry,
+        max_row_sum)`` (the exactness metadata callers use to bound the
+        f32 accumulator), or None when the graph is too large for the
+        dense form (Npad^2 bf16 per matrix) or a multiplicity exceeds
+        bf16's exact-integer range (256). Rows/cols past N are zero."""
+        key = (types_key, reverse)
+        if key not in self._dense_adj:
+            self.node_ids(ctx)
+            n = self.num_nodes
+            if not 0 < n <= max_nodes:
+                # cheap size gate BEFORE resolving per-edge endpoints
+                self._dense_adj[key] = None
+                return None
+            s, d, _ = self._edge_endpoints(types_key, ctx)
+            out = None
+            b = self.DENSE_BLOCK
+            npad = -(-n // b) * b
+            a, bb = (d, s) if reverse else (s, d)
+            dense = np.zeros((npad, npad), dtype=np.int32)
+            np.add.at(dense, (a, bb), 1)
+            max_entry = int(dense.max()) if len(s) else 0
+            if max_entry <= 256:
+                out = (
+                    jnp.asarray(dense.astype(np.float32)).astype(
+                        jnp.bfloat16
+                    ),
+                    max_entry,
+                    int(dense.sum(axis=1).max()) if len(s) else 0,
+                )
+            self._dense_adj[key] = out
+        return self._dense_adj[key]
 
     def csr_max_degree(self, types_key: Tuple[str, ...], reverse: bool, ctx) -> int:
         """Host-cached max degree of one CSR orientation (computed at

@@ -209,6 +209,14 @@ def expand_degrees_total(rp, pos, present):
     return deg, jnp.sum(deg)
 
 
+@partial(jax.jit, static_argnames=("n",))
+def frontier_multiplicity(pos, present, n: int):
+    """int64[n] count of frontier rows per compact node (absent rows spill
+    into a dropped slot) — the MXU tier's row-weight vector."""
+    acc = jnp.zeros(n + 1, jnp.int64).at[jnp.where(present, pos, n)].add(1)
+    return acc[:n]
+
+
 @partial(jax.jit, static_argnames=("total",))
 def expand_materialize(rp, ci, eo, pos, deg, total: int):
     """(row, nbr, orig) for one expand half; ``total`` = sum(deg), static."""
@@ -817,6 +825,70 @@ def equivalence_minmax(datas, valids, extra_keys, kinds):
     return (
         jnp.stack([k.min() for k in ints]),
         jnp.stack([k.max() for k in ints]),
+    )
+
+
+# ---------------------------------------------------------------------------
+# MXU dense tier: path counting as blocked A @ A on the systolic array.
+# The CSR walk streams gathers through the VPU; for graphs whose dense
+# adjacency fits HBM, the same counts are ONE chain of bf16 matmuls with
+# f32 accumulation — the shape the MXU was built for. Entries are exact
+# small integers (multiplicities <= 256, checked at build), block row-sums
+# round back to int64 before accumulating, so results are exact.
+# ---------------------------------------------------------------------------
+
+
+@partial(jax.jit, static_argnames=("block",))
+def mxu_close_count(a1, a2, c, mult, mask_b, mask_c, block: int):
+    """count(*) of (a)-[r1]->(b)-[r2]->(c'), (a)-[rc]->(c') as
+    sum_a mult[a] * sum_c (A1 @ A2)[a, c] * C[a, c]: per row-block one
+    (block, N) @ (N, N) matmul + one elementwise product with the closing
+    adjacency. ``mult``: frontier multiplicity per source row (int64);
+    masks: optional bf16 0/1 vectors folding far-label filters."""
+    n = a1.shape[0]
+
+    def body(i, acc):
+        blk = lax.dynamic_slice_in_dim(a1, i * block, block, 0)
+        if mask_b is not None:
+            blk = blk * mask_b[None, :]
+        p2 = jnp.dot(blk, a2, preferred_element_type=jnp.float32)
+        cb = lax.dynamic_slice_in_dim(c, i * block, block, 0).astype(
+            jnp.float32
+        )
+        prod = p2 * cb
+        if mask_c is not None:
+            prod = prod * mask_c[None, :].astype(jnp.float32)
+        # f64 row reduction: per-row totals may pass f32's 2^24 exact range
+        row = jnp.sum(prod.astype(jnp.float64), axis=1)
+        mb = lax.dynamic_slice_in_dim(mult, i * block, block, 0)
+        return acc + jnp.sum(jnp.round(row).astype(jnp.int64) * mb)
+
+    return lax.fori_loop(
+        0, n // block, body, jnp.asarray(0, jnp.int64)
+    )
+
+
+@partial(jax.jit, static_argnames=("block",))
+def mxu_distinct_pairs(a1, a2, present, mask_b, mask_c, block: int):
+    """count(DISTINCT a, c) over a 2-hop chain as the nonzero count of the
+    boolean product: per row-block (block, N) @ (N, N) then a >0 test.
+    ``present``: bool per source row (frontier membership)."""
+    n = a1.shape[0]
+
+    def body(i, acc):
+        blk = lax.dynamic_slice_in_dim(a1, i * block, block, 0)
+        if mask_b is not None:
+            blk = blk * mask_b[None, :]
+        p2 = jnp.dot(blk, a2, preferred_element_type=jnp.float32)
+        hit = p2 > 0.5
+        if mask_c is not None:
+            hit = hit & (mask_c[None, :] > 0.5)
+        pb = lax.dynamic_slice_in_dim(present, i * block, block, 0)
+        hit = hit & pb[:, None]
+        return acc + jnp.sum(hit.astype(jnp.int64))
+
+    return lax.fori_loop(
+        0, n // block, body, jnp.asarray(0, jnp.int64)
     )
 
 

@@ -87,6 +87,16 @@ class _FallbackCounter:
 FALLBACK_COUNTER = _FallbackCounter()
 
 
+def _fold_valids(valids):
+    """AND a tuple of validity masks into one (None = all valid)."""
+    out = None
+    for v in valids:
+        if v is None:
+            continue
+        out = v if out is None else (out & v)
+    return out
+
+
 class TpuTable(Table):
     def __init__(self, cols: Dict[str, Column], nrows: Optional[int] = None):
         self._cols = dict(cols)
@@ -379,19 +389,34 @@ class TpuTable(Table):
         )
         lvalids = l_extra_valid + ((lk.valid,) if lk.valid is not None else ())
         rvalids = r_extra_valid + ((rk.valid,) if rk.valid is not None else ())
-        is_f64 = lk.kind == F64
-        is_bool = lk.kind == BOOL
-        # phase 1: build side sorted valid-first (one jitted dispatch, one
-        # scalar sync for the valid count)
-        rd_s, r_order, nvalid_dev = J.join_build(rk.data, rvalids, is_f64=is_f64, is_bool=is_bool)
-        nvalid = int(nvalid_dev)
-        # phase 2: probe by binary search (one dispatch, one sync for total)
-        r_idx_valid, lo, counts, total_dev = J.join_probe(
-            rd_s, r_order, lk.data, lvalids, nvalid=nvalid, is_f64=is_f64, is_bool=is_bool
-        )
-        total = int(total_dev)
-        # phase 3: materialize match row pairs (one dispatch, static total)
-        left_rows, right_rows = J.join_materialize(r_idx_valid, lo, counts, total=total)
+        left_rows = right_rows = None
+        if kind == "inner" and lk.kind == I64 and rk.kind == I64:
+            # mesh path: DELIBERATE hash-repartition join (all_to_all
+            # shuffle + per-shard local joins — the engines' shuffled hash
+            # join, SparkTable.scala:178) instead of relying on GSPMD to
+            # partition a global sort. None = no mesh / bucket overflow.
+            from ...parallel.shuffle import hash_repartition_join
+
+            lv = _fold_valids(lvalids)
+            rv = _fold_valids(rvalids)
+            got = hash_repartition_join(lk.data, lv, rk.data, rv)
+            if got is not None:
+                left_rows, right_rows = got
+                total = int(left_rows.shape[0])
+        if left_rows is None:
+            is_f64 = lk.kind == F64
+            is_bool = lk.kind == BOOL
+            # phase 1: build side sorted valid-first (one jitted dispatch,
+            # one scalar sync for the valid count)
+            rd_s, r_order, nvalid_dev = J.join_build(rk.data, rvalids, is_f64=is_f64, is_bool=is_bool)
+            nvalid = int(nvalid_dev)
+            # phase 2: probe by binary search (one dispatch, one sync)
+            r_idx_valid, lo, counts, total_dev = J.join_probe(
+                rd_s, r_order, lk.data, lvalids, nvalid=nvalid, is_f64=is_f64, is_bool=is_bool
+            )
+            total = int(total_dev)
+            # phase 3: materialize match pairs (one dispatch, static total)
+            left_rows, right_rows = J.join_materialize(r_idx_valid, lo, counts, total=total)
         if len(join_cols) > 1 and total:
             never_match = False
             l_datas, l_valids2, r_datas, r_valids2, kinds = [], [], [], [], []

@@ -1,0 +1,241 @@
+"""Explicit hash-repartition (shuffle) equi-join for the mesh path.
+
+The reference engines join by hash-SHUFFLING both sides so equal keys meet
+on one worker (``SparkTable.scala:178`` joins ride Spark's exchange;
+``flink-cypher TableOps.scala:146`` likewise) — the partitioning of the
+intermediate is a deliberate plan decision, not an accident of input
+layout. The engine's default device join is one global sort + binary-search
+probe, which XLA/GSPMD partitions by propagating the INPUT shardings; at
+pod scale a global ``lax.sort`` degenerates to an all-gather. This module
+is the deliberate alternative (SURVEY §2.3 "distributed join / shuffle",
+VERDICT r3 missing #3):
+
+* each device buckets its local key block by ``key % n_shards`` — a row's
+  bucket depends only on its VALUE, so equal keys land on equal shards;
+* ONE ``lax.all_to_all`` per side exchanges the buckets over the mesh axis
+  (ICI within a host, DCN across hosts — exactly where the engines
+  shuffle);
+* each shard then joins its received blocks LOCALLY (sort + searchsorted
+  over per-shard data — no global collective in the join itself);
+* match pairs return as GLOBAL row indices carried through the exchange.
+
+Static-shape discipline (everything under ``shard_map`` is compiled once):
+buckets get a fixed capacity ``cap_factor * fair_share``; a skewed key
+distribution that overflows a bucket is detected ON DEVICE and reported
+back — the caller falls back to the global sort-probe join, trading layout
+quality for unconditional correctness. Join output uses the engine's
+count-then-materialize discipline: phase A syncs per-shard match counts,
+phase B materializes padded to the max count.
+
+Runs bit-identically on the CPU test mesh (8 virtual devices) and a TPU
+pod — only the device list changes."""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Dict, Optional, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from .mesh import current_mesh, mesh_size, shard_map
+
+# Key namespace: real keys ship DOUBLED (even numbers — injective, equality
+# and bucket assignment preserved); pad slots use per-side odd sentinels that
+# can never equal a real key or each other. Invalid rows are dropped at host
+# staging, so NO data value needs a reserved encoding — negative keys
+# included. Staging rejects |key| >= 2^62 (doubling would overflow).
+_L_PAD = 1
+_R_PAD = 3
+_KEY_LIMIT = 1 << 62
+
+
+def _bucketize(keys, rows, nsh: int, cap: int, pad_key: int, axis: str):
+    """Route (key, global row) pairs to shard ``key % nsh`` with ONE tiled
+    all_to_all. Keys arrive doubled (even); ``pad_key`` is this side's odd
+    pad sentinel (staged pad rows carry it too). Returns (received keys,
+    received rows, overflow flag); slots past a bucket's fill carry the
+    pad key."""
+    n = keys.shape[0]
+    # jnp % with a positive divisor is nonnegative for negative keys too
+    tgt = (keys % nsh).astype(jnp.int32)
+    order = jnp.argsort(tgt, stable=True)
+    tgt_s = jnp.take(tgt, order)
+    rank = jnp.arange(n) - jnp.searchsorted(tgt_s, tgt_s, side="left")
+    is_real = jnp.take(keys, order) != pad_key
+    overflow = jnp.any((rank >= cap) & is_real)
+    keys_s = jnp.take(keys, order)
+    rows_s = jnp.take(rows, order)
+    rank_c = jnp.minimum(rank, cap - 1)
+    buf_k = jnp.full((nsh, cap), pad_key, jnp.int64)
+    buf_r = jnp.zeros((nsh, cap), jnp.int64)
+    buf_k = buf_k.at[tgt_s, rank_c].set(
+        jnp.where(rank < cap, keys_s, pad_key)
+    )
+    buf_r = buf_r.at[tgt_s, rank_c].set(rows_s)
+    buf_k = lax.all_to_all(buf_k, axis, 0, 0, tiled=True)
+    buf_r = lax.all_to_all(buf_r, axis, 0, 0, tiled=True)
+    return buf_k.reshape(-1), buf_r.reshape(-1), overflow
+
+
+def _local_probe(lk, rk):
+    """Sort the received right block, binary-search the received left block.
+    Returns (r_sorted_rows-selector pieces) shared by count & materialize.
+    Pad keys are odd and per-side distinct, so they never match anything."""
+    r_order = jnp.argsort(rk, stable=True)
+    rk_s = jnp.take(rk, r_order)
+    lo = jnp.searchsorted(rk_s, lk, side="left")
+    hi = jnp.searchsorted(rk_s, lk, side="right")
+    counts = jnp.where(lk != _L_PAD, hi - lo, 0).astype(jnp.int64)
+    return r_order, lo, counts
+
+
+_COUNT_CACHE: Dict[Any, Any] = {}
+_MAT_CACHE: Dict[Any, Any] = {}
+
+
+def _count_fn(mesh, axis, nsh, cap_l, cap_r):
+    key = (mesh, axis, cap_l, cap_r)
+    got = _COUNT_CACHE.get(key)
+    if got is not None:
+        return got
+
+    def local(lk, lrow, rk, rrow):
+        lk2, _, ovf_l = _bucketize(lk, lrow, nsh, cap_l, _L_PAD, axis)
+        rk2, _, ovf_r = _bucketize(rk, rrow, nsh, cap_r, _R_PAD, axis)
+        _, _, counts = _local_probe(lk2, rk2)
+        return jnp.sum(counts)[None], (ovf_l | ovf_r)[None]
+
+    spec = P(axis)
+    fn = jax.jit(
+        shard_map(
+            local,
+            mesh=mesh,
+            in_specs=(spec, spec, spec, spec),
+            out_specs=(spec, spec),
+        )
+    )
+    _COUNT_CACHE[key] = fn
+    return fn
+
+
+def _materialize_fn(mesh, axis, nsh, cap_l, cap_r, out_cap):
+    key = (mesh, axis, cap_l, cap_r, out_cap)
+    got = _MAT_CACHE.get(key)
+    if got is not None:
+        return got
+
+    def local(lk, lrow, rk, rrow):
+        lk2, lrow2, _ = _bucketize(lk, lrow, nsh, cap_l, _L_PAD, axis)
+        rk2, rrow2, _ = _bucketize(rk, rrow, nsh, cap_r, _R_PAD, axis)
+        r_order, lo, counts = _local_probe(lk2, rk2)
+        rrow_sorted = jnp.take(rrow2, r_order)
+        off = jnp.cumsum(counts)
+        total = off[-1] if counts.shape[0] else jnp.asarray(0, jnp.int64)
+        slot = jnp.arange(out_cap, dtype=jnp.int64)
+        src = jnp.searchsorted(off, slot, side="right")
+        src_c = jnp.minimum(src, counts.shape[0] - 1)
+        within = slot - jnp.take(off - counts, src_c)
+        valid = slot < total
+        l_out = jnp.where(valid, jnp.take(lrow2, src_c), 0)
+        r_idx = jnp.take(lo, src_c) + within
+        r_out = jnp.where(
+            valid, jnp.take(rrow_sorted, jnp.minimum(r_idx, rrow_sorted.shape[0] - 1)), 0
+        )
+        return l_out, r_out, valid
+
+    spec = P(axis)
+    fn = jax.jit(
+        shard_map(
+            local,
+            mesh=mesh,
+            in_specs=(spec, spec, spec, spec),
+            out_specs=(spec, spec, spec),
+        )
+    )
+    _MAT_CACHE[key] = fn
+    return fn
+
+
+def _pad_sharded(arr_np: np.ndarray, nsh: int, fill, mesh, axis):
+    pad = (-len(arr_np)) % nsh
+    if pad:
+        arr_np = np.concatenate(
+            [arr_np, np.full(pad, fill, dtype=arr_np.dtype)]
+        )
+    return jax.device_put(arr_np, NamedSharding(mesh, P(axis)))
+
+
+def hash_repartition_join(
+    l_key, l_valid, r_key, r_valid, cap_factor: float = 2.0
+) -> Optional[Tuple[Any, Any]]:
+    """Inner equi-join row pairs over the active mesh via explicit hash
+    shuffle. ``l_key``/``r_key``: int64 device arrays (element ids); valid
+    masks may be None. Returns (left_rows, right_rows) int64 arrays of
+    matching GLOBAL row indices (compacted), or None when no multi-device
+    mesh is active or a hash bucket overflows its static capacity — the
+    caller keeps the global sort-probe join."""
+    mesh = current_mesh()
+    nsh = mesh_size()
+    if mesh is None or nsh <= 1:
+        return None
+    axis = mesh.axis_names[0]
+    n_l, n_r = int(l_key.shape[0]), int(r_key.shape[0])
+    if n_l == 0 or n_r == 0:
+        return None  # trivial; the default join handles empties cheaply
+
+    # host staging: drop invalid rows (null keys never match), double the
+    # keys into the even namespace, pad to shard multiples with odd pad
+    # sentinels. (join() depads its inputs, so the clean row sharding must
+    # be rebuilt anyway.)
+    lk_np = np.asarray(l_key, dtype=np.int64)
+    rk_np = np.asarray(r_key, dtype=np.int64)
+    lrow_np = np.arange(n_l, dtype=np.int64)
+    rrow_np = np.arange(n_r, dtype=np.int64)
+    if l_valid is not None:
+        keep = np.asarray(l_valid)
+        lk_np, lrow_np = lk_np[keep], lrow_np[keep]
+    if r_valid is not None:
+        keep = np.asarray(r_valid)
+        rk_np, rrow_np = rk_np[keep], rrow_np[keep]
+    if len(lk_np) == 0 or len(rk_np) == 0:
+        z = jnp.zeros(0, jnp.int64)
+        return z, z
+    if (
+        np.abs(lk_np).max(initial=0) >= _KEY_LIMIT
+        or np.abs(rk_np).max(initial=0) >= _KEY_LIMIT
+    ):
+        return None  # doubling would overflow int64
+    lk = _pad_sharded(lk_np * 2, nsh, _L_PAD, mesh, axis)
+    rk = _pad_sharded(rk_np * 2, nsh, _R_PAD, mesh, axis)
+    lrow = _pad_sharded(lrow_np, nsh, 0, mesh, axis)
+    rrow = _pad_sharded(rrow_np, nsh, 0, mesh, axis)
+
+    bl = int(lk.shape[0]) // nsh
+    br = int(rk.shape[0]) // nsh
+    cap_l = max(int(bl / nsh * cap_factor) + 16, 16)
+    cap_r = max(int(br / nsh * cap_factor) + 16, 16)
+
+    counts, overflow = _count_fn(mesh, axis, nsh, cap_l, cap_r)(
+        lk, lrow, rk, rrow
+    )
+    counts_np = np.asarray(counts)
+    if bool(np.asarray(overflow).any()):
+        return None  # skewed keys: fall back to the global sort-probe join
+    out_cap = int(counts_np.max()) if counts_np.size else 0
+    if out_cap == 0:
+        z = jnp.zeros(0, jnp.int64)
+        return z, z
+    l_out, r_out, valid = _materialize_fn(
+        mesh, axis, nsh, cap_l, cap_r, out_cap
+    )(lk, lrow, rk, rrow)
+    from ..backend.tpu.jit_ops import mask_nonzero, tree_take
+
+    total = int(counts_np.sum())
+    idx = mask_nonzero(valid, size=total)
+    l_rows, r_rows = tree_take((l_out, r_out), idx)
+    return l_rows, r_rows
