@@ -971,10 +971,11 @@ class CsrExpandIntoOp(_FusedExpandBase):
             return None
         a1, _, rowsum1 = got1
         a2, entry2, _ = got2
-        cm, _, _ = gotc
-        if rowsum1 * entry2 > (1 << 24):
-            # a single 2-path cell could pass f32's exact-integer range
-            # inside the matmul accumulator — keep the walk path
+        cm, entry_c, _ = gotc
+        if rowsum1 * entry2 * max(entry_c, 1) > (1 << 24):
+            # a 2-path cell (or its product with the closing multiplicity,
+            # computed in f32 BEFORE the f64 reduction) could pass f32's
+            # exact-integer range — keep the walk path
             return None
         pos, present = gi.compact_of(id_col, ctx)
         npad = int(a1.shape[0])
@@ -1185,6 +1186,30 @@ class CsrVarExpandOp(_FusedExpandBase):
             f"{self.upper}]{arrow}({self.target_fld})"
         )
 
+    def _native_varlen_count(self, rp, ci, eo, pos, present, row_map):
+        """count(*) of bounded var-length walks via the C++ DFS kernel;
+        None when unavailable (callers keep the device frontier loop)."""
+        from ... import native
+
+        if native.get_lib() is None:
+            return None
+        fr = np.asarray(pos)[np.asarray(present)]
+        rm = np.asarray(row_map)
+        mask = (rm >= 0).astype(np.uint8) if self.far_labels else None
+        total = 0
+        if self.lower == 0:
+            keep = np.ones(len(fr), bool) if mask is None else (
+                mask[fr].astype(bool)
+            )
+            total += int(keep.sum())
+        got = native.varlen_count_native(
+            np.asarray(rp), np.asarray(ci), np.asarray(eo), fr,
+            max(1, self.lower), self.upper, mask,
+        )
+        if got is None:
+            return None
+        return total + got
+
     def _fused_table(self):
         from .table import TpuTable
 
@@ -1213,6 +1238,16 @@ class CsrVarExpandOp(_FusedExpandBase):
         else:
             rp, ci, eo = gi.csr(self.types_key, False, ctx)
         _, _, row_map = gi.node_scan(self.far_labels, ctx)
+        if (
+            count_only
+            and jax.default_backend() == "cpu"
+            and current_mesh() is None
+        ):
+            # host tier: DFS with a register-resident walked-edge stack
+            # (native/csr_builder.cpp) — no per-level materialization
+            got = self._native_varlen_count(rp, ci, eo, pos, present, row_map)
+            if got is not None:
+                return TpuTable({}, got)
         row0 = None
         prev_edges: Tuple[Any, ...] = ()
         total_count = 0

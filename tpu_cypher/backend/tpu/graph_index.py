@@ -384,10 +384,10 @@ class GraphIndex:
             np.add.at(dense, (a, bb), 1)
             max_entry = int(dense.max()) if len(s) else 0
             if max_entry <= 256:
+                # int32 -> bf16 on DEVICE (entries <= 256 are bf16-exact);
+                # a host f32 staging copy would double peak host memory
                 out = (
-                    jnp.asarray(dense.astype(np.float32)).astype(
-                        jnp.bfloat16
-                    ),
+                    jnp.asarray(dense).astype(jnp.bfloat16),
                     max_entry,
                     int(dense.sum(axis=1).max()) if len(s) else 0,
                 )

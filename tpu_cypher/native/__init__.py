@@ -110,6 +110,11 @@ def get_lib() -> Optional[ctypes.CDLL]:
             p32, p32, p32, p32, p32, p32, p64, p64,
             ctypes.c_int64, ctypes.c_int64, pu8, pu8,
         ]
+        lib.varlen_count.restype = ctypes.c_int64
+        lib.varlen_count.argtypes = [
+            p32, p32, p64, p64,
+            ctypes.c_int64, ctypes.c_int64, ctypes.c_int64, pu8,
+        ]
         _lib = lib
         return _lib
 
@@ -213,6 +218,28 @@ def two_hop_close_count_native(
             _p64(fr), _p64(ak), len(fr), int(n), _pm(m1), _pm(m2),
         )
     )
+
+
+def varlen_count_native(
+    rp, ci, eo, frontier, lo, hi, far_mask
+) -> Optional[int]:
+    """Bounded var-length walk count via the DFS kernel (see
+    csr_builder.cpp); None when the native lib is unavailable or the bound
+    is out of the kernel's stack range."""
+    lib = get_lib()
+    if lib is None:
+        return None
+    rp, ci = _csr32(rp, ci)
+    eo = np.ascontiguousarray(eo, dtype=np.int64)
+    fr = np.ascontiguousarray(frontier, dtype=np.int64)
+    m = _mask_u8(far_mask)
+    got = int(
+        lib.varlen_count(
+            _p32(rp), _p32(ci), _p64(eo), _p64(fr),
+            len(fr), int(lo), int(hi), _pm(m),
+        )
+    )
+    return None if got < 0 else got
 
 
 def build_csr_native(

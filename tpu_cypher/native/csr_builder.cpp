@@ -223,4 +223,50 @@ int64_t two_hop_close_count(const int32_t* rp1, const int32_t* ci1,
     return cnt;
 }
 
+// Bounded var-length walk count with relationship-distinctness (openCypher
+// path isomorphism): iterative DFS per frontier row over the CSR, counting
+// walks of length in [lo, hi] whose far node passes the label mask. The
+// walked-edge stack holds canonical scan rows (eo) — undirected walks share
+// one scan row per relationship, so reuse checks are direction-agnostic —
+// and is at most `hi` deep, so the distinctness check is a linear scan of a
+// register-resident array. Replaces materializing every partial-walk level
+// on host backends (the device frontier loop keeps TPU/mesh paths).
+int64_t varlen_count(const int32_t* rp, const int32_t* ci, const int64_t* eo,
+                     const int64_t* frontier, int64_t nf,
+                     int64_t lo, int64_t hi, const uint8_t* far_mask) {
+    if (hi < 1 || hi > 64) return -1;  // caller falls back
+    int64_t count = 0;
+    std::vector<int64_t> estack(hi + 1);
+    std::vector<int32_t> vstack(hi + 1);
+    std::vector<int32_t> epos(hi + 1);
+    for (int64_t i = 0; i < nf; i++) {
+        int32_t s = (int32_t)frontier[i];
+        int depth = 0;
+        vstack[0] = s;
+        epos[0] = rp[s];
+        while (depth >= 0) {
+            if (epos[depth] < rp[vstack[depth] + 1]) {
+                int32_t e = epos[depth]++;
+                int64_t orig = eo[e];
+                bool dup = false;
+                for (int k = 0; k < depth; k++)
+                    if (estack[k] == orig) { dup = true; break; }
+                if (dup) continue;
+                int32_t nb = ci[e];
+                int d1 = depth + 1;
+                if (d1 >= lo && (!far_mask || far_mask[nb])) count++;
+                if (d1 < hi) {
+                    estack[depth] = orig;
+                    vstack[d1] = nb;
+                    epos[d1] = rp[nb];
+                    depth = d1;
+                }
+            } else {
+                depth--;
+            }
+        }
+    }
+    return count;
+}
+
 }  // extern "C"

@@ -61,8 +61,16 @@ def _bucketize(keys, rows, nsh: int, cap: int, pad_key: int, axis: str):
     received rows, overflow flag); slots past a bucket's fill carry the
     pad key."""
     n = keys.shape[0]
-    # jnp % with a positive divisor is nonnegative for negative keys too
-    tgt = (keys % nsh).astype(jnp.int32)
+    # bucket on the PRE-doubled value (keys ship doubled; an even key mod an
+    # even mesh size would use only half the shards) — arithmetic shift
+    # recovers the original for negatives too. Staged pad rows round-robin
+    # so they never crowd one bucket's capacity.
+    is_pad = keys == pad_key
+    tgt = jnp.where(
+        is_pad,
+        jnp.arange(n) % nsh,
+        ((keys >> 1) % nsh),
+    ).astype(jnp.int32)
     order = jnp.argsort(tgt, stable=True)
     tgt_s = jnp.take(tgt, order)
     rank = jnp.arange(n) - jnp.searchsorted(tgt_s, tgt_s, side="left")
