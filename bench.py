@@ -200,15 +200,8 @@ def _time_query(g, query, params=None, repeats=3):
     return float(np.median(times)), out
 
 
-def run_config(
-    name: str, scale: float, session, results: dict, budget_rows: int,
-    count_only: bool = False,
-):
-    """One ladder rung: build the SNB graph, run the four shapes.
-
-    ``count_only`` runs just the fused 2-hop count (scalar sync, no row
-    materialization) — the CPU-fallback SF10 rung, so scale behavior at
-    ~4.5M edges is on record even when the chip is unreachable."""
+def run_config(name: str, scale: float, session, results: dict, budget_rows: int):
+    """One ladder rung: build the SNB graph, run the four shapes."""
     from tpu_cypher.io.ldbc import generate_snb
     from tpu_cypher.relational.session import PropertyGraph
 
@@ -227,10 +220,6 @@ def run_config(
         results["validated"] = False
     rung["seconds_two_hop"] = round(dt, 6)
     rung["expansions_per_sec"] = round(expansions / dt, 1)
-    if count_only:
-        rung["count_only"] = True
-        results["ladder"][name] = rung
-        return rung
 
     # the fused distinct path materializes one packed key per 2-hop row
     # (plus sort buffers); gate so an over-scaled run degrades to a skip
@@ -308,23 +297,16 @@ def main():
     results = {"ladder": {}, "validated": validate_against_oracle()}
 
     session = CypherSession.tpu()
-    # CPU fallback keeps the run fast and honest: full ladder at SF1 only,
-    # plus an SF10 count-only rung (fused count syncs one scalar, no row
-    # set) so >=4.5M-edge behavior is always on record
-    if tpu_ok:
-        configs = [
-            ("SF1", 1.0 * scale_mult, 20_000_000, False),
-            ("SF10", 10.0 * scale_mult, 60_000_000, False),
-        ]
-    else:
-        configs = [
-            ("SF1", 1.0 * scale_mult, 20_000_000, False),
-            ("SF10", 10.0 * scale_mult, 60_000_000, True),
-        ]
-    for name, scale, budget, count_only in configs:
-        rung = run_config(
-            name, scale, session, results, budget, count_only=count_only
-        )
+    # the full ladder runs at BOTH scales on any device: since round 4 the
+    # count shapes never materialize their row sets (native stamping / DFS
+    # kernels on host, fused walks + MXU matmuls on TPU), so SF10
+    # (~100k persons / ~4.5M KNOWS) costs under a second per shape on CPU
+    configs = [
+        ("SF1", 1.0 * scale_mult, 20_000_000),
+        ("SF10", 10.0 * scale_mult, 60_000_000),
+    ]
+    for name, scale, budget in configs:
+        rung = run_config(name, scale, session, results, budget)
         headline, headline_name = rung, name  # last rung wins
 
     rate = headline["expansions_per_sec"]

@@ -1,0 +1,127 @@
+Feature: MathFunctions2
+
+  Scenario: abs sign ceil floor round over mixed numbers
+    Given an empty graph
+    When executing query:
+      """
+      RETURN abs(-3) AS a, sign(-2.5) AS s, ceil(1.2) AS c,
+             floor(-1.2) AS f, round(2.5) AS r
+      """
+    Then the result should be, in any order:
+      | a | s  | c   | f    | r   |
+      | 3 | -1 | 2.0 | -2.0 | 3.0 |
+    And no side effects
+
+  Scenario: sqrt exp log compose
+    Given an empty graph
+    When executing query:
+      """
+      RETURN sqrt(16) AS q, exp(0) AS e, log(e()) AS l
+      """
+    Then the result should be, in any order:
+      | q   | e   | l   |
+      | 4.0 | 1.0 | 1.0 |
+    And no side effects
+
+  Scenario: Integer division truncates toward zero
+    Given an empty graph
+    When executing query:
+      """
+      RETURN 7 / 2 AS a, -7 / 2 AS b, 7 % 3 AS c, -7 % 3 AS d
+      """
+    Then the result should be, in any order:
+      | a | b  | c | d  |
+      | 3 | -3 | 1 | -1 |
+    And no side effects
+
+  Scenario: Float division keeps fractions
+    Given an empty graph
+    When executing query:
+      """
+      RETURN 7.0 / 2 AS a, 1 / 4.0 AS b
+      """
+    Then the result should be, in any order:
+      | a   | b    |
+      | 3.5 | 0.25 |
+    And no side effects
+
+  Scenario: Integer division and modulo by zero are null
+    Given an empty graph
+    When executing query:
+      """
+      RETURN 1 / 0 AS a, 7 % 0 AS b
+      """
+    Then the result should be, in any order:
+      | a    | b    |
+      | null | null |
+    And no side effects
+
+  Scenario: Float division by zero gives infinities
+    Given an empty graph
+    When executing query:
+      """
+      RETURN 1.0 / 0.0 AS pos, -1.0 / 0.0 AS neg
+      """
+    Then the result should be, in any order:
+      | pos | neg  |
+      | Inf | -Inf |
+    And no side effects
+
+  Scenario: Power operator crosses int and float
+    Given an empty graph
+    When executing query:
+      """
+      RETURN 2 ^ 10 AS a, 4 ^ 0.5 AS b
+      """
+    Then the result should be, in any order:
+      | a      | b   |
+      | 1024.0 | 2.0 |
+    And no side effects
+
+  Scenario: Trigonometry round trip
+    Given an empty graph
+    When executing query:
+      """
+      RETURN round(degrees(radians(90))) AS d, round(sin(0)) AS s
+      """
+    Then the result should be, in any order:
+      | d    | s   |
+      | 90.0 | 0.0 |
+    And no side effects
+
+  Scenario: Math functions propagate null
+    Given an empty graph
+    When executing query:
+      """
+      RETURN abs(null) AS a, sqrt(null) AS b, round(null) AS c
+      """
+    Then the result should be, in any order:
+      | a    | b    | c    |
+      | null | null | null |
+    And no side effects
+
+  Scenario: Arithmetic precedence follows convention
+    Given an empty graph
+    When executing query:
+      """
+      RETURN 2 + 3 * 4 AS a, (2 + 3) * 4 AS b, -2 ^ 2 AS c
+      """
+    Then the result should be, in any order:
+      | a  | b  | c    |
+      | 14 | 20 | -4.0 |
+    And no side effects
+
+  Scenario: Aggregating computed math stays numeric
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:N {v: 3}), (:N {v: -4})
+      """
+    When executing query:
+      """
+      MATCH (n:N) RETURN sum(abs(n.v)) AS s, max(n.v * n.v) AS m
+      """
+    Then the result should be, in any order:
+      | s | m  |
+      | 7 | 16 |
+    And no side effects

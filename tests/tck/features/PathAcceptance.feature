@@ -1,0 +1,130 @@
+Feature: PathAcceptance
+
+  Scenario: Path length counts relationships
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:A {n: 1})-[:R]->(:B {n: 2})-[:R]->(:C {n: 3})
+      """
+    When executing query:
+      """
+      MATCH p = (:A)-[:R]->()-[:R]->() RETURN length(p) AS l
+      """
+    Then the result should be, in any order:
+      | l |
+      | 2 |
+    And no side effects
+
+  Scenario: nodes of a path in traversal order
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:A {n: 1})-[:R]->(:B {n: 2})-[:R]->(:C {n: 3})
+      """
+    When executing query:
+      """
+      MATCH p = (:A)-[:R]->()-[:R]->()
+      UNWIND nodes(p) AS x RETURN x.n AS n
+      """
+    Then the result should be, in order:
+      | n |
+      | 1 |
+      | 2 |
+      | 3 |
+    And no side effects
+
+  Scenario: relationships of a path in traversal order
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:A)-[:R {i: 1}]->(:B)-[:S {i: 2}]->(:C)
+      """
+    When executing query:
+      """
+      MATCH p = (:A)-->()-->()
+      UNWIND relationships(p) AS r RETURN type(r) AS t, r.i AS i
+      """
+    Then the result should be, in order:
+      | t   | i |
+      | 'R' | 1 |
+      | 'S' | 2 |
+    And no side effects
+
+  Scenario: Zero-relationship path has length zero
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:A {n: 1})
+      """
+    When executing query:
+      """
+      MATCH p = (a:A) RETURN length(p) AS l, size(nodes(p)) AS ns
+      """
+    Then the result should be, in any order:
+      | l | ns |
+      | 0 | 1  |
+    And no side effects
+
+  Scenario: Path over a backwards pattern keeps traversal order
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:A {n: 1})-[:R]->(:B {n: 2})
+      """
+    When executing query:
+      """
+      MATCH p = (b:B)<-[:R]-(a:A)
+      UNWIND nodes(p) AS x RETURN x.n AS n
+      """
+    Then the result should be, in order:
+      | n |
+      | 2 |
+      | 1 |
+    And no side effects
+
+  Scenario: Var-length path lengths vary per row
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:A {n: 1})-[:R]->(:B {n: 2})-[:R]->(:C {n: 3})
+      """
+    When executing query:
+      """
+      MATCH p = (:A)-[:R*1..2]->() RETURN length(p) AS l ORDER BY l
+      """
+    Then the result should be, in order:
+      | l |
+      | 1 |
+      | 2 |
+    And no side effects
+
+  Scenario: Paths compare and count as values
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:A)-[:R]->(:B), (:A)-[:R]->(:B)
+      """
+    When executing query:
+      """
+      MATCH p = (:A)-[:R]->(:B) RETURN count(p) AS c
+      """
+    Then the result should be, in any order:
+      | c |
+      | 2 |
+    And no side effects
+
+  Scenario: Named path through a shared middle node
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:X {n: 1})-[:K]->(m:M {n: 9}), (:X {n: 2})-[:K]->(m)
+      """
+    When executing query:
+      """
+      MATCH p = (x:X)-[:K]->(:M)
+      RETURN size(nodes(p)) AS ns, size(relationships(p)) AS rs, count(*) AS c
+      """
+    Then the result should be, in any order:
+      | ns | rs | c |
+      | 2  | 1  | 2 |
+    And no side effects

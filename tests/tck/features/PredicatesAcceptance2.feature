@@ -1,0 +1,156 @@
+Feature: PredicatesAcceptance2
+
+  Scenario: exists with a full two-node pattern
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:P {n: 1})-[:K]->(:Q), (:P {n: 2})-[:L]->(:Q), (:P {n: 3})
+      """
+    When executing query:
+      """
+      MATCH (p:P) WHERE exists((p)-[:K]->(:Q)) RETURN p.n AS n
+      """
+    Then the result should be, in any order:
+      | n |
+      | 1 |
+    And no side effects
+
+  Scenario: exists on a property versus IS NOT NULL
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:P {n: 1, extra: 'x'}), (:P {n: 2})
+      """
+    When executing query:
+      """
+      MATCH (p:P) WHERE exists(p.extra) RETURN p.n AS n
+      """
+    Then the result should be, in any order:
+      | n |
+      | 1 |
+    And no side effects
+
+  Scenario: Pattern predicate between two bound nodes
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:A {n: 1})-[:K]->(:B {m: 1}), (:A {n: 2}), (:B {m: 2})
+      """
+    When executing query:
+      """
+      MATCH (a:A), (b:B) WHERE exists((a)-[:K]->(b))
+      RETURN a.n AS an, b.m AS bm
+      """
+    Then the result should be, in any order:
+      | an | bm |
+      | 1  | 1  |
+    And no side effects
+
+  Scenario: IN over a parameter list
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:P {n: 1}), (:P {n: 2}), (:P {n: 3})
+      """
+    And parameters are:
+      | wanted | [1, 3] |
+    When executing query:
+      """
+      MATCH (p:P) WHERE p.n IN $wanted RETURN p.n AS n ORDER BY n
+      """
+    Then the result should be, in order:
+      | n |
+      | 1 |
+      | 3 |
+    And no side effects
+
+  Scenario: Range predicates combine with AND
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:P {n: 1}), (:P {n: 5}), (:P {n: 9})
+      """
+    When executing query:
+      """
+      MATCH (p:P) WHERE 2 <= p.n AND p.n <= 8 RETURN p.n AS n
+      """
+    Then the result should be, in any order:
+      | n |
+      | 5 |
+    And no side effects
+
+  Scenario: String inequality filters lexicographically
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:P {s: 'apple'}), (:P {s: 'mango'}), (:P {s: 'zebra'})
+      """
+    When executing query:
+      """
+      MATCH (p:P) WHERE p.s > 'banana' RETURN p.s AS s ORDER BY s
+      """
+    Then the result should be, in order:
+      | s       |
+      | 'mango' |
+      | 'zebra' |
+    And no side effects
+
+  Scenario: Negated IN keeps nulls out
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:P {n: 1}), (:P {n: 2}), (:P)
+      """
+    When executing query:
+      """
+      MATCH (p:P) WHERE NOT p.n IN [2] RETURN p.n AS n
+      """
+    Then the result should be, in any order:
+      | n |
+      | 1 |
+    And no side effects
+
+  Scenario: Boolean property used directly as a predicate
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:P {n: 1, ok: true}), (:P {n: 2, ok: false}), (:P {n: 3})
+      """
+    When executing query:
+      """
+      MATCH (p:P) WHERE p.ok RETURN p.n AS n
+      """
+    Then the result should be, in any order:
+      | n |
+      | 1 |
+    And no side effects
+
+  Scenario: Comparing a property to a computed expression
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:P {a: 4, b: 2}), (:P {a: 3, b: 3}), (:P {a: 1, b: 5})
+      """
+    When executing query:
+      """
+      MATCH (p:P) WHERE p.a > p.b + 1 RETURN p.a AS a
+      """
+    Then the result should be, in any order:
+      | a |
+      | 4 |
+    And no side effects
+
+  Scenario: Label predicate in WHERE position
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:X:Extra {n: 1}), (:X {n: 2})
+      """
+    When executing query:
+      """
+      MATCH (x:X) WHERE x:Extra RETURN x.n AS n
+      """
+    Then the result should be, in any order:
+      | n |
+      | 1 |
+    And no side effects

@@ -75,13 +75,15 @@ def test_negative_keys_join_correctly():
     assert got_c == _ground_truth(lk, [True] * 5, rk, [True] * 5)
 
 
-def test_even_keys_use_all_shards():
-    """Bucketing must happen on the PRE-doubled key: all-even inputs on an
-    even-sized mesh previously landed on half the shards and overflowed
-    (round-4 review finding). Unique even keys across a large range must
-    join without tripping the capacity fallback."""
+@pytest.mark.parametrize("stride", [2, 4, 8, 7])
+def test_strided_keys_use_all_shards(stride):
+    """Bucket assignment mixes the key (splitmix64) before the modulo:
+    strided id namespaces (multiples of the mesh size included) must spread
+    over every shard instead of concentrating and tripping the capacity
+    fallback (round-4 review findings: first the doubled-key collapse, then
+    the general stride class)."""
     n = 4096
-    keys = np.arange(n, dtype=np.int64) * 2
+    keys = np.arange(n, dtype=np.int64) * stride
     with use_mesh(make_row_mesh()):
         got = SH.hash_repartition_join(
             jnp.asarray(keys), None, jnp.asarray(keys), None

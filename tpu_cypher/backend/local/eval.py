@@ -456,7 +456,10 @@ class Evaluator:
             if op == "Divide":
                 if isinstance(l, int) and isinstance(rr, int):
                     if rr == 0:
-                        raise CypherTypeError("/ by zero")
+                        # reference semantics: the engines' SQL division by
+                        # zero is NULL, not an error (Spark/Flink; the TPU
+                        # backend's masked device division agrees)
+                        return None
                     q = abs(l) // abs(rr)
                     return q if (l >= 0) == (rr >= 0) else -q
                 return l / rr if rr != 0 else (
@@ -465,7 +468,7 @@ class Evaluator:
             if op == "Modulo":
                 if rr == 0:
                     if isinstance(l, int) and isinstance(rr, int):
-                        raise CypherTypeError("% by zero")
+                        return None  # reference SQL semantics: NULL
                     return float("nan")
                 return math.fmod(l, rr) if isinstance(l, float) or isinstance(rr, float) else int(math.fmod(l, rr))
             if op == "Pow":

@@ -54,39 +54,52 @@ _R_PAD = 3
 _KEY_LIMIT = 1 << 62
 
 
+def _mix64(k):
+    """splitmix64 finalizer over wrapping uint64 arithmetic: equal keys mix
+    equal, and ANY structured key pattern (strided id namespaces, even-only
+    ids, graph-tag high bits) spreads uniformly over the shards — a plain
+    ``key % nsh`` concentrates every stride that shares a factor with the
+    mesh size."""
+    k = k.astype(jnp.uint64)
+    k = (k ^ (k >> jnp.uint64(30))) * jnp.uint64(0xBF58476D1CE4E5B9)
+    k = (k ^ (k >> jnp.uint64(27))) * jnp.uint64(0x94D049BB133111EB)
+    return k ^ (k >> jnp.uint64(31))
+
+
 def _bucketize(keys, rows, nsh: int, cap: int, pad_key: int, axis: str):
-    """Route (key, global row) pairs to shard ``key % nsh`` with ONE tiled
-    all_to_all. Keys arrive doubled (even); ``pad_key`` is this side's odd
-    pad sentinel (staged pad rows carry it too). Returns (received keys,
-    received rows, overflow flag); slots past a bucket's fill carry the
-    pad key."""
+    """Route (key, global row) pairs to shard ``mix(key) % nsh`` with ONE
+    tiled all_to_all. Keys arrive doubled (even); ``pad_key`` is this
+    side's odd pad sentinel (staged pad rows carry it too). Returns
+    (received keys, received rows, overflow flag); slots past a bucket's
+    fill carry the pad key. Pads and overflowing rows scatter into a
+    per-bucket SPILL slot that is sliced off before the exchange, so they
+    can never overwrite a real row."""
     n = keys.shape[0]
-    # bucket on the PRE-doubled value (keys ship doubled; an even key mod an
-    # even mesh size would use only half the shards) — arithmetic shift
-    # recovers the original for negatives too. Staged pad rows round-robin
-    # so they never crowd one bucket's capacity.
+    # bucket on the PRE-doubled value (arithmetic shift recovers the
+    # original, negatives included), mixed so strided key sets spread
     is_pad = keys == pad_key
     tgt = jnp.where(
         is_pad,
-        jnp.arange(n) % nsh,
-        ((keys >> 1) % nsh),
+        (jnp.arange(n) % nsh).astype(jnp.uint64),
+        _mix64(keys >> 1) % jnp.uint64(nsh),
     ).astype(jnp.int32)
     order = jnp.argsort(tgt, stable=True)
     tgt_s = jnp.take(tgt, order)
     rank = jnp.arange(n) - jnp.searchsorted(tgt_s, tgt_s, side="left")
-    is_real = jnp.take(keys, order) != pad_key
+    is_real = ~jnp.take(is_pad, order)
     overflow = jnp.any((rank >= cap) & is_real)
     keys_s = jnp.take(keys, order)
     rows_s = jnp.take(rows, order)
-    rank_c = jnp.minimum(rank, cap - 1)
-    buf_k = jnp.full((nsh, cap), pad_key, jnp.int64)
-    buf_r = jnp.zeros((nsh, cap), jnp.int64)
+    # pads and past-capacity rows land in the spill slot (index cap)
+    rank_c = jnp.where(is_real, jnp.minimum(rank, cap), cap)
+    buf_k = jnp.full((nsh, cap + 1), pad_key, jnp.int64)
+    buf_r = jnp.zeros((nsh, cap + 1), jnp.int64)
     buf_k = buf_k.at[tgt_s, rank_c].set(
-        jnp.where(rank < cap, keys_s, pad_key)
+        jnp.where(rank_c < cap, keys_s, pad_key)
     )
     buf_r = buf_r.at[tgt_s, rank_c].set(rows_s)
-    buf_k = lax.all_to_all(buf_k, axis, 0, 0, tiled=True)
-    buf_r = lax.all_to_all(buf_r, axis, 0, 0, tiled=True)
+    buf_k = lax.all_to_all(buf_k[:, :cap], axis, 0, 0, tiled=True)
+    buf_r = lax.all_to_all(buf_r[:, :cap], axis, 0, 0, tiled=True)
     return buf_k.reshape(-1), buf_r.reshape(-1), overflow
 
 
