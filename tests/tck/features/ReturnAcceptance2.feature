@@ -1,0 +1,169 @@
+Feature: ReturnAcceptance2
+
+  Scenario: RETURN DISTINCT dedups projected rows
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:N {g: 1, v: 'a'}), (:N {g: 1, v: 'a'}), (:N {g: 2, v: 'a'})
+      """
+    When executing query:
+      """
+      MATCH (n:N) RETURN DISTINCT n.g AS g, n.v AS v ORDER BY g
+      """
+    Then the result should be, in order:
+      | g | v   |
+      | 1 | 'a' |
+      | 2 | 'a' |
+    And no side effects
+
+  Scenario: RETURN star exposes every bound variable
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:A {n: 1})-[:R {w: 5}]->(:B {m: 2})
+      """
+    When executing query:
+      """
+      MATCH (a:A)-[r:R]->(b:B) RETURN *
+      """
+    Then the result should be, in any order:
+      | a            | r            | b            |
+      | (:A {n: 1})  | [:R {w: 5}]  | (:B {m: 2})  |
+    And no side effects
+
+  Scenario: An alias can be reused inside the same RETURN
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:N {v: 3})
+      """
+    When executing query:
+      """
+      MATCH (n:N) WITH n.v AS v RETURN v, v * 2 AS double
+      """
+    Then the result should be, in any order:
+      | v | double |
+      | 3 | 6      |
+    And no side effects
+
+  Scenario: Returning nodes and relationships as values
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:Solo {tag: 'x'})
+      """
+    When executing query:
+      """
+      MATCH (s:Solo) RETURN s
+      """
+    Then the result should be, in any order:
+      | s                   |
+      | (:Solo {tag: 'x'})  |
+    And no side effects
+
+  Scenario: Expressions over aggregates in RETURN
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:N {v: 1}), (:N {v: 2}), (:N {v: 3})
+      """
+    When executing query:
+      """
+      MATCH (n:N) RETURN count(*) * 10 AS c, max(n.v) - min(n.v) AS spread
+      """
+    Then the result should be, in any order:
+      | c  | spread |
+      | 30 | 2      |
+    And no side effects
+
+  Scenario: RETURN a literal map built from variables
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:N {v: 7})
+      """
+    When executing query:
+      """
+      MATCH (n:N) RETURN {value: n.v, twice: n.v * 2} AS m
+      """
+    Then the result should be, in any order:
+      | m                     |
+      | {value: 7, twice: 14} |
+    And no side effects
+
+  Scenario: RETURN a list built from variables
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:N {a: 1, b: 2})
+      """
+    When executing query:
+      """
+      MATCH (n:N) RETURN [n.a, n.b, n.a + n.b] AS l
+      """
+    Then the result should be, in any order:
+      | l         |
+      | [1, 2, 3] |
+    And no side effects
+
+  Scenario: DISTINCT interacts with ORDER BY and LIMIT
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:N {v: 3}), (:N {v: 1}), (:N {v: 3}), (:N {v: 2})
+      """
+    When executing query:
+      """
+      MATCH (n:N) RETURN DISTINCT n.v AS v ORDER BY v DESC LIMIT 2
+      """
+    Then the result should be, in order:
+      | v |
+      | 3 |
+      | 2 |
+    And no side effects
+
+  Scenario: Column order follows the RETURN clause
+    Given an empty graph
+    When executing query:
+      """
+      RETURN 1 AS first, 2 AS second, 3 AS third
+      """
+    Then the result should be, in any order:
+      | first | second | third |
+      | 1     | 2      | 3     |
+    And no side effects
+
+  Scenario: Duplicate column aliases are an error
+    Given an empty graph
+    When executing query:
+      """
+      RETURN 1 AS x, 2 AS x
+      """
+    Then a SyntaxError should be raised at compile time: ColumnNameConflict
+    And no side effects
+
+  Scenario: RETURN without MATCH evaluates once
+    Given an empty graph
+    When executing query:
+      """
+      RETURN 1 + 1 AS two, 'a' + 'b' AS ab
+      """
+    Then the result should be, in any order:
+      | two | ab   |
+      | 2   | 'ab' |
+    And no side effects
+
+  Scenario: Aggregate of an empty match via WHERE false
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:N {v: 1})
+      """
+    When executing query:
+      """
+      MATCH (n:N) WHERE false RETURN count(n) AS c, collect(n.v) AS l
+      """
+    Then the result should be, in any order:
+      | c | l  |
+      | 0 | [] |
+    And no side effects

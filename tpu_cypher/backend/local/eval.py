@@ -447,7 +447,7 @@ class Evaluator:
                 return _scale_duration(rr, l)
             if op == "Divide" and isinstance(l, Duration) and _num(rr):
                 if rr == 0:
-                    raise CypherTypeError("/ by zero")
+                    return None  # same NULL-on-zero contract as numeric /
                 return _scale_duration(l, 1.0 / rr)
             if not (_num(l) and _num(rr)):
                 raise CypherTypeError(f"Numeric operator {op} on non-numbers")
