@@ -67,11 +67,27 @@ class Evaluator:
 
                 return path_materializer(self.header, expr)
             mat = expr.cypher_type.material
-            if isinstance(mat, T.CTNodeType):
-                return self._element_fn(expr, node=True)
-            if isinstance(mat, T.CTRelationshipType):
-                return self._element_fn(expr, node=False)
             key = "\x00local:" + expr.name
+            if isinstance(mat, (T.CTNodeType, T.CTRelationshipType)):
+                # comprehension/quantifier locals shadow pattern variables
+                # (lexical scoping); an element var with no header columns
+                # can ONLY be such a local (e.g. the rel-isomorphism
+                # ``none(x IN rs WHERE ...)`` predicates)
+                try:
+                    elem = self._element_fn(expr, node=isinstance(mat, T.CTNodeType))
+                except KeyError:
+                    elem = None
+
+                def _elem_or_local(r, k=key, f=elem, name=expr.name):
+                    if k in r:
+                        return r[k]
+                    if f is None:
+                        raise EvalError(
+                            f"Unbound variable {name!r} during evaluation"
+                        )
+                    return f(r)
+
+                return _elem_or_local
 
             def _local(r, k=key, name=expr.name):
                 if k in r:

@@ -1167,6 +1167,7 @@ class CsrVarExpandOp(_FusedExpandBase):
         upper: int,
         far_labels: Tuple[str, ...],
         undirected: bool = False,
+        enforced_pairs: Tuple[Tuple[str, str], ...] = (),
     ):
         super().__init__(in_plan, classic, graph_obj)
         self.source_fld = source_fld
@@ -1177,23 +1178,75 @@ class CsrVarExpandOp(_FusedExpandBase):
         self.upper = upper
         self.far_labels = far_labels
         self.undirected = undirected
+        # (rel_fld, fixed_rel) pairs: the walk must avoid the fixed rel's
+        # edge — ``none(x IN rel_fld WHERE id(x) = id(fixed))`` enforced
+        # in-kernel as an initial forbidden entry of the walked-edge masks
+        self.enforced_pairs = enforced_pairs
+
+    def _ctor_kwargs(self) -> Dict[str, Any]:
+        return dict(
+            source_fld=self.source_fld,
+            rel_fld=self.rel_fld,
+            target_fld=self.target_fld,
+            types_key=self.types_key,
+            lower=self.lower,
+            upper=self.upper,
+            far_labels=self.far_labels,
+            undirected=self.undirected,
+            enforced_pairs=self.enforced_pairs,
+        )
 
     def _show_inner(self) -> str:
         t = "|".join(self.types_key) or "*"
         arrow = "-" if self.undirected else "->"
+        uniq = (
+            " uniq" + ",".join(f"({a}<>{b})" for a, b in self.enforced_pairs)
+            if self.enforced_pairs
+            else ""
+        )
         return (
             f"({self.source_fld})-[{self.rel_fld}:{t}*{self.lower}.."
-            f"{self.upper}]{arrow}({self.target_fld})"
+            f"{self.upper}]{arrow}({self.target_fld}){uniq}"
         )
 
-    def _native_varlen_count(self, rp, ci, eo, pos, present, row_map):
+    def _forbid_arrays(self, gi: GraphIndex, ctx):
+        """Per-input-row forbidden canonical scan rows (one int64 array per
+        enforced pair, -1 = unconstrained): fixed-rel global ids from the
+        input table, bridged into this walk's scan-row space. Seeding the
+        frontier loop's ``prev_edges`` with these arrays makes the existing
+        walked-edge masks enforce the fixed-vs-var-length isomorphism with
+        zero new kernel code."""
+        if not self.enforced_pairs:
+            return ()
+        in_op = self.children[0]
+        in_t = in_op.table
+        h = in_op.header
+        sorted_ids, perm = gi.rel_row_index(self.types_key, ctx)
+        out = []
+        for ra, rb in self.enforced_pairs:
+            other = rb if ra == self.rel_fld else ra
+            if other == self.rel_fld:
+                raise GraphIndexError("forbid pair does not name a fixed rel")
+            try:
+                col = in_t._cols[h.column(h.id_expr(h.var(other)))]
+            except (KeyError, ValueError) as exc:
+                raise GraphIndexError(
+                    f"uniqueness rel {other!r} unmapped"
+                ) from exc
+            if col.kind == OBJ:
+                raise GraphIndexError("host id column in forbid pair")
+            out.append(J.rel_rows_of_ids(sorted_ids, perm, col.data, col.valid))
+        return tuple(out)
+
+    def _native_varlen_count(self, rp, ci, eo, pos, present, row_map, forbid):
         """count(*) of bounded var-length walks via the C++ DFS kernel;
         None when unavailable (callers keep the device frontier loop)."""
         from ... import native
 
         if native.get_lib() is None:
             return None
-        fr = np.asarray(pos)[np.asarray(present)]
+        pres = np.asarray(present)
+        fr = np.asarray(pos)[pres]
         rm = np.asarray(row_map)
         mask = (rm >= 0).astype(np.uint8) if self.far_labels else None
         total = 0
@@ -1202,9 +1255,16 @@ class CsrVarExpandOp(_FusedExpandBase):
                 mask[fr].astype(bool)
             )
             total += int(keep.sum())
+        fb = (
+            np.ascontiguousarray(
+                np.stack([np.asarray(f)[pres] for f in forbid], axis=1)
+            )
+            if forbid
+            else None
+        )
         got = native.varlen_count_native(
             np.asarray(rp), np.asarray(ci), np.asarray(eo), fr,
-            max(1, self.lower), self.upper, mask,
+            max(1, self.lower), self.upper, mask, fb,
         )
         if got is None:
             return None
@@ -1238,6 +1298,7 @@ class CsrVarExpandOp(_FusedExpandBase):
         else:
             rp, ci, eo = gi.csr(self.types_key, False, ctx)
         _, _, row_map = gi.node_scan(self.far_labels, ctx)
+        forbid = self._forbid_arrays(gi, ctx)
         if (
             count_only
             and jax.default_backend() == "cpu"
@@ -1245,11 +1306,16 @@ class CsrVarExpandOp(_FusedExpandBase):
         ):
             # host tier: DFS with a register-resident walked-edge stack
             # (native/csr_builder.cpp) — no per-level materialization
-            got = self._native_varlen_count(rp, ci, eo, pos, present, row_map)
+            got = self._native_varlen_count(
+                rp, ci, eo, pos, present, row_map, forbid
+            )
             if got is not None:
                 return TpuTable({}, got)
         row0 = None
-        prev_edges: Tuple[Any, ...] = ()
+        # forbidden edges seed the walked-edge masks: the loop's existing
+        # ``orig != prev`` checks then enforce fixed-vs-var-length
+        # relationship isomorphism with no extra kernel
+        prev_edges: Tuple[Any, ...] = forbid
         total_count = 0
         levels: List[Tuple[Any, Any]] = []
         if self.lower == 0:
@@ -1479,6 +1545,45 @@ def _rel_neq_pair(pred) -> Optional[Tuple[str, str]]:
     return lv.name, rv.name
 
 
+def _rel_list_none_pair(pred) -> Optional[Tuple[str, str]]:
+    """Recognize the fixed-vs-var-length isomorphism predicate
+    ``none(x IN rs WHERE id(x) = id(r))`` (the shape ``ir.builder`` emits
+    for a var-length rel list ``rs`` vs a fixed rel ``r``); returns
+    (list_var, fixed_var) or None."""
+    from ...api import types as T
+
+    if not isinstance(pred, E.Quantified) or pred.kind != "none":
+        return None
+    lst = pred.list_expr
+    if not isinstance(lst, E.Var):
+        return None
+    lt = getattr(lst, "cypher_type", None)
+    if lt is None or not isinstance(lt.material, T.CTListType):
+        return None
+    if not isinstance(lt.material.inner.material, T.CTRelationshipType):
+        return None
+    eq = pred.predicate
+    if not isinstance(eq, E.Equals):
+        return None
+    l, r = eq.lhs, eq.rhs
+    if not (isinstance(l, E.Id) and isinstance(r, E.Id)):
+        return None
+    lv, rv = l.expr, r.expr
+    if not (isinstance(lv, E.Var) and isinstance(rv, E.Var)):
+        return None
+    names = {lv.name, rv.name}
+    if pred.var.name not in names:
+        return None
+    (other,) = names - {pred.var.name} if len(names) == 2 else (None,)
+    if other is None:
+        return None
+    for v in (lv, rv):
+        t = getattr(v, "cypher_type", None)
+        if t is None or not isinstance(t.material, T.CTRelationshipType):
+            return None
+    return lst.name, other
+
+
 def _graph_loop_free(graph_obj, types_key, ctx) -> bool:
     """True when no relationship of the type set is a self-loop (host-cached
     on the GraphIndex)."""
@@ -1572,7 +1677,8 @@ def plan_filter_fastpath(planner, op, child) -> Optional[RelationalOperator]:
     from ...relational.ops import CacheOp
 
     pair = _rel_neq_pair(op.predicate)
-    if pair is None:
+    list_pair = _rel_list_none_pair(op.predicate) if pair is None else None
+    if pair is None and list_pair is None:
         return None
     wraps = 0
     node = child
@@ -1584,6 +1690,21 @@ def plan_filter_fastpath(planner, op, child) -> Optional[RelationalOperator]:
         for _ in range(wraps):
             n = CacheOp(n)
         return n
+
+    if list_pair is not None:
+        # fixed-vs-var-length isomorphism: push the fixed rel into the fused
+        # walk as a forbidden edge (seeded walked-edge mask); the classic
+        # shadow keeps the quantified predicate as a literal FilterOp
+        rs, r = list_pair
+        if not isinstance(node, CsrVarExpandOp) or node.rel_fld != rs:
+            return None
+        in_vars = {v.name for v in node.children[0].header.vars}
+        if r not in in_vars or r == node.rel_fld:
+            return None
+        key = tuple(sorted((rs, r)))
+        if key in node.enforced_pairs:
+            return child  # duplicate predicate: already enforced below
+        return rewrap(node._with_pair(key, op.predicate))
 
     if isinstance(node, CsrExpandIntoOp) and not node.undirected:
         in_op = node.children[0]

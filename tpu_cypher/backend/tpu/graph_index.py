@@ -124,6 +124,9 @@ class GraphIndex:
         self._label_mask: Dict[Tuple[str, ...], Optional[Any]] = {}
         # labels_key -> host row_map copy (mask building without a D2H sync)
         self._row_map_np: Dict[Tuple[str, ...], np.ndarray] = {}
+        # types_key -> (sorted global ids, scan-row perm) device arrays:
+        # global-rel-id -> canonical scan row (isomorphism forbid masks)
+        self._rel_id_index: Dict[Tuple[str, ...], Tuple[Any, Any]] = {}
 
     # -- nodes -------------------------------------------------------------
 
@@ -207,6 +210,24 @@ class GraphIndex:
         self._rel_scans[types_key] = out
         self._rel_sizes[types_key] = op.table.size
         return out
+
+    def rel_row_index(self, types_key: Tuple[str, ...], ctx):
+        """(sorted int64 global ids, int64 canonical-scan-row perm) device
+        arrays: binary-search bridge from relationship element ids to the
+        rows that ``csr``'s ``edge_orig`` walks carry — how a fixed rel
+        bound in the input becomes a forbidden edge inside a fused
+        var-length walk (reference ``VarLengthExpandPlanner.scala:96``
+        filters var-length steps against in-scope rel elements)."""
+        got = self._rel_id_index.get(types_key)
+        if got is None:
+            cols, header = self.rel_scan(types_key, ctx)
+            n = self._rel_sizes[types_key]
+            id_col = cols[header.column(header.id_expr(header.var(CANON_REL)))]
+            ids = _host_logical(id_col, n)
+            order = np.argsort(ids, kind="stable").astype(np.int64)
+            got = (jnp.asarray(ids[order]), jnp.asarray(order))
+            self._rel_id_index[types_key] = got
+        return got
 
     def _edge_endpoints(self, types_key: Tuple[str, ...], ctx):
         """Resolve one type set's relationships to compact endpoint
@@ -367,7 +388,7 @@ class GraphIndex:
         f32 accumulator), or None when the graph is too large for the
         dense form (Npad^2 bf16 per matrix) or a multiplicity exceeds
         bf16's exact-integer range (256). Rows/cols past N are zero."""
-        key = (types_key, reverse)
+        key = (types_key, reverse, max_nodes)
         if key not in self._dense_adj:
             self.node_ids(ctx)
             n = self.num_nodes

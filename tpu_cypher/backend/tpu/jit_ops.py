@@ -549,6 +549,22 @@ def path_count_chain_on_mesh(mesh, axis: str):
 # ---------------------------------------------------------------------------
 
 
+@jax.jit
+def rel_rows_of_ids(sorted_ids, perm, q, valid):
+    """Canonical rel-scan row per queried global relationship id, or -1
+    when the id is not in the scan (or the query row is null). Binary
+    search over the id-sorted permutation (``GraphIndex.rel_row_index``) —
+    the id-space bridge for relationship-isomorphism forbid masks."""
+    n = sorted_ids.shape[0]
+    if n == 0:
+        return jnp.full(q.shape, -1, jnp.int64)
+    i = jnp.clip(jnp.searchsorted(sorted_ids, q), 0, n - 1)
+    hit = jnp.take(sorted_ids, i) == q
+    if valid is not None:
+        hit = hit & valid
+    return jnp.where(hit, jnp.take(perm, i), jnp.int64(-1))
+
+
 @partial(jax.jit, static_argnames=("total",))
 def varlen_hop(rp, ci, eo, pos, deg, row0, prev_edges, total: int):
     """One hop of a var-length expansion. State per partial path: origin

@@ -341,27 +341,82 @@ class IRBuilder:
                     )
                 ir.paths[part.path_var] = tuple(path_fields)
         if rel_uniqueness:
-            fixed = [
-                r for r, conn in ir.topology.items() if not conn.is_var_length
-            ]
-            for i in range(len(fixed)):
-                for j in range(i + 1, len(fixed)):
-                    r1, r2 = fixed[i], fixed[j]
-                    t1 = ir.rel_types[r1].types or None  # None/empty = any
-                    t2 = ir.rel_types[r2].types or None
-                    if t1 is not None and t2 is not None and not (set(t1) & set(t2)):
-                        continue  # disjoint types can never be the same rel
-                    predicates.append(
-                        E.Neq(
-                            E.Id(E.Var(r1).with_type(ir.rel_types[r1])).with_type(
-                                T.CTInteger
-                            ),
-                            E.Id(E.Var(r2).with_type(ir.rel_types[r2])).with_type(
-                                T.CTInteger
-                            ),
-                        ).with_type(T.CTBoolean)
-                    )
+            predicates.extend(self._uniqueness_predicates(ir))
         return ir, predicates
+
+    def _uniqueness_predicates(self, ir: IRPattern) -> List[E.Expr]:
+        """openCypher per-MATCH relationship-isomorphism predicates for
+        every pair of relationship variables whose type sets can intersect
+        (the rewrite Neo4j's frontend performs — AddUniquenessPredicates —
+        before the reference ever sees the query; reference
+        ``VarLengthExpandPlanner.scala:96,173-186`` additionally filters a
+        var-length's edges against every rel element in scope):
+
+        * fixed vs fixed — ``id(r1) <> id(r2)``;
+        * fixed vs var-length — ``none(x IN rs WHERE id(x) = id(r))``;
+        * var-length vs var-length —
+          ``none(x IN rs1 WHERE any(y IN rs2 WHERE id(x) = id(y)))``.
+        """
+        fixed = [r for r, conn in ir.topology.items() if not conn.is_var_length]
+        varlen = [r for r, conn in ir.topology.items() if conn.is_var_length]
+
+        def may_intersect(r1: str, r2: str) -> bool:
+            t1 = ir.rel_types[r1].types or None  # None/empty = any
+            t2 = ir.rel_types[r2].types or None
+            return t1 is None or t2 is None or bool(set(t1) & set(t2))
+
+        def rel_id(r: str) -> E.Expr:
+            return E.Id(E.Var(r).with_type(ir.rel_types[r])).with_type(T.CTInteger)
+
+        def local_rel(rs: str) -> E.Var:
+            return E.Var(self.fresh_name("uq")).with_type(ir.rel_types[rs])
+
+        def local_id(v: E.Var) -> E.Expr:
+            return E.Id(v).with_type(T.CTInteger)
+
+        def list_of(rs: str) -> E.Expr:
+            return E.Var(rs).with_type(T.CTListType(ir.rel_types[rs]))
+
+        preds: List[E.Expr] = []
+        for i in range(len(fixed)):
+            for j in range(i + 1, len(fixed)):
+                r1, r2 = fixed[i], fixed[j]
+                if not may_intersect(r1, r2):
+                    continue
+                preds.append(
+                    E.Neq(rel_id(r1), rel_id(r2)).with_type(T.CTBoolean)
+                )
+        for rs in varlen:
+            for r in fixed:
+                if not may_intersect(rs, r):
+                    continue
+                x = local_rel(rs)
+                preds.append(
+                    E.Quantified(
+                        "none",
+                        x,
+                        list_of(rs),
+                        E.Equals(local_id(x), rel_id(r)).with_type(T.CTBoolean),
+                    ).with_type(T.CTBoolean)
+                )
+        for i in range(len(varlen)):
+            for j in range(i + 1, len(varlen)):
+                rs1, rs2 = varlen[i], varlen[j]
+                if not may_intersect(rs1, rs2):
+                    continue
+                x, y = local_rel(rs1), local_rel(rs2)
+                inner = E.Quantified(
+                    "any",
+                    y,
+                    list_of(rs2),
+                    E.Equals(local_id(x), local_id(y)).with_type(T.CTBoolean),
+                ).with_type(T.CTBoolean)
+                preds.append(
+                    E.Quantified("none", x, list_of(rs1), inner).with_type(
+                        T.CTBoolean
+                    )
+                )
+        return preds
 
     # ------------------------------------------------------------------
     # WITH / RETURN

@@ -198,11 +198,20 @@ class LogicalPlanner:
             return L.NodeScan(src, fld, pattern.node_types[fld])
 
         # deterministic component order: components containing bound nodes
-        # first, then by smallest member name
-        comps = sorted(
-            pattern.components(),
-            key=lambda comp: (not any(n in bound for n in comp), sorted(comp)[0]),
-        )
+        # first, then fixed-length-only components before ones with
+        # var-length connections (so fixed rels are in scope when a
+        # var-length plans — its isomorphism-vs-fixed predicates can then
+        # push into the fused walk as forbidden edges instead of filtering
+        # a materialized rel list), then by smallest member name
+        def comp_key(comp):
+            has_var = any(
+                c.is_var_length
+                for r, c in unsolved_conns.items()
+                if c.source in comp or c.target in comp
+            )
+            return (not any(n in bound for n in comp), has_var, sorted(comp)[0])
+
+        comps = sorted(pattern.components(), key=comp_key)
         for comp in comps:
             comp_conns = {
                 r: c
@@ -221,7 +230,9 @@ class LogicalPlanner:
             # expand until the whole component is solved
             while comp_conns:
                 progress = False
-                for r in sorted(comp_conns):
+                for r in sorted(
+                    comp_conns, key=lambda n: (comp_conns[n].is_var_length, n)
+                ):
                     c = comp_conns[r]
                     src_solved = c.source in solved_nodes
                     dst_solved = c.target in solved_nodes

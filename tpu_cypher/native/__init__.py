@@ -115,6 +115,12 @@ def get_lib() -> Optional[ctypes.CDLL]:
             p32, p32, p64, p64,
             ctypes.c_int64, ctypes.c_int64, ctypes.c_int64, pu8,
         ]
+        lib.varlen_count_forbid.restype = ctypes.c_int64
+        lib.varlen_count_forbid.argtypes = [
+            p32, p32, p64, p64,
+            ctypes.c_int64, ctypes.c_int64, ctypes.c_int64, pu8,
+            p64, ctypes.c_int64,
+        ]
         _lib = lib
         return _lib
 
@@ -181,6 +187,11 @@ def two_hop_distinct_native(
     lib = get_lib()
     if lib is None:
         return None
+    if not use_a and not use_c:
+        # the kernel counts one hit per frontier ROW in this mode while the
+        # device path would count at most one GLOBAL row — reject rather
+        # than silently diverge (ADVICE r4)
+        return None
     ak = np.ascontiguousarray(akeys, dtype=np.int64)
     if not _grouped(ak):
         return None  # stamping needs contiguous per-source row groups
@@ -221,11 +232,12 @@ def two_hop_close_count_native(
 
 
 def varlen_count_native(
-    rp, ci, eo, frontier, lo, hi, far_mask
+    rp, ci, eo, frontier, lo, hi, far_mask, forbid=None
 ) -> Optional[int]:
     """Bounded var-length walk count via the DFS kernel (see
     csr_builder.cpp); None when the native lib is unavailable or the bound
-    is out of the kernel's stack range."""
+    is out of the kernel's stack range. ``forbid``: optional [nf, k] int64
+    canonical scan rows each frontier row's walks must avoid (-1 pads)."""
     lib = get_lib()
     if lib is None:
         return None
@@ -233,6 +245,18 @@ def varlen_count_native(
     eo = np.ascontiguousarray(eo, dtype=np.int64)
     fr = np.ascontiguousarray(frontier, dtype=np.int64)
     m = _mask_u8(far_mask)
+    if forbid is not None:
+        fb = np.ascontiguousarray(forbid, dtype=np.int64)
+        if fb.ndim != 2 or fb.shape[0] != len(fr):
+            return None
+        got = int(
+            lib.varlen_count_forbid(
+                _p32(rp), _p32(ci), _p64(eo), _p64(fr),
+                len(fr), int(lo), int(hi), _pm(m),
+                _p64(fb), int(fb.shape[1]),
+            )
+        )
+        return None if got < 0 else got
     got = int(
         lib.varlen_count(
             _p32(rp), _p32(ci), _p64(eo), _p64(fr),

@@ -231,16 +231,37 @@ int64_t two_hop_close_count(const int32_t* rp1, const int32_t* ci1,
 // and is at most `hi` deep, so the distinctness check is a linear scan of a
 // register-resident array. Replaces materializing every partial-walk level
 // on host backends (the device frontier loop keeps TPU/mesh paths).
+int64_t varlen_count_forbid(const int32_t* rp, const int32_t* ci,
+                            const int64_t* eo, const int64_t* frontier,
+                            int64_t nf, int64_t lo, int64_t hi,
+                            const uint8_t* far_mask,
+                            const int64_t* forbid, int64_t nfb);
+
 int64_t varlen_count(const int32_t* rp, const int32_t* ci, const int64_t* eo,
                      const int64_t* frontier, int64_t nf,
                      int64_t lo, int64_t hi, const uint8_t* far_mask) {
-    if (hi < 1 || hi > 64) return -1;  // caller falls back
+    return varlen_count_forbid(rp, ci, eo, frontier, nf, lo, hi, far_mask,
+                               nullptr, 0);
+}
+
+// varlen_count with per-frontier-row forbidden edges: forbid is row-major
+// [nf x nfb] canonical scan rows (-1 = unconstrained) that row i's walks may
+// not use — the openCypher isomorphism between a var-length and the fixed
+// relationships already bound in its input row (the device tier seeds the
+// same values into the walked-edge masks).
+int64_t varlen_count_forbid(const int32_t* rp, const int32_t* ci,
+                            const int64_t* eo, const int64_t* frontier,
+                            int64_t nf, int64_t lo, int64_t hi,
+                            const uint8_t* far_mask,
+                            const int64_t* forbid, int64_t nfb) {
+    if (hi < 1 || hi > 64 || nfb < 0) return -1;  // caller falls back
     int64_t count = 0;
     std::vector<int64_t> estack(hi + 1);
     std::vector<int32_t> vstack(hi + 1);
     std::vector<int32_t> epos(hi + 1);
     for (int64_t i = 0; i < nf; i++) {
         int32_t s = (int32_t)frontier[i];
+        const int64_t* fb = forbid ? forbid + i * nfb : nullptr;
         int depth = 0;
         vstack[0] = s;
         epos[0] = rp[s];
@@ -249,8 +270,11 @@ int64_t varlen_count(const int32_t* rp, const int32_t* ci, const int64_t* eo,
                 int32_t e = epos[depth]++;
                 int64_t orig = eo[e];
                 bool dup = false;
-                for (int k = 0; k < depth; k++)
-                    if (estack[k] == orig) { dup = true; break; }
+                for (int64_t k = 0; k < nfb; k++)
+                    if (fb[k] == orig) { dup = true; break; }
+                if (!dup)
+                    for (int k = 0; k < depth; k++)
+                        if (estack[k] == orig) { dup = true; break; }
                 if (dup) continue;
                 int32_t nb = ci[e];
                 int d1 = depth + 1;

@@ -85,8 +85,14 @@ def _bucketize(keys, rows, nsh: int, cap: int, pad_key: int, axis: str):
     ).astype(jnp.int32)
     order = jnp.argsort(tgt, stable=True)
     tgt_s = jnp.take(tgt, order)
-    rank = jnp.arange(n) - jnp.searchsorted(tgt_s, tgt_s, side="left")
     is_real = ~jnp.take(is_pad, order)
+    # rank REAL rows only (ADVICE r4): pads sorted ahead within a bucket
+    # must not inflate real ranks, or near-capacity buckets trip the
+    # overflow fallback spuriously
+    creal = jnp.cumsum(is_real.astype(jnp.int64))
+    start = jnp.searchsorted(tgt_s, tgt_s, side="left")
+    before = jnp.where(start > 0, jnp.take(creal, jnp.maximum(start - 1, 0)), 0)
+    rank = creal - 1 - before
     overflow = jnp.any((rank >= cap) & is_real)
     keys_s = jnp.take(keys, order)
     rows_s = jnp.take(rows, order)
@@ -208,6 +214,12 @@ def hash_repartition_join(
     n_l, n_r = int(l_key.shape[0]), int(r_key.shape[0])
     if n_l == 0 or n_r == 0:
         return None  # trivial; the default join handles empties cheaply
+    for arr in (l_key, l_valid, r_key, r_valid):
+        # multi-process meshes hold row-sharded GLOBAL arrays whose remote
+        # shards this process cannot read — np.asarray staging would raise,
+        # so keep the default (GSPMD-partitioned) sort-probe join (ADVICE r4)
+        if arr is not None and not getattr(arr, "is_fully_addressable", True):
+            return None
 
     # host staging: drop invalid rows (null keys never match), double the
     # keys into the even namespace, pad to shard multiples with odd pad
