@@ -1,0 +1,249 @@
+Feature: MultipleGraphsConstruct
+  # Multiple-graph CONSTRUCT (Cypher 10 / reference MultipleGraphTests,
+  # ConstructGraphPlanner.scala:52-514), exercised through query
+  # continuation so results stay tabular: clauses after CONSTRUCT run on
+  # the constructed graph. Provenance: self-authored (the openCypher TCK
+  # does not cover multiple graphs — it is a CAPS/Morpheus extension).
+
+  Scenario: NEW creates one node per binding row
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:P {v: 1}), (:P {v: 2})
+      """
+    When executing query:
+      """
+      MATCH (p:P)
+      CONSTRUCT NEW (:Q {w: p.v})
+      MATCH (q:Q) RETURN q.w AS w
+      """
+    Then the result should be, in any order:
+      | w |
+      | 1 |
+      | 2 |
+    And no side effects
+
+  Scenario: CLONE keeps element identity
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:P {v: 1})
+      """
+    When executing query:
+      """
+      MATCH (p:P), (q:P)
+      CONSTRUCT CLONE p, q
+      MATCH (n:P) RETURN count(*) AS c
+      """
+    Then the result should be, in any order:
+      | c |
+      | 1 |
+    And no side effects
+
+  Scenario: COPY OF a node creates a new identity
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:P {v: 1})
+      """
+    When executing query:
+      """
+      MATCH (p:P), (q:P)
+      CONSTRUCT NEW (c COPY OF p)
+      MATCH (n:P) RETURN count(*) AS c
+      """
+    Then the result should be, in any order:
+      | c |
+      | 1 |
+    And no side effects
+
+  Scenario: COPY OF inherits labels and properties
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:A:B {v: 1, s: 'x'})
+      """
+    When executing query:
+      """
+      MATCH (p:A)
+      CONSTRUCT NEW (c COPY OF p)
+      MATCH (n:B) RETURN labels(n) AS l, n.v AS v, n.s AS s
+      """
+    Then the result should be, in any order:
+      | l          | v | s   |
+      | ['A', 'B'] | 1 | 'x' |
+    And no side effects
+
+  Scenario: COPY OF with inline property map overrides
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:P {v: 1})
+      """
+    When executing query:
+      """
+      MATCH (p:P)
+      CONSTRUCT NEW (c COPY OF p {v: 99, extra: true})
+      MATCH (n:P) RETURN n.v AS v, n.extra AS e
+      """
+    Then the result should be, in any order:
+      | v  | e    |
+      | 99 | true |
+    And no side effects
+
+  Scenario: COPY OF with SET property override
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:P {v: 1})
+      """
+    When executing query:
+      """
+      MATCH (p:P)
+      CONSTRUCT NEW (c COPY OF p)
+      SET c.v = 2
+      MATCH (n:P) RETURN n.v AS v
+      """
+    Then the result should be, in any order:
+      | v |
+      | 2 |
+    And no side effects
+
+  Scenario: COPY OF with SET label
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:P {v: 1})
+      """
+    When executing query:
+      """
+      MATCH (p:P)
+      CONSTRUCT NEW (c COPY OF p)
+      SET c:Extra
+      MATCH (n:Extra) RETURN labels(n) AS l, n.v AS v
+      """
+    Then the result should be, in any order:
+      | l              | v |
+      | ['Extra', 'P'] | 1 |
+    And no side effects
+
+  Scenario: COPY OF a relationship inherits type and properties
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:S)-[:K {w: 7}]->(:T)
+      """
+    When executing query:
+      """
+      MATCH (a:S)-[r:K]->(b:T)
+      CONSTRUCT NEW (a)-[r2 COPY OF r]->(b)
+      MATCH ()-[e]->() RETURN type(e) AS t, e.w AS w
+      """
+    Then the result should be, in any order:
+      | t   | w |
+      | 'K' | 7 |
+    And no side effects
+
+  Scenario: COPY OF a relationship with SET override
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:S)-[:K {w: 7}]->(:T)
+      """
+    When executing query:
+      """
+      MATCH (a:S)-[r:K]->(b:T)
+      CONSTRUCT NEW (a)-[r2 COPY OF r]->(b)
+      SET r2.w = 8
+      MATCH ()-[e:K]->() RETURN e.w AS w
+      """
+    Then the result should be, in any order:
+      | w |
+      | 8 |
+    And no side effects
+
+  Scenario: COPY OF each binding row yields a distinct element
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:P {v: 1}), (:Other), (:Other)
+      """
+    When executing query:
+      """
+      MATCH (p:P), (o:Other)
+      CONSTRUCT NEW (c COPY OF p)
+      MATCH (n:P) RETURN count(*) AS c
+      """
+    Then the result should be, in any order:
+      | c |
+      | 2 |
+    And no side effects
+
+  Scenario: CLONE with SET supersedes the base row
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:P {v: 1})
+      """
+    When executing query:
+      """
+      MATCH (p:P)
+      CONSTRUCT CLONE p
+      SET p.v = 5
+      MATCH (n:P) RETURN n.v AS v
+      """
+    Then the result should be, in any order:
+      | v |
+      | 5 |
+    And no side effects
+
+  Scenario: COPY OF a null binding constructs nothing
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:P {v: 1})-[:K]->(:Q {v: 2}), (:P {v: 3})
+      """
+    When executing query:
+      """
+      MATCH (p:P) OPTIONAL MATCH (p)-[:K]->(q:Q)
+      CONSTRUCT NEW (c COPY OF q)
+      MATCH (n) RETURN n.v AS v
+      """
+    Then the result should be, in any order:
+      | v |
+      | 2 |
+    And no side effects
+
+  Scenario: CLONE of a null binding constructs nothing
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:P {v: 1})-[:K]->(:Q {v: 2}), (:P {v: 3})
+      """
+    When executing query:
+      """
+      MATCH (p:P) OPTIONAL MATCH (p)-[:K]->(q:Q)
+      CONSTRUCT CLONE q
+      MATCH (n) RETURN count(*) AS c
+      """
+    Then the result should be, in any order:
+      | c |
+      | 1 |
+    And no side effects
+
+  Scenario: NEW relationship between copies
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:P {v: 1})
+      """
+    When executing query:
+      """
+      MATCH (p:P)
+      CONSTRUCT NEW (a COPY OF p)-[:L]->(b COPY OF p)
+      MATCH (x)-[:L]->(y) RETURN x.v AS xv, y.v AS yv
+      """
+    Then the result should be, in any order:
+      | xv | yv |
+      | 1  | 1  |
+    And no side effects

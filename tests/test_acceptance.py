@@ -779,6 +779,115 @@ class TestMultipleGraphs:
             [{"n.name": "Alice", "n.age": 33}],
         )
 
+    def test_construct_copy_of_node(self, g):
+        # COPY OF: new identity, inherited labels + properties
+        # (reference ConstructGraphPlanner.computeNodeProjections :199-218)
+        ng = g.cypher(
+            "MATCH (p:Person {name:'Alice'}) "
+            "CONSTRUCT NEW (c COPY OF p {copied: true}) RETURN GRAPH"
+        ).graph
+        assert_results(
+            ng,
+            "MATCH (n:Person) RETURN n.name, n.copied",
+            [{"n.name": "Alice", "n.copied": True}],
+        )
+
+    def test_construct_copy_of_node_new_id(self, g):
+        # each binding row yields a distinct copy even of the same base node
+        ng = g.cypher(
+            "MATCH (p:Person {name:'Alice'}), (q:Person) "
+            "CONSTRUCT NEW (c COPY OF p) RETURN GRAPH"
+        ).graph
+        assert_results(ng, "MATCH (n:Person) RETURN count(*)", [{"count(*)": 2}])
+
+    def test_construct_copy_of_rel(self, g):
+        ng = g.cypher(
+            "MATCH (a:Person)-[r:KNOWS]->(b:Person) "
+            "CONSTRUCT NEW (a)-[r2 COPY OF r]->(b) RETURN GRAPH"
+        ).graph
+        assert_results(
+            ng,
+            "MATCH (x)-[e]->(y) RETURN type(e) AS t, e.since, x.name",
+            [{"t": "KNOWS", "e.since": 2020, "x.name": "Alice"}],
+        )
+
+    def test_construct_copy_of_rel_set_override(self, g):
+        ng = g.cypher(
+            "MATCH (a:Person)-[r:KNOWS]->(b:Person) "
+            "CONSTRUCT NEW (a)-[r2 COPY OF r]->(b) SET r2.since = 1999 "
+            "RETURN GRAPH"
+        ).graph
+        assert_results(
+            ng, "MATCH ()-[e:KNOWS]->() RETURN e.since", [{"e.since": 1999}]
+        )
+
+    def test_construct_copy_of_set_label(self, g):
+        ng = g.cypher(
+            "MATCH (p:Person {name:'Bob'}) "
+            "CONSTRUCT NEW (c COPY OF p) SET c:Copied RETURN GRAPH"
+        ).graph
+        assert_results(
+            ng,
+            "MATCH (n:Copied) RETURN n.name, labels(n) AS l",
+            [{"n.name": "Bob", "l": ["Copied", "Person"]}],
+        )
+
+    def test_construct_copy_of_null_base(self, session):
+        # null base under OPTIONAL MATCH constructs nothing — no phantom
+        # elements, no dangling rels
+        g = init_graph(
+            session,
+            "CREATE (:S {v:1})-[:K]->(:T {v:2}), (:S {v:3})",
+        )
+        r = g.cypher(
+            "MATCH (s:S) OPTIONAL MATCH (s)-[:K]->(t:T) "
+            "CONSTRUCT NEW (c COPY OF t)-[:R]->(d:D) "
+            "MATCH (n) OPTIONAL MATCH (n)-[e:R]->() "
+            "RETURN labels(n) AS l, n.v, e IS NOT NULL AS has_rel "
+            "ORDER BY l[0], n.v"
+        )
+        assert [dict(x) for x in r.records.collect()] == [
+            {"l": ["D"], "n.v": None, "has_rel": False},
+            {"l": ["D"], "n.v": None, "has_rel": False},
+            {"l": ["T"], "n.v": 2, "has_rel": True},
+        ]
+
+    def test_construct_copy_of_multi_type_errors(self, g):
+        from tpu_cypher.relational.ops import RelationalError
+
+        with pytest.raises(RelationalError):
+            g.cypher(
+                "MATCH (a)-[r:KNOWS]->(b) "
+                "CONSTRUCT NEW (a)-[r2 COPY OF r:K2|K3]->(b) RETURN GRAPH"
+            )
+
+    def test_construct_copy_of_set_references_target(self, g):
+        r = g.cypher(
+            "MATCH (p:Person {name:'Alice'}) CONSTRUCT NEW (c COPY OF p) "
+            "SET c.name2 = c.name MATCH (n:Person) RETURN n.name2"
+        )
+        assert [dict(x) for x in r.records.collect()] == [{"n.name2": "Alice"}]
+
+    def test_construct_copy_of_clone_alias(self, g):
+        r = g.cypher(
+            "MATCH (p:Person {name:'Alice'}) "
+            "CONSTRUCT CLONE p AS q NEW (c COPY OF q) "
+            "MATCH (n:Person) RETURN count(*)"
+        )
+        assert [dict(x) for x in r.records.collect()] == [{"count(*)": 2}]
+
+    def test_match_after_construct(self, g):
+        # Cypher 10 query continuation: clauses after CONSTRUCT run on the
+        # constructed graph
+        r = g.cypher(
+            "MATCH (p:Person) CONSTRUCT NEW (c COPY OF p) "
+            "MATCH (n:Person) RETURN n.name ORDER BY n.name"
+        )
+        assert [dict(x) for x in r.records.collect()] == [
+            {"n.name": "Alice"},
+            {"n.name": "Bob"},
+        ]
+
     def test_catalog_create_graph_and_on(self, session):
         g1 = init_graph(session, "CREATE (:A {v: 1})")
         g2 = init_graph(session, "CREATE (:B {w: 2})")

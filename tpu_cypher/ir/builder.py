@@ -606,6 +606,20 @@ class IRBuilder:
         cloned = {new for new, _ in clones}
         for pat in c.news:
             ir, preds = self.convert_pattern(pat, clone_env, rel_uniqueness=False)
+            for n, base in ir.base_entities.items():
+                # a COPY OF target must be a FRESH name: colliding with a
+                # bound var, clone alias, or earlier COPY declaration would
+                # silently drop one of the two meanings
+                if n in clone_env:
+                    raise IRBuildError(
+                        f"COPY OF target {n!r} is already bound; use a "
+                        "fresh variable (CLONE keeps element identity)"
+                    )
+                prev = new_pattern.base_entities.get(n)
+                if prev is not None and prev != base:
+                    raise IRBuildError(
+                        f"COPY OF target {n!r} declared more than once"
+                    )
             for n, t in ir.node_types.items():
                 if n in clone_env:
                     # references an existing/cloned entity: an implicit clone
@@ -625,6 +639,11 @@ class IRBuilder:
                     owner = p.lhs.expr
                     assert isinstance(owner, E.Var)
                     new_props.append((owner.name, p.lhs.key, p.rhs))
+        # COPY OF targets resolve like their base in SET value expressions
+        # (the planner aliases the target's columns to the base's)
+        for name, base in new_pattern.base_entities.items():
+            if base in clone_env and name not in clone_env:
+                clone_env[name] = clone_env[base]
         sets: List[Tuple[str, str, E.Expr]] = []
         set_labels: List[Tuple[str, Tuple[str, ...]]] = []
         for s in c.sets:

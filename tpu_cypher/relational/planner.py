@@ -58,6 +58,14 @@ class RelationalPlanner:
         self.driving_table = driving_table
         self.driving_header = driving_header
         self._fresh = itertools.count()
+        # graphs created by CONSTRUCT earlier in THIS query: later clauses
+        # (MATCH after CONSTRUCT — Cypher 10 query continuation) Start from
+        # the constructed QGN before the session catalog is consulted
+        self.constructed_graphs = {}
+
+    def resolve_graph(self, qgn):
+        got = self.constructed_graphs.get(qgn)
+        return got if got is not None else self.ctx.resolve_graph(qgn)
 
     def fresh(self, prefix: str) -> str:
         return f"__{prefix}_{next(self._fresh)}"
@@ -86,15 +94,15 @@ class RelationalPlanner:
     # -- leaves ---------------------------------------------------------
 
     def _plan_Start(self, op: L.Start) -> RelationalOperator:
-        graph = self.ctx.resolve_graph(op.qgn)
+        graph = self.resolve_graph(op.qgn)
         return StartOp(graph, self.ctx)
 
     def _plan_DrivingTable(self, op: L.DrivingTable) -> RelationalOperator:
-        graph = self.ctx.resolve_graph(op.qgn)
+        graph = self.resolve_graph(op.qgn)
         return StartOp(graph, self.ctx, self.driving_table, self.driving_header)
 
     def _plan_EmptyRecords(self, op: L.EmptyRecords) -> RelationalOperator:
-        graph = self.ctx.resolve_graph(op.qgn)
+        graph = self.resolve_graph(op.qgn)
         h = RecordHeader()
         for name, t in op.empty_fields:
             m = t.material
@@ -187,7 +195,7 @@ class RelationalPlanner:
 
     def _plan_FromGraph(self, op: L.FromGraph) -> RelationalOperator:
         in_plan = self.process(op.in_op)
-        graph = self.ctx.resolve_graph(op.qgn)
+        graph = self.resolve_graph(op.qgn)
         return TableOp(graph, self.ctx, in_plan.header, in_plan.table)
 
     def _plan_ReturnGraph(self, op: L.ReturnGraph) -> RelationalOperator:
