@@ -790,6 +790,21 @@ class TpuEvaluator:
 
                 merged = sorted({s for a in args for s in (a.vocab or [])})
                 args = [_remap(a, merged) for a in args]
+            elif kinds == {OBJ}:
+                # host-side blend: OBJ columns (lists/elements) are numpy
+                # object arrays, null encoded as None
+                import numpy as np
+
+                out_vals = list(args[-1].data)
+                for a in reversed(args[:-1]):
+                    out_vals = [
+                        v if v is not None else o
+                        for v, o in zip(list(a.data), out_vals)
+                    ]
+                arr = np.empty(len(out_vals), dtype=object)
+                for i, v in enumerate(out_vals):
+                    arr[i] = v
+                return Column(OBJ, arr, None)
             elif len(kinds) > 1:
                 raise TpuUnsupportedExpr("heterogeneous coalesce")
             out = args[-1]

@@ -190,6 +190,18 @@ class CreateClause(Clause):
     pattern: Pattern
 
 
+@dataclass(frozen=True)
+class CallClause(Clause):
+    """CALL proc.name(args) [YIELD item, ...] — parsed for a clean typed
+    "unsupported" error downstream (the reference parses procedure calls via
+    its frontend and blacklists ProcedureCallAcceptance at TCK level)."""
+
+    procedure: str
+    args: Tuple[Expr, ...] = ()
+    yields: Tuple[ReturnItem, ...] = ()
+    star: bool = False
+
+
 # ---------------------------------------------------------------------------
 # Queries / statements
 # ---------------------------------------------------------------------------

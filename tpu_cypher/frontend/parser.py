@@ -40,6 +40,8 @@ QUANTIFIERS = {"any", "all", "none", "single"}
 
 _CLAUSE_STARTS = {
     "MATCH",
+    "CALL",
+    "YIELD",
     "OPTIONAL",
     "WITH",
     "RETURN",
@@ -247,7 +249,35 @@ class Parser:
         if self.at_kw("CREATE"):
             self.next()
             return A.CreateClause(self.parse_pattern())
+        if self.at_kw("CALL"):
+            self.next()
+            return self.parse_call()
         self.fail("Expected a clause")
+
+    def parse_call(self) -> A.CallClause:
+        parts = [self.name()]
+        while self.try_sym("."):
+            parts.append(self.name())
+        args: List[E.Expr] = []
+        if self.try_sym("("):
+            while not self.at_sym(")"):
+                args.append(self.parse_expression())
+                if not self.at_sym(")"):
+                    self.eat_sym(",")
+            self.eat_sym(")")
+        yields: List[A.ReturnItem] = []
+        star = False
+        if self.try_kw("YIELD"):
+            if self.at_sym("*"):
+                self.next()
+                star = True
+            else:
+                yields.append(self.parse_return_item())
+                while self.try_sym(","):
+                    yields.append(self.parse_return_item())
+        return A.CallClause(
+            ".".join(parts), tuple(args), tuple(yields), star
+        )
 
     def parse_match(self, optional: bool) -> A.Match:
         self.eat_kw("MATCH")
@@ -828,6 +858,28 @@ class Parser:
     def parse_list_atom(self) -> E.Expr:
         """List literal or list comprehension."""
         self.eat_sym("[")
+        # pattern comprehension: [p = (a)-[:R]->(b) WHERE pred | proj]
+        # (path binding optional). Backtracks: a '[' may also open a list
+        # literal whose first element is a parenthesized expression.
+        save = self.i
+        try:
+            part = self.parse_pattern_part()
+            if part.rels and (self.at_kw("WHERE") or self.at_sym("|")):
+                where = None
+                if self.try_kw("WHERE"):
+                    where = self.parse_expression()
+                self.eat_sym("|")
+                proj = self.parse_expression()
+                self.eat_sym("]")
+                return E.PatternComprehension(
+                    A.Pattern((part,)),
+                    part.path_var,
+                    E.Opaque(where) if where is not None else None,
+                    E.Opaque(proj),
+                )
+        except CypherSyntaxError:
+            pass
+        self.i = save
         # list comprehension: [x IN expr WHERE p | proj]
         if self.peek().kind in ("IDENT", "ESC_IDENT") and self.at_kw("IN", ahead=1):
             var = E.Var(self.name())

@@ -39,6 +39,12 @@ class IRBuildError(Exception):
     pass
 
 
+class UnsupportedFeatureError(IRBuildError):
+    """A feature the grammar accepts but the engine does not execute
+    (procedure calls). The reference's analog: its frontend parses CALL and
+    the backends blacklist ProcedureCallAcceptance at TCK level."""
+
+
 @dataclass
 class IRBuilderContext:
     schema: PropertyGraphSchema
@@ -130,6 +136,10 @@ class IRBuilder:
                 raise IRBuildError(
                     "CREATE is only supported in test-graph construction "
                     "(use testing.create_graph) or CONSTRUCT NEW"
+                )
+            elif isinstance(c, A.CallClause):
+                raise UnsupportedFeatureError(
+                    f"CALL {c.procedure}: procedure calls are not supported"
                 )
             else:
                 raise IRBuildError(f"Unsupported clause {type(c).__name__}")
@@ -807,7 +817,45 @@ class IRBuilder:
             return E.MapProjection(var, items, e.all_props).with_type(T.CTMapType(None))
         if isinstance(e, E.ExistsPattern):
             return self._assign_exists_targets(e, env)
+        if isinstance(e, E.PatternComprehension):
+            return self._convert_pattern_comprehension(e, env)
         raise IRBuildError(f"Cannot convert expression {type(e).__name__}")
+
+    def _convert_pattern_comprehension(
+        self, e: E.PatternComprehension, env: Dict[str, CypherType]
+    ) -> E.PatternComprehension:
+        """Convert the comprehension's inner pattern/WHERE/projection in an
+        inner scope (outer vars correlated, pattern vars fresh) and attach
+        the results for the logical planner's collect-subquery extraction
+        (the exists-pattern treatment, ``_assign_exists_targets``)."""
+        if e.target_field is not None:
+            return e
+        inner_env = dict(env)
+        sub_pattern, sub_preds = self.convert_pattern(e.pattern, inner_env)
+        for n, t in sub_pattern.node_types.items():
+            inner_env[n] = t
+        for r, t in sub_pattern.rel_types.items():
+            conn = sub_pattern.topology.get(r)
+            if conn is not None and conn.is_var_length:
+                inner_env[r] = T.CTListType(t)
+            else:
+                inner_env[r] = t
+        for pname in sub_pattern.paths:
+            inner_env[pname] = T.CTPath
+        preds = list(sub_preds)
+        if e.where is not None:
+            w = self.convert_expr(e.where.value, inner_env)
+            preds.extend(w.exprs if isinstance(w, E.Ands) else [w])
+        proj = self.convert_expr(e.projection.value, inner_env)
+        target = self.fresh_name("pc")
+        clone = E.PatternComprehension(
+            e.pattern, e.path_var, e.where, E.Opaque(proj), target
+        )
+        object.__setattr__(clone, "_ir_pattern", sub_pattern)
+        object.__setattr__(clone, "_ir_predicates", tuple(preds))
+        object.__setattr__(clone, "_ir_projection", proj)
+        object.__setattr__(clone, "_typ", T.CTListType(proj.cypher_type))
+        return clone
 
     def _type_property(self, p: E.Property, owner_t: CypherType) -> E.Expr:
         m = owner_t.material

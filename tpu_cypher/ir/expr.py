@@ -440,6 +440,36 @@ class ListComprehension(Expr):
 
 
 @dataclass(frozen=True)
+class Opaque:
+    """Wraps an expression so generic tree traversal does NOT descend into
+    it (it is not a TreeNode): sub-expressions scoped to an inner context
+    (pattern comprehension bodies) must not be rewritten/extracted against
+    the OUTER plan."""
+
+    value: Any
+
+
+@dataclass(frozen=True)
+class PatternComprehension(Expr):
+    """[path = (a)-[:R]->(b) WHERE pred | proj] — a correlated subquery
+    producing a list per outer row (reference: ``PatternComprehension`` in
+    the Neo4j frontend, rewritten by ``extractSubqueryFromPatternExpression``;
+    the reference backends blacklist it at TCK level — we execute it).
+
+    Carries the raw frontend pattern and inner expressions (boxed so outer
+    traversals skip them); the IR builder attaches the converted inner
+    pattern/predicates/projection, and the logical planner extracts it into
+    a collect-subquery the way exists-patterns become ``ExistsSubQuery``."""
+
+    pattern: Any  # frontend.ast.Pattern (untyped to avoid import cycle)
+    path_var: Optional[str]
+    where: Optional[Opaque]
+    projection: Opaque
+    # filled by IR builder with a fresh target var name
+    target_field: Optional[str] = None
+
+
+@dataclass(frozen=True)
 class ListSlice(Expr):
     expr: Expr
     from_: Optional[Expr]

@@ -341,6 +341,22 @@ class ExistsSubQuery(BinaryOp):
 
 
 @dataclass(frozen=True)
+class PatternComprehension(BinaryOp):
+    """Per-lhs-row list of ``projection`` values over rhs pattern matches,
+    bound to ``target_field``; no matches yield the empty list. Planned as
+    collect-aggregate + left outer join (the reference blacklists pattern
+    comprehensions at TCK level — ``failing_blacklist`` — we execute them)."""
+
+    projection: Expr
+    target_field: str
+    list_type: CypherType
+
+    @property
+    def fields(self) -> FieldsT:
+        return self.lhs.fields + ((self.target_field, self.list_type),)
+
+
+@dataclass(frozen=True)
 class Expand(BinaryOp):
     """(source)-[rel]->(target): lhs solves ONE endpoint (source or target —
     inspect ``lhs.fields``), rhs scans the other

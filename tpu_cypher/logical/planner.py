@@ -146,9 +146,10 @@ class LogicalPlanner:
                 plan = L.Limit(plan, blk.limit)
             return plan
         if isinstance(blk, B.UnwindBlock):
-            inner = blk.list_expr.cypher_type.material
+            lx, plan = self._extract_exists(blk.list_expr, plan)
+            inner = lx.cypher_type.material
             t = inner.inner if isinstance(inner, T.CTListType) else T.CTAny.nullable
-            return L.Unwind(plan, blk.list_expr, blk.fld, t)
+            return L.Unwind(plan, lx, blk.fld, t)
         if isinstance(blk, (B.SelectBlock, B.ResultBlock)):
             current = tuple(n for n, _ in plan.fields)
             if current == tuple(blk.fields):
@@ -159,6 +160,25 @@ class LogicalPlanner:
         if isinstance(blk, B.GraphResultBlock):
             return L.ReturnGraph(plan)
         if isinstance(blk, B.ConstructBlock):
+            # SET / property-map values may contain subquery expressions
+            # (exists, pattern comprehensions) — extract them into the
+            # binding plan before the construct consumes it
+            import dataclasses
+
+            def _ex(items):
+                nonlocal plan
+                out = []
+                for owner, key, expr in items:
+                    ex, plan = self._extract_exists(expr, plan)
+                    out.append((owner, key, ex))
+                return tuple(out)
+
+            new_properties = _ex(blk.new_properties)
+            sets = _ex(blk.sets)
+            if new_properties != blk.new_properties or sets != blk.sets:
+                blk = dataclasses.replace(
+                    blk, new_properties=new_properties, sets=sets
+                )
             return L.ConstructGraph(plan, blk, self.fresh("constructed"))
         raise LogicalPlanningError(f"Cannot plan block {type(blk).__name__}")
 
@@ -330,18 +350,42 @@ class LogicalPlanner:
         flag var of a planned ``ExistsSubQuery`` (works in WHERE and in
         projections alike — reference
         ``extractSubqueryFromPatternExpression``)."""
-        exists = [n for n in expr.iter_nodes() if isinstance(n, E.ExistsPattern)]
+        subs = [
+            n
+            for n in expr.iter_nodes()
+            if isinstance(n, (E.ExistsPattern, E.PatternComprehension))
+        ]
         mapping: Dict[E.Expr, E.Expr] = {}
-        for ep in exists:
-            target = ep.target_field or self.fresh("exists")
+        for ep in subs:
             sub_pattern = getattr(ep, "_ir_pattern", None)
             if sub_pattern is None:
-                raise LogicalPlanningError("ExistsPattern missing IR pattern")
-            rhs = self._plan_pattern(sub_pattern, plan)
-            for p in getattr(ep, "_ir_predicates", ()):  # inner property predicates
+                raise LogicalPlanningError(
+                    f"{type(ep).__name__} missing IR pattern"
+                )
+            if isinstance(ep, E.ExistsPattern):
+                target = ep.target_field or self.fresh("exists")
+                rhs = self._plan_pattern(sub_pattern, plan)
+                for p in getattr(ep, "_ir_predicates", ()):
+                    rhs = self._plan_predicate(p, rhs)
+                plan = L.ExistsSubQuery(plan, rhs, target)
+                mapping[ep] = E.Var(target).with_type(T.CTBoolean)
+                continue
+            target = ep.target_field or self.fresh("pc")
+            # expand from DISTINCT outer rows: bag-duplicate lhs rows (UNWIND
+            # [1,1] ...) must not multiply the collected list — the list
+            # depends only on the correlated bindings, and the join-back
+            # re-attaches it to every duplicate
+            dedup = L.Distinct(plan, tuple(n for n, _ in plan.fields))
+            rhs = self._plan_pattern(sub_pattern, dedup)
+            for pname, fields in sorted(sub_pattern.paths.items()):
+                rhs = L.BindPath(rhs, pname, tuple(fields))
+            for p in getattr(ep, "_ir_predicates", ()):
                 rhs = self._plan_predicate(p, rhs)
-            plan = L.ExistsSubQuery(plan, rhs, target)
-            mapping[ep] = E.Var(target).with_type(T.CTBoolean)
+            # nested comprehensions/exists in the projection extract into rhs
+            proj, rhs = self._extract_exists(ep._ir_projection, rhs)
+            list_type = T.CTListType(proj.cypher_type)
+            plan = L.PatternComprehension(plan, rhs, proj, target, list_type)
+            mapping[ep] = E.Var(target).with_type(list_type)
         if mapping:
             expr = E.substitute(expr, mapping)
         return expr, plan

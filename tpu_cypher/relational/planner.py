@@ -275,6 +275,35 @@ class RelationalPlanner:
         )
         return DropOp(with_target, [flag_var])
 
+    def _plan_PatternComprehension(
+        self, op: L.PatternComprehension
+    ) -> RelationalOperator:
+        """Collect the projection over rhs matches per outer row: project
+        the value, group by the correlated outer vars collecting a list,
+        left-outer-join the lists back, and default no-match rows to []."""
+        lhs, rhs = self.process(op.lhs), self.process(op.rhs)
+        common = [
+            v.name
+            for v in rhs.header.vars
+            if any(v.name == lv.name for lv in lhs.header.vars)
+        ]
+        val = self.fresh("pcval")
+        rhs_val = AddOp(rhs, op.projection, val)
+        rhs_sel = SelectOp(rhs_val, common + [val])
+        lst = self.fresh("pclist")
+        agg = E.Agg("collect", E.Var(val).with_type(op.projection.cypher_type))
+        object.__setattr__(agg, "_typ", op.list_type)
+        rhs_agg = AggregateOp(rhs_sel, common, [(lst, agg)])
+        pairs = self._common_join_pairs(lhs, rhs_agg)
+        joined = JoinOp(lhs, rhs_agg, pairs, "left_outer")
+        lst_var = E.Var(lst).with_type(op.list_type)
+        empty = E.ListLit(()).with_type(op.list_type)
+        coalesced = E.FunctionCall("coalesce", (lst_var, empty)).with_type(
+            op.list_type
+        )
+        with_target = AddOp(joined, coalesced, op.target_field)
+        return DropOp(with_target, [lst_var])
+
     def _plan_TabularUnionAll(self, op: L.TabularUnionAll) -> RelationalOperator:
         return UnionAllOp(self.process(op.lhs), self.process(op.rhs))
 
