@@ -189,18 +189,64 @@ def _host_graph_stats(graph):
     return ids_sorted, s, d, outdeg, two_hop
 
 
+def _tier_snapshot():
+    from tpu_cypher.backend.tpu import expand_op as X
+
+    return {
+        **{f"mxu_{k}": v for k, v in X.MXU_TIER_COUNTS.items()},
+        **{f"native_{k}": v for k, v in X.NATIVE_TIER_COUNTS.items()},
+    }
+
+
 def _time_query(g, query, params=None, repeats=3):
-    """Median wall time of a warmed query (warmup compiles + builds CSR)."""
+    """Median wall time of a warmed query (warmup compiles + builds CSR)
+    plus WHICH tier answered (MXU dense/tiled, native C++, or the device
+    frontier programs as the residual)."""
     out = g.cypher(query, parameters=params).records.collect()
+    before = _tier_snapshot()
     times = []
     for _ in range(repeats):
         t0 = time.perf_counter()
         g.cypher(query, parameters=params).records.collect()
         times.append(time.perf_counter() - t0)
-    return float(np.median(times)), out
+    after = _tier_snapshot()
+    hits = sorted(k for k in after if after[k] > before[k])
+    tier = "+".join(hits) if hits else "device"
+    return float(np.median(times)), out, tier
 
 
-def run_config(name: str, scale: float, session, results: dict, budget_rows: int):
+# v5e single-chip peaks (public spec): the roofline/MFU denominators.
+# A CPU-fallback run reports the byte/flop MODEL only (utilization against
+# a TPU peak would be meaningless).
+V5E_PEAK_FLOPS = 197e12  # bf16 FLOP/s
+V5E_PEAK_BYTES = 819e9  # HBM bytes/s
+
+
+def _roofline(n: int, e: int, paths: int, dt: float, on_tpu: bool) -> dict:
+    """First-order model of the fused 2-hop count: stream row_ptr + both
+    col_idx passes (4B lanes) and one multiply-add per edge-expansion.
+    ``paths`` enters the flop count (each 2-hop path is one accumulate)."""
+    bytes_moved = 4.0 * (n + 1) + 8.0 * e + 8.0 * n
+    flops = 2.0 * (e + paths)
+    entry = {
+        "est_bytes": int(bytes_moved),
+        "est_flops": int(flops),
+        "arith_intensity": round(flops / max(bytes_moved, 1.0), 4),
+    }
+    if on_tpu and dt > 0:
+        t_mem = bytes_moved / V5E_PEAK_BYTES
+        t_cmp = flops / V5E_PEAK_FLOPS
+        entry["bandwidth_util"] = round(bytes_moved / dt / V5E_PEAK_BYTES, 6)
+        entry["mfu"] = round(flops / dt / V5E_PEAK_FLOPS, 6)
+        entry["bound"] = "memory" if t_mem >= t_cmp else "compute"
+        entry["roofline_frac"] = round(max(t_mem, t_cmp) / dt, 6)
+    return entry
+
+
+def run_config(
+    name: str, scale: float, session, results: dict, budget_rows: int,
+    on_tpu: bool = False,
+):
     """One ladder rung: build the SNB graph, run the four shapes."""
     from tpu_cypher.io.ldbc import generate_snb
     from tpu_cypher.relational.session import PropertyGraph
@@ -212,7 +258,7 @@ def run_config(name: str, scale: float, session, results: dict, budget_rows: int
     expansions = e + two_hop_paths
     rung = {"nodes": n, "edges": e, "two_hop_paths": two_hop_paths}
 
-    dt, out = _time_query(g, TWO_HOP)
+    dt, out, tier = _time_query(g, TWO_HOP)
     if int(out[0]["c"]) != two_hop_paths:
         sys.stderr.write(
             f"ENGINE COUNT MISMATCH {name}: {out[0]['c']} != {two_hop_paths}\n"
@@ -220,14 +266,17 @@ def run_config(name: str, scale: float, session, results: dict, budget_rows: int
         results["validated"] = False
     rung["seconds_two_hop"] = round(dt, 6)
     rung["expansions_per_sec"] = round(expansions / dt, 1)
+    rung["tier_two_hop"] = tier
+    rung["roofline_two_hop"] = _roofline(n, e, two_hop_paths, dt, on_tpu)
 
     # the fused distinct path materializes one packed key per 2-hop row
     # (plus sort buffers); gate so an over-scaled run degrades to a skip
     # note instead of an OOM that kills the JSON line
     if two_hop_paths <= budget_rows * 8:
-        dt, out = _time_query(g, TWO_HOP_DISTINCT, repeats=1)
+        dt, out, tier = _time_query(g, TWO_HOP_DISTINCT, repeats=1)
         rung["seconds_two_hop_distinct"] = round(dt, 6)
         rung["distinct_pairs"] = int(out[0]["pairs"])
+        rung["tier_two_hop_distinct"] = tier
     else:
         rung["seconds_two_hop_distinct"] = None
         rung["distinct_skipped"] = f"2-hop rows {two_hop_paths} over budget"
@@ -236,9 +285,10 @@ def run_config(name: str, scale: float, session, results: dict, budget_rows: int
     # materialization); the transient per-program arrays still scale with
     # the 2-hop row count, so keep a generous gate
     if two_hop_paths <= budget_rows * 8:
-        dt, out = _time_query(g, TRIANGLE, repeats=1)
+        dt, out, tier = _time_query(g, TRIANGLE, repeats=1)
         rung["seconds_triangle"] = round(dt, 6)
         rung["triangles"] = int(out[0]["triangles"])
+        rung["tier_triangle"] = tier
     else:
         rung["seconds_triangle"] = None
         rung["triangle_skipped"] = f"2-hop rows {two_hop_paths} over budget"
@@ -259,8 +309,9 @@ def run_config(name: str, scale: float, session, results: dict, budget_rows: int
     lo = int(ids_sorted[start])
     # exclusive upper bound: one past the last window id (ids are sorted)
     hi = int(ids_sorted[start + k - 1]) + 1
-    dt, out = _time_query(g, VAR_LENGTH, params={"lo": lo, "hi": hi}, repeats=1)
+    dt, out, tier = _time_query(g, VAR_LENGTH, params={"lo": lo, "hi": hi}, repeats=1)
     rung["seconds_var_length"] = round(dt, 6)
+    rung["tier_var_length"] = tier
     rung["var_length_walks"] = int(out[0]["walks"])
     rung["var_length_sources"] = k
     rung["walks_per_sec"] = round(int(out[0]["walks"]) / max(dt, 1e-9), 1)
@@ -306,7 +357,7 @@ def main():
         ("SF10", 10.0 * scale_mult, 60_000_000),
     ]
     for name, scale, budget in configs:
-        rung = run_config(name, scale, session, results, budget)
+        rung = run_config(name, scale, session, results, budget, on_tpu=tpu_ok)
         headline, headline_name = rung, name  # last rung wins
 
     rate = headline["expansions_per_sec"]

@@ -231,6 +231,25 @@ Feature: MultipleGraphsConstruct
       | 1 |
     And no side effects
 
+  Scenario: A new node re-referenced by a later NEW clause keeps its labels
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:V {email: 'a'})
+      """
+    When executing query:
+      """
+      MATCH (v:V)
+      CONSTRUCT
+        NEW (profile:Profile {email: v.email})
+        NEW (profile)-[:I]->(:T)
+      MATCH (n:Profile)-[:I]->(:T) RETURN n.email AS e
+      """
+    Then the result should be, in any order:
+      | e   |
+      | 'a' |
+    And no side effects
+
   Scenario: NEW relationship between copies
     Given an empty graph
     And having executed:

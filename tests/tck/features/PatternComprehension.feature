@@ -253,6 +253,38 @@ Feature: PatternComprehension
       | 2 |
     And no side effects
 
+  Scenario: Pattern comprehension unaffected by null columns from OPTIONAL MATCH
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:A {name: 'a'})-[:T]->(:B {name: 'b'})
+      """
+    When executing query:
+      """
+      MATCH (n:B) OPTIONAL MATCH (n)-[r:T]->(m)
+      RETURN [(n)<--(z) | z.name] AS l
+      """
+    Then the result should be, in any order:
+      | l     |
+      | ['a'] |
+    And no side effects
+
+  Scenario: Exists pattern unaffected by null columns from OPTIONAL MATCH
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:A {name: 'a'})-[:T]->(:B {name: 'b'})
+      """
+    When executing query:
+      """
+      MATCH (n:B) OPTIONAL MATCH (n)-[r:T]->(m)
+      RETURN exists((n)<--()) AS e
+      """
+    Then the result should be, in any order:
+      | e    |
+      | true |
+    And no side effects
+
   Scenario: Pattern comprehension on undirected pattern
     Given an empty graph
     And having executed:

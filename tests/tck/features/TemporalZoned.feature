@@ -1,0 +1,228 @@
+Feature: TemporalZoned
+  # Zoned datetime / time / localtime (reference CTDateTime/CTTime via
+  # TemporalUdfs.scala:40-160 — whose 920-line temporal blacklist admits
+  # weakness; we execute these on BOTH backends, device-resident for
+  # fixed-offset columns). Provenance: transcribed openCypher TCK
+  # temporal shapes (temporal/Temporal*.feature) plus self-authored
+  # offset/instant-semantics cases.
+
+  Scenario: datetime from a string with an offset
+    Given an empty graph
+    When executing query:
+      """
+      WITH datetime('2015-06-24T12:50:35.556+01:00') AS d
+      RETURN d.year AS y, d.month AS mo, d.day AS day,
+             d.hour AS h, d.minute AS mi, d.second AS s,
+             d.millisecond AS ms
+      """
+    Then the result should be, in any order:
+      | y    | mo | day | h  | mi | s  | ms  |
+      | 2015 | 6  | 24  | 12 | 50 | 35 | 556 |
+    And no side effects
+
+  Scenario: datetime accessors read the local clock, not UTC
+    Given an empty graph
+    When executing query:
+      """
+      WITH datetime('2015-01-01T01:30:00-05:00') AS d
+      RETURN d.year AS y, d.day AS day, d.hour AS h
+      """
+    Then the result should be, in any order:
+      | y    | day | h |
+      | 2015 | 1   | 1 |
+    And no side effects
+
+  Scenario: offset accessors
+    Given an empty graph
+    When executing query:
+      """
+      WITH datetime('2015-06-24T12:50:35+05:30') AS d
+      RETURN d.offset AS o, d.offsetMinutes AS m
+      """
+    Then the result should be, in any order:
+      | o        | m   |
+      | '+05:30' | 330 |
+    And no side effects
+
+  Scenario: epoch accessors
+    Given an empty graph
+    When executing query:
+      """
+      WITH datetime('1970-01-01T00:00:10Z') AS d
+      RETURN d.epochSeconds AS s, d.epochMillis AS ms
+      """
+    Then the result should be, in any order:
+      | s  | ms    |
+      | 10 | 10000 |
+    And no side effects
+
+  Scenario: datetime from a map with a timezone
+    Given an empty graph
+    When executing query:
+      """
+      WITH datetime({year: 1984, month: 10, day: 11, hour: 12,
+                     minute: 31, timezone: '+02:00'}) AS d
+      RETURN d.hour AS h, d.offsetMinutes AS off
+      """
+    Then the result should be, in any order:
+      | h  | off |
+      | 12 | 120 |
+    And no side effects
+
+  Scenario: datetime equality is instant equality
+    Given an empty graph
+    When executing query:
+      """
+      RETURN datetime('2020-01-01T12:00+01:00') = datetime('2020-01-01T11:00Z') AS eq,
+             datetime('2020-01-01T12:00+01:00') = datetime('2020-01-01T12:00Z') AS ne
+      """
+    Then the result should be, in any order:
+      | eq   | ne    |
+      | true | false |
+    And no side effects
+
+  Scenario: datetime ordering is instant ordering
+    Given an empty graph
+    When executing query:
+      """
+      RETURN datetime('2020-01-01T12:00+01:00') < datetime('2020-01-01T12:00Z') AS a,
+             datetime('2020-01-01T10:00Z') < datetime('2020-01-01T12:00+01:00') AS b
+      """
+    Then the result should be, in any order:
+      | a    | b    |
+      | true | true |
+    And no side effects
+
+  Scenario: zoned datetime properties order by instant
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:E {n: 1, ts: datetime('2020-01-01T12:00+01:00')}),
+             (:E {n: 2, ts: datetime('2020-01-01T10:30Z')})
+      """
+    When executing query:
+      """
+      MATCH (e:E) RETURN e.n AS n ORDER BY e.ts
+      """
+    Then the result should be, in ORDER:
+      | n |
+      | 2 |
+      | 1 |
+    And no side effects
+
+  Scenario: min and max over zoned datetimes
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:E {ts: datetime('2020-01-01T12:00+01:00')}),
+             (:E {ts: datetime('2020-06-01T09:30+01:00')})
+      """
+    When executing query:
+      """
+      MATCH (e:E) RETURN max(e.ts).month AS mx, min(e.ts).month AS mn
+      """
+    Then the result should be, in any order:
+      | mx | mn |
+      | 6  | 1  |
+    And no side effects
+
+  Scenario: datetime truncate keeps the zone
+    Given an empty graph
+    When executing query:
+      """
+      WITH datetime.truncate('month', datetime('2015-06-24T12:30+01:00')) AS d
+      RETURN d.day AS day, d.hour AS h, d.offset AS o
+      """
+    Then the result should be, in any order:
+      | day | h | o        |
+      | 1   | 0 | '+01:00' |
+    And no side effects
+
+  Scenario: datetime plus a duration
+    Given an empty graph
+    When executing query:
+      """
+      WITH datetime('2015-06-24T12:00+01:00') + duration('P1DT2H') AS d
+      RETURN d.day AS day, d.hour AS h, d.offset AS o
+      """
+    Then the result should be, in any order:
+      | day | h  | o        |
+      | 25  | 14 | '+01:00' |
+    And no side effects
+
+  Scenario: time from a string with an offset
+    Given an empty graph
+    When executing query:
+      """
+      WITH time('12:31:14.645+01:00') AS t
+      RETURN t.hour AS h, t.minute AS m, t.second AS s,
+             t.millisecond AS ms, t.offset AS o
+      """
+    Then the result should be, in any order:
+      | h  | m  | s  | ms  | o        |
+      | 12 | 31 | 14 | 645 | '+01:00' |
+    And no side effects
+
+  Scenario: localtime accessors
+    Given an empty graph
+    When executing query:
+      """
+      WITH localtime('12:31:14.645') AS t
+      RETURN t.hour AS h, t.minute AS m, t.second AS s
+      """
+    Then the result should be, in any order:
+      | h  | m  | s  |
+      | 12 | 31 | 14 |
+    And no side effects
+
+  Scenario: time from a map
+    Given an empty graph
+    When executing query:
+      """
+      WITH time({hour: 12, minute: 31, second: 14, timezone: '+01:00'}) AS t
+      RETURN t.hour AS h, t.offsetMinutes AS off
+      """
+    Then the result should be, in any order:
+      | h  | off |
+      | 12 | 60  |
+    And no side effects
+
+  Scenario: zoned time properties stored and filtered
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:S {n: 1, at: time('09:00+01:00')}),
+             (:S {n: 2, at: time('17:30+01:00')})
+      """
+    When executing query:
+      """
+      MATCH (s:S) WHERE s.at.hour >= 12 RETURN s.n AS n
+      """
+    Then the result should be, in any order:
+      | n |
+      | 2 |
+    And no side effects
+
+  Scenario: datetime with a named zone resolves its offset
+    Given an empty graph
+    When executing query:
+      """
+      WITH datetime('2015-06-24T12:50:35[Europe/Berlin]') AS d
+      RETURN d.hour AS h, d.offsetMinutes AS off, d.timezone AS tz
+      """
+    Then the result should be, in any order:
+      | h  | off | tz              |
+      | 12 | 120 | 'Europe/Berlin' |
+    And no side effects
+
+  Scenario: Z suffix means UTC
+    Given an empty graph
+    When executing query:
+      """
+      WITH datetime('2015-06-24T12:50:35Z') AS d
+      RETURN d.offset AS o, d.offsetSeconds AS s
+      """
+    Then the result should be, in any order:
+      | o        | s |
+      | '+00:00' | 0 |
+    And no side effects

@@ -43,6 +43,82 @@ Feature: VarLengthAcceptance
       | c |
       | 2 |
 
+  Scenario: Unbounded variable length match terminates on cycles
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (a:N)-[:K]->(b:N), (b)-[:K]->(a)
+      """
+    When executing query:
+      """
+      MATCH (x)-[:K*]->(y) RETURN count(*) AS c
+      """
+    Then the result should be, in any order:
+      | c |
+      | 4 |
+    And no side effects
+
+  Scenario: Zero lower bound with unbounded upper
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:S)-[:R]->(:M)-[:R]->(:E)
+      """
+    When executing query:
+      """
+      MATCH (a:S)-[:R*0..]->(b) RETURN count(*) AS c
+      """
+    Then the result should be, in any order:
+      | c |
+      | 3 |
+    And no side effects
+
+  Scenario: Unbounded variable length with relationship list binding
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:S {v: 1})-[:R]->(:M {v: 2})-[:R]->(:E {v: 3})
+      """
+    When executing query:
+      """
+      MATCH (a:S)-[rs:R*]->(b) RETURN b.v AS v, size(rs) AS n
+      """
+    Then the result should be, in any order:
+      | v | n |
+      | 2 | 1 |
+      | 3 | 2 |
+    And no side effects
+
+  Scenario: Undirected unbounded variable length match
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:S)-[:R]->(m:M), (m)-[:R]->(:E)
+      """
+    When executing query:
+      """
+      MATCH (a:M)-[:R*]-(b) RETURN count(*) AS c
+      """
+    Then the result should be, in any order:
+      | c |
+      | 2 |
+    And no side effects
+
+  Scenario: Unbounded variable length respects rel uniqueness against fixed rels
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (x:N)-[:K]->(y:N)
+      """
+    When executing query:
+      """
+      MATCH (a)-[r:K]->(b), (c)-[rs:K*]->(d) RETURN count(*) AS c
+      """
+    Then the result should be, in any order:
+      | c |
+      | 0 |
+    And no side effects
+
   Scenario: Handling relationships that are already bound in variable length paths
     Given an empty graph
     And having executed:

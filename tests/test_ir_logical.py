@@ -108,9 +108,14 @@ def test_unknown_variable_rejected():
         ir_for("MATCH (a) RETURN b")
 
 
-def test_unbounded_var_length_rejected():
-    with pytest.raises(IRBuildError):
-        ir_for("MATCH (a)-[:KNOWS*]->(b) RETURN a")
+def test_unbounded_var_length_accepted():
+    # '*' keeps upper=None through IR; the relational layer resolves it to
+    # a fixpoint loop (the reference rejects unbounded — we execute it)
+    ir = ir_for("MATCH (a)-[:KNOWS*]->(b) RETURN a")
+    match = [b for b in ir.blocks if isinstance(b, B.MatchBlock)][0]
+    conns = list(match.pattern.topology.values())
+    assert len(conns) == 1
+    assert conns[0].lower == 1 and conns[0].upper is None
 
 
 def test_missing_return_rejected():

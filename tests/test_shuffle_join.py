@@ -10,6 +10,7 @@ from collections import Counter
 import numpy as np
 import pytest
 
+import jax
 import jax.numpy as jnp
 
 from tpu_cypher import CypherSession
@@ -118,6 +119,7 @@ def test_engine_join_on_mesh_uses_shuffle(monkeypatch):
     matches the oracle."""
     calls = {"n": 0}
     orig = SH.hash_repartition_join
+    orig_b = SH.broadcast_join
 
     def spy(*a, **k):
         out = orig(*a, **k)
@@ -125,7 +127,14 @@ def test_engine_join_on_mesh_uses_shuffle(monkeypatch):
             calls["n"] += 1
         return out
 
+    def spy_b(*a, **k):
+        out = orig_b(*a, **k)
+        if out is not None:
+            calls["n"] += 1
+        return out
+
     monkeypatch.setattr(SH, "hash_repartition_join", spy)
+    monkeypatch.setattr(SH, "broadcast_join", spy_b)
 
     rng = np.random.default_rng(5)
     n, e = 120, 400
@@ -174,4 +183,144 @@ def test_engine_join_on_mesh_uses_shuffle(monkeypatch):
         g_tpu = build(CypherSession.tpu())
         got = [dict(r) for r in g_tpu.cypher(q).records.collect()]
     assert got == want
-    assert calls["n"] >= 1, "mesh join did not route through the shuffle"
+    assert calls["n"] >= 1, "mesh join did not route through a deliberate tier"
+
+
+# ---------------------------------------------------------------------------
+# Broadcast tier: small build side replicated, probe local, NO collective
+# (VERDICT r4 §2.3 "broadcast small relations")
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize(
+    "seed,n_l,n_r,lo,hi",
+    [
+        (3, 1003, 50, 0, 40),     # small build side, duplicates both sides
+        (4, 64, 1, 0, 4),         # single-row build
+        (5, 513, 100, -50, 50),   # negative keys
+    ],
+)
+def test_broadcast_join_differential(seed, n_l, n_r, lo, hi):
+    rng = np.random.default_rng(seed)
+    lk = rng.integers(lo, hi, n_l).astype(np.int64)
+    rk = rng.integers(lo, hi, n_r).astype(np.int64)
+    lv = rng.random(n_l) < 0.9
+    rv = rng.random(n_r) < 0.9
+    want = _ground_truth(lk, lv, rk, rv)
+    with use_mesh(make_row_mesh()):
+        got = SH.broadcast_join(
+            jnp.asarray(lk), jnp.asarray(lv), jnp.asarray(rk), jnp.asarray(rv)
+        )
+    assert got is not None
+    l_rows, r_rows = got
+    have = Counter(zip(np.asarray(l_rows).tolist(), np.asarray(r_rows).tolist()))
+    assert have == want
+
+
+def test_broadcast_join_declines_large_build():
+    with use_mesh(make_row_mesh()):
+        n = SH._broadcast_limit() + 1
+        got = SH.broadcast_join(
+            jnp.arange(64, dtype=jnp.int64), None,
+            jnp.arange(n, dtype=jnp.int64), None,
+        )
+    assert got is None  # falls through to the hash shuffle
+
+
+def test_broadcast_join_hlo_has_no_collective():
+    """The point of the tier: the compiled join program contains NO
+    all_to_all / all-gather style collective (the build side is already
+    replicated; probes are purely local)."""
+    with use_mesh(make_row_mesh()) as mesh:
+        axis = mesh.axis_names[0]
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        lk = jax.device_put(
+            jnp.arange(64, dtype=jnp.int64) * 2, NamedSharding(mesh, P(axis))
+        )
+        rk = jax.device_put(
+            jnp.arange(16, dtype=jnp.int64) * 2, NamedSharding(mesh, P(None))
+        )
+        txt = SH._bcast_count_fn(mesh, axis).lower(lk, rk).compile().as_text()
+    assert "all-to-all" not in txt
+    # the count reduction gathers nsh scalars at the end; the JOIN itself
+    # must not move row data — no all_to_all anywhere is the contract
+
+
+def test_optional_match_rides_mesh_join(monkeypatch):
+    """OPTIONAL MATCH (left outer) joins now ride the deliberate mesh
+    tiers: match pairs from broadcast/shuffle, unmatched-row padding on
+    top (VERDICT r4 weak #5)."""
+    calls = {"bcast": 0, "shuffle": 0}
+    orig_b, orig_s = SH.broadcast_join, SH.hash_repartition_join
+
+    def spy_b(*a, **k):
+        out = orig_b(*a, **k)
+        if out is not None:
+            calls["bcast"] += 1
+        return out
+
+    def spy_s(*a, **k):
+        out = orig_s(*a, **k)
+        if out is not None:
+            calls["shuffle"] += 1
+        return out
+
+    monkeypatch.setattr(SH, "broadcast_join", spy_b)
+    monkeypatch.setattr(SH, "hash_repartition_join", spy_s)
+
+    create = (
+        "CREATE (a:P {v: 1})-[:K]->(:Q {w: 10}), (:P {v: 2}), "
+        "(c:P {v: 3})-[:K]->(:Q {w: 30})"
+    )
+    q = (
+        "MATCH (p:P) OPTIONAL MATCH (p)-[:K]->(x:Q) "
+        "RETURN p.v AS v, x.w AS w ORDER BY v"
+    )
+    want = [
+        dict(r)
+        for r in CypherSession.local()
+        .create_graph_from_create_query(create)
+        .cypher(q)
+        .records.collect()
+    ]
+    with use_mesh(make_row_mesh()):
+        gt = CypherSession.tpu().create_graph_from_create_query(create)
+        got = [dict(r) for r in gt.cypher(q).records.collect()]
+    assert got == want
+    assert calls["bcast"] + calls["shuffle"] >= 1
+
+
+def test_composite_key_join_rides_mesh(monkeypatch):
+    """Multi-column join keys pack into ONE mixed key for the mesh tiers;
+    every key column is post-verified (hash-collision screen)."""
+    calls = {"n": 0}
+    orig_b = SH.broadcast_join
+
+    def spy_b(*a, **k):
+        out = orig_b(*a, **k)
+        if out is not None:
+            calls["n"] += 1
+        return out
+
+    monkeypatch.setattr(SH, "broadcast_join", spy_b)
+    create = (
+        "CREATE (:L {a: 1, b: 1, s: 'x'}), (:L {a: 1, b: 2, s: 'y'}), "
+        "(:L {a: 2, b: 1, s: 'z'}), (:R {a: 1, b: 1, t: 'p'}), "
+        "(:R {a: 1, b: 2, t: 'q'}), (:R {a: 2, b: 2, t: 'r'})"
+    )
+    q = (
+        "MATCH (l:L), (r:R) WHERE l.a = r.a AND l.b = r.b "
+        "RETURN l.s AS s, r.t AS t ORDER BY s"
+    )
+    want = [
+        dict(r)
+        for r in CypherSession.local()
+        .create_graph_from_create_query(create)
+        .cypher(q)
+        .records.collect()
+    ]
+    with use_mesh(make_row_mesh()):
+        gt = CypherSession.tpu().create_graph_from_create_query(create)
+        got = [dict(r) for r in gt.cypher(q).records.collect()]
+    assert got == want

@@ -52,12 +52,39 @@ def test_column_roundtrip():
     c2 = Column.from_values(dts)
     assert c2.kind == LDT
     assert c2.to_values() == dts
-    # mixed date/datetime and zoned datetimes stay host-exact
+    # mixed date/datetime stays host-exact
     assert Column.from_values([dt.date(2020, 1, 1), dt.datetime(2020, 1, 1)]).kind == "obj"
-    assert (
-        Column.from_values([dt.datetime(2020, 1, 1, tzinfo=dt.timezone.utc)]).kind
-        == "obj"
-    )
+    # fixed-offset zoned datetimes are device columns (round 5): UTC
+    # instant lane + column-level offset metadata
+    zvals = [
+        dt.datetime(2020, 1, 1, 12, 0, tzinfo=dt.timezone.utc),
+        dt.datetime(2020, 6, 1, 9, 30, 0, 5, tzinfo=dt.timezone.utc),
+        None,
+    ]
+    cz = Column.from_values(zvals)
+    assert cz.kind == "zdt"
+    assert cz.to_values() == zvals
+    # per-row MIXED offsets and region-named zones stay host-exact: a
+    # device round-trip would lose the zone name / per-row offsets
+    mixed = [
+        dt.datetime(2020, 1, 1, tzinfo=dt.timezone.utc),
+        dt.datetime(2020, 1, 1, tzinfo=dt.timezone(dt.timedelta(hours=1))),
+    ]
+    assert Column.from_values(mixed).kind == "obj"
+    import zoneinfo
+
+    named = [dt.datetime(2020, 1, 1, tzinfo=zoneinfo.ZoneInfo("Europe/Berlin"))]
+    assert Column.from_values(named).kind == "obj"
+    # zoned/naive times
+    tz = dt.timezone(dt.timedelta(hours=1))
+    tvals = [dt.time(9, 30, tzinfo=tz), dt.time(17, 0, 0, 250, tzinfo=tz), None]
+    ct = Column.from_values(tvals)
+    assert ct.kind == "zt"
+    assert ct.to_values() == tvals
+    lvals = [dt.time(9, 30), None, dt.time(23, 59, 59, 999999)]
+    cl = Column.from_values(lvals)
+    assert cl.kind == "lt"
+    assert cl.to_values() == lvals
 
 
 CREATE = (

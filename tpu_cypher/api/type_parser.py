@@ -87,6 +87,9 @@ class _Parser:
             "NUMBER": T.CTNumber,
             "DATE": T.CTDate,
             "LOCALDATETIME": T.CTLocalDateTime,
+            "DATETIME": T.CTDateTime,
+            "LOCALTIME": T.CTLocalTime,
+            "TIME": T.CTTime,
             "DURATION": T.CTDuration,
             "PATH": T.CTPath,
             "ELEMENTID": T.CTElementId,

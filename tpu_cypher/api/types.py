@@ -197,6 +197,23 @@ class CTLocalDateTimeType(_Singleton):
     _NAME = "LOCALDATETIME"
 
 
+class CTDateTimeType(_Singleton):
+    """Zoned datetime (instant + zone offset) — reference CTDateTime; its
+    ``TemporalUdfs.scala:40`` warns on timezone loss, we keep the offset."""
+
+    _NAME = "DATETIME"
+
+
+class CTLocalTimeType(_Singleton):
+    _NAME = "LOCALTIME"
+
+
+class CTTimeType(_Singleton):
+    """Zoned time-of-day (local micros + zone offset) — reference CTTime."""
+
+    _NAME = "TIME"
+
+
 class CTDurationType(_Singleton):
     _NAME = "DURATION"
 
@@ -519,6 +536,9 @@ CTFloat = CTFloatType()
 CTNumber = CTNumberType()
 CTDate = CTDateType()
 CTLocalDateTime = CTLocalDateTimeType()
+CTDateTime = CTDateTimeType()
+CTLocalTime = CTLocalTimeType()
+CTTime = CTTimeType()
 CTDuration = CTDurationType()
 CTPath = CTPathType()
 CTElementId = CTElementIdType()
@@ -584,9 +604,11 @@ def type_of_value(value) -> CypherType:
     if isinstance(value, _v.Path):
         return CTPath
     if isinstance(value, _dt.datetime):
-        return CTLocalDateTime
+        return CTDateTime if value.tzinfo is not None else CTLocalDateTime
     if isinstance(value, _dt.date):
         return CTDate
+    if isinstance(value, _dt.time):
+        return CTTime if value.tzinfo is not None else CTLocalTime
     if isinstance(value, (list, tuple)):
         return CTListType(join_types(type_of_value(v) for v in value))
     if isinstance(value, Mapping):

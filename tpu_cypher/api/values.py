@@ -534,3 +534,13 @@ def to_cypher_string(v) -> str:
     if isinstance(v, Decimal):
         return str(v)
     return str(v)
+
+
+def format_utc_offset(total_seconds: int) -> str:
+    """'+HH:MM' (':SS' only when nonzero) — ONE formatter for zone offsets,
+    shared by the oracle accessors and the device column metadata."""
+    sign = "+" if total_seconds >= 0 else "-"
+    h, rem = divmod(abs(int(total_seconds)), 3600)
+    m, sec = divmod(rem, 60)
+    base = f"{sign}{h:02d}:{m:02d}"
+    return base + (f":{sec:02d}" if sec else "")

@@ -373,7 +373,7 @@ class Evaluator:
                 if acc is None:
                     raise CypherTypeError(f"Unknown duration accessor {key!r}")
                 return acc(v)
-            if isinstance(v, (_dt.date, _dt.datetime)):
+            if isinstance(v, (_dt.date, _dt.datetime, _dt.time)):
                 acc = TEMPORAL_ACCESSORS.get(key.lower())
                 if acc is None:
                     raise CypherTypeError(f"Unknown temporal accessor {key!r}")

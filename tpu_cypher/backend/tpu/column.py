@@ -55,6 +55,9 @@ BOOL = "bool"
 STR = "str"  # dictionary-encoded int32 codes
 DATE = "date"  # int32 days since 1970-01-01 (ref TemporalUdfs.scala:40-160)
 LDT = "ldt"  # int64 microseconds since 1970-01-01T00:00 (local, no zone)
+ZDT = "zdt"  # int64 UTC microseconds; vocab = ['+HH:MM'] column zone offset
+ZT = "zt"  # int64 UTC-adjusted micros of day; vocab = ['+HH:MM'] offset
+LT = "lt"  # int64 microseconds since midnight (local time, no zone)
 DUR = "dur"  # int64 (n, 3): months / days / total micros (seconds*1e6+us) —
 #              the reference's (months, days, seconds, nanos) Duration model
 #              (okapi-api Duration.scala) with the normalized sub-day pair
@@ -69,8 +72,11 @@ OBJ = "obj"  # host-side Python objects (lists, elements) — not device residen
 
 # temporal kinds share the integer device machinery (sort keys, joins,
 # distinct/group packing, min/max) — they differ only in decode + typing
-TEMPORAL_KINDS = (DATE, LDT)
-INTEGRAL_KINDS = (I64, BOOL, STR, DATE, LDT)
+TEMPORAL_KINDS = (DATE, LDT, ZDT, ZT, LT)
+# zoned kinds key on their single UTC-instant lane, so every packed
+# sort/group/distinct path treats them as plain integers (openCypher
+# datetime equality/order IS instant equality/order)
+INTEGRAL_KINDS = (I64, BOOL, STR, DATE, LDT, ZDT, ZT, LT)
 
 _NULL_CODE = np.int32(-1)
 
@@ -270,6 +276,57 @@ class Column:
                 dtype=np.int32,
             )
             return build(DATE, data, 0)
+        from .temporal import (
+            encode_time_of_day,
+            encode_zdt,
+            encode_zt,
+            offset_seconds_of,
+            offset_str,
+        )
+
+        # zoned datetimes/times with ONE fixed offset across the column:
+        # the UTC instant is the device lane, the offset rides as column
+        # metadata (vocab). Per-row MIXED offsets (e.g. a DST-crossing
+        # zoneinfo column) stay host-exact OBJ — the reference's
+        # TemporalUdfs warn on timezone loss; we lose nothing, we fall back.
+        if all(
+            isinstance(v, _dt.datetime) and isinstance(v.tzinfo, _dt.timezone)
+            for v in non_null
+        ):
+            # fixed-offset zones only: region-NAMED zones (zoneinfo) keep
+            # their name host-exact; a device round-trip would degrade
+            # 'Europe/Berlin' to '+02:00' (the reference's TemporalUdfs
+            # warn on exactly this loss — we avoid it instead)
+            offs = {offset_seconds_of(v) for v in non_null}
+            if len(offs) == 1:
+                off = offs.pop()
+                data = np.array(
+                    [encode_zdt(v) if v is not None else 0 for v in values],
+                    dtype=np.int64,
+                )
+                return build(ZDT, data, 0, vocab=[offset_str(off)])
+            return Column(OBJ, _obj_array(values), None)
+        if all(isinstance(v, _dt.time) for v in non_null):
+            if all(isinstance(v.tzinfo, _dt.timezone) for v in non_null):
+                offs = {offset_seconds_of(v) for v in non_null}
+                if len(offs) == 1:
+                    off = offs.pop()
+                    data = np.array(
+                        [encode_zt(v) if v is not None else 0 for v in values],
+                        dtype=np.int64,
+                    )
+                    return build(ZT, data, 0, vocab=[offset_str(off)])
+                return Column(OBJ, _obj_array(values), None)
+            if all(v.tzinfo is None for v in non_null):
+                data = np.array(
+                    [
+                        encode_time_of_day(v) if v is not None else 0
+                        for v in values
+                    ],
+                    dtype=np.int64,
+                )
+                return build(LT, data, 0)
+            return Column(OBJ, _obj_array(values), None)
         from ...api.values import Duration
 
         if all(isinstance(v, Duration) for v in non_null):
@@ -371,6 +428,22 @@ class Column:
                     decode_ldt(v) if (valid is None or valid[i]) else None
                     for i, v in enumerate(data)
                 ]
+            elif self.kind in (ZDT, ZT):
+                from .temporal import decode_zdt, decode_zt, parse_offset_str
+
+                off = parse_offset_str((self.vocab or ["+00:00"])[0])
+                dec = decode_zdt if self.kind == ZDT else decode_zt
+                vals = [
+                    dec(v, off) if (valid is None or valid[i]) else None
+                    for i, v in enumerate(data)
+                ]
+            elif self.kind == LT:
+                from .temporal import decode_lt
+
+                vals = [
+                    decode_lt(v) if (valid is None or valid[i]) else None
+                    for i, v in enumerate(data)
+                ]
             elif self.kind == DUR:
                 from ...api.values import Duration
 
@@ -458,6 +531,14 @@ class Column:
             return Column(OBJ, np.concatenate([a.data, b.data]), None)
         if a.kind == STR:
             a, b = _unify_vocab(a, b)
+        if a.kind in (ZDT, ZT) and a.vocab != b.vocab:
+            # DIFFERENT column offsets: the vocab carries one offset for
+            # the whole column, so a blind concat would silently re-zone
+            # one side's rows — keep the union host-exact instead (same
+            # policy as mixed-offset ingest)
+            a = a.to_obj()
+            b = b.to_obj()
+            return Column(OBJ, np.concatenate([a.data, b.data]), None)
         data = jnp.concatenate([a.data, b.data])
         if a.valid is None and b.valid is None:
             valid = None
@@ -552,6 +633,9 @@ class Column:
             STR: T.CTString,
             DATE: T.CTDate,
             LDT: T.CTLocalDateTime,
+            ZDT: T.CTDateTime,
+            ZT: T.CTTime,
+            LT: T.CTLocalTime,
             DUR: T.CTDuration,
             OBJ: T.CTAny,
         }[self.kind]
@@ -610,11 +694,41 @@ def constant_column(value: Any, n: int) -> Column:
             from .temporal import encode_ldt
 
             return Column(LDT, jnp.full(n, encode_ldt(value), jnp.int64), None)
-        return Column(OBJ, _obj_array([value] * n), None)
+        if not isinstance(value.tzinfo, _dt.timezone):
+            # region-named zone: keep the name host-exact (see from_values)
+            return Column(OBJ, _obj_array([value] * n), None)
+        from .temporal import encode_zdt, offset_seconds_of, offset_str
+
+        return Column(
+            ZDT,
+            jnp.full(n, encode_zdt(value), jnp.int64),
+            None,
+            [offset_str(offset_seconds_of(value))],
+        )
     if isinstance(value, _dt.date):
         from .temporal import encode_date
 
         return Column(DATE, jnp.full(n, encode_date(value), jnp.int32), None)
+    if isinstance(value, _dt.time):
+        from .temporal import (
+            encode_time_of_day,
+            encode_zt,
+            offset_seconds_of,
+            offset_str,
+        )
+
+        if value.tzinfo is None:
+            return Column(
+                LT, jnp.full(n, encode_time_of_day(value), jnp.int64), None
+            )
+        if not isinstance(value.tzinfo, _dt.timezone):
+            return Column(OBJ, _obj_array([value] * n), None)
+        return Column(
+            ZT,
+            jnp.full(n, encode_zt(value), jnp.int64),
+            None,
+            [offset_str(offset_seconds_of(value))],
+        )
     from ...api.values import Duration
 
     if isinstance(value, Duration):

@@ -34,8 +34,11 @@ from .column import (
     F64,
     I64,
     LDT,
+    LT,
     OBJ,
     STR,
+    ZDT,
+    ZT,
     Column,
     InexactPromotionError,
     TpuBackendError,
@@ -428,6 +431,51 @@ class TpuEvaluator:
                 out = time_accessor(k, tod)
             if out is None:
                 raise TpuUnsupportedExpr(f"datetime accessor {key!r}")
+            return Column(I64, out, inner.valid)
+        if inner.kind in (ZDT, ZT, LT):
+            from .temporal import US_PER_SECOND, parse_offset_str
+
+            off = parse_offset_str((inner.vocab or ["+00:00"])[0])
+            if inner.kind != LT and k in ("timezone", "offset"):
+                # column-level offset: one constant dictionary code
+                return Column(
+                    STR,
+                    jnp.zeros(self.n, jnp.int32),
+                    inner.valid,
+                    [(inner.vocab or ["+00:00"])[0]],
+                )
+            if inner.kind != LT and k == "offsetminutes":
+                return Column(
+                    I64, jnp.full(self.n, off // 60, jnp.int64), inner.valid
+                )
+            if inner.kind != LT and k == "offsetseconds":
+                return Column(
+                    I64, jnp.full(self.n, off, jnp.int64), inner.valid
+                )
+            if inner.kind == ZDT and k == "epochseconds":
+                return Column(
+                    I64,
+                    jnp.floor_divide(inner.data, US_PER_SECOND),
+                    inner.valid,
+                )
+            if inner.kind == ZDT and k == "epochmillis":
+                return Column(
+                    I64, jnp.floor_divide(inner.data, 1000), inner.valid
+                )
+            # civil fields read the LOCAL clock: shift the UTC lane by the
+            # column offset
+            local = inner.data + (0 if inner.kind == LT else off * US_PER_SECOND)
+            if inner.kind == ZDT:
+                days, tod = split_ldt(local)
+                out = date_accessor(k, days)
+                if out is None:
+                    out = time_accessor(k, tod)
+            else:
+                from .temporal import US_PER_DAY
+
+                out = time_accessor(k, local % US_PER_DAY)
+            if out is None:
+                raise TpuUnsupportedExpr(f"temporal accessor {key!r}")
             return Column(I64, out, inner.valid)
         if inner.kind == DUR:
             # integer component functions of (months, days, total micros) —

@@ -54,6 +54,53 @@ def _mxu_dense_mode() -> bool:
     return jax.default_backend() != "cpu"
 
 
+def _mxu_tiled_enabled() -> bool:
+    """The TILED MXU tier (no full dense matrix; ``jit_ops.mxu_*_tiled``)
+    engages only on EXPLICIT request (``TPU_CYPHER_MXU_DENSE=1|force``) —
+    deliberately NOT on auto: a dense product is Theta(N^3) FLOPs, so past
+    ``dense_adj``'s cap the sparse walk/stamping tiers win by orders of
+    magnitude (100k nodes ~ 1e15 bf16 FLOPs ~ minutes on one chip vs
+    sub-second sparse). The tier exists to run dense-eligible counts on
+    the systolic array at ANY node count with bit-identical results —
+    proven by the forced differential tests — not to outrace the sparse
+    tiers at scale. Node gate: ``TPU_CYPHER_MXU_TILED_MAX`` (default
+    131072, covers SF10's 100k nodes)."""
+    import os
+
+    return os.environ.get("TPU_CYPHER_MXU_DENSE", "auto") in ("1", "force")
+
+
+def _mxu_tiled_max() -> int:
+    import os
+
+    return int(os.environ.get("TPU_CYPHER_MXU_TILED_MAX", str(1 << 17)))
+
+
+# which MXU tier answered each dense-eligible count — bench.py reports the
+# per-rung tier so a perf run shows WHERE the FLOPs went
+MXU_TIER_COUNTS = {"dense": 0, "tiled": 0}
+
+# which NATIVE (C++ stamping/DFS) kernels answered — same purpose
+NATIVE_TIER_COUNTS = {"two_hop": 0, "close": 0, "varlen": 0}
+
+
+def _mxu_tiled_common(gi, ctx, hops):
+    """Shared preamble of the tiled MXU tier: gate, hop tile providers,
+    f32-exactness product term, label masks. None when the tier does not
+    apply."""
+    if not _mxu_tiled_enabled() or gi.num_nodes > _mxu_tiled_max():
+        return None
+    base, final_hop = hops[1], hops[0]
+    t1 = gi.dense_tiles(base.types_key, base.backwards, ctx)
+    t2 = gi.dense_tiles(final_hop.types_key, final_hop.backwards, ctx)
+    if t1 is None or t2 is None:
+        return None
+    npad = t1.npad
+    m_b = _pad_mask(gi.label_mask(base.far_labels, ctx), npad)
+    m_c = _pad_mask(gi.label_mask(final_hop.far_labels, ctx), npad)
+    return t1, t2, t1.max_row_sum * max(t2.max_entry, 1), m_b, m_c
+
+
 def _pad_mask(mask, npad: int):
     """Optional bool[num_nodes] label mask -> bf16 0/1[(npad,)] or None."""
     if mask is None:
@@ -678,7 +725,7 @@ class CsrExpandOp(_FusedExpandBase):
         got1 = gi.dense_adj(base.types_key, base.backwards, ctx)
         got2 = gi.dense_adj(final_hop.types_key, final_hop.backwards, ctx)
         if got1 is None or got2 is None:
-            return None
+            return self._mxu_distinct_pairs_tiled(gi, ctx, hops, id_col)
         a1, _, rowsum1 = got1
         a2, entry2, _ = got2
         if rowsum1 * entry2 > (1 << 24):
@@ -688,11 +735,27 @@ class CsrExpandOp(_FusedExpandBase):
         pres = J.frontier_multiplicity(pos, present, n=npad) > 0
         m_b = _pad_mask(gi.label_mask(base.far_labels, ctx), npad)
         m_c = _pad_mask(gi.label_mask(final_hop.far_labels, ctx), npad)
+        MXU_TIER_COUNTS["dense"] += 1
         return int(
             J.mxu_distinct_pairs(
                 a1, a2, pres, m_b, m_c, block=GraphIndex.DENSE_BLOCK
             )
         )
+
+    def _mxu_distinct_pairs_tiled(self, gi, ctx, hops, id_col):
+        """count(DISTINCT a, c) on the TILED MXU tier: densified row blocks
+        straight from the edge lists, no (Npad, Npad) matrix — the path
+        that keeps SF10-scale graphs (100k nodes) on the systolic array."""
+        got = _mxu_tiled_common(gi, ctx, hops)
+        if got is None:
+            return None
+        t1, t2, cell_bound, m_b, m_c = got
+        if cell_bound > (1 << 24):
+            return None
+        pos, present = gi.compact_of(id_col, ctx)
+        pres = J.frontier_multiplicity(pos, present, n=t1.npad) > 0
+        MXU_TIER_COUNTS["tiled"] += 1
+        return int(J.mxu_distinct_pairs_tiled(t1, t2, pres, m_b, m_c))
 
     def _native_two_hop(self, gi, ctx, hops, id_col, *, use_a, use_c):
         """Host-tier 2-hop DISTINCT count via the C++ stamping kernel
@@ -709,12 +772,15 @@ class CsrExpandOp(_FusedExpandBase):
         rp2, ci2, _ = gi.csr(final_hop.types_key, final_hop.backwards, ctx)
         m1 = gi.label_mask(base.far_labels, ctx)
         m2 = gi.label_mask(final_hop.far_labels, ctx)
-        return native.two_hop_distinct_native(
+        got = native.two_hop_distinct_native(
             np.asarray(rp1), np.asarray(ci1), np.asarray(rp2), np.asarray(ci2),
             fr, fr, gi.num_nodes, use_a, use_c,
             None if m1 is None else np.asarray(m1),
             None if m2 is None else np.asarray(m2),
         )
+        if got is not None:
+            NATIVE_TIER_COUNTS["two_hop"] += 1
+        return got
 
     def _fused_table(self):
         gi = GraphIndex.of(self.graph)
@@ -968,7 +1034,7 @@ class CsrExpandIntoOp(_FusedExpandBase):
         got2 = gi.dense_adj(final_hop.types_key, final_hop.backwards, ctx)
         gotc = gi.dense_adj(self.types_key, not src_is_base, ctx)
         if got1 is None or got2 is None or gotc is None:
-            return None
+            return self._mxu_close_count_tiled(gi, ctx, hops, id_col, src_is_base)
         a1, _, rowsum1 = got1
         a2, entry2, _ = got2
         cm, entry_c, _ = gotc
@@ -982,11 +1048,27 @@ class CsrExpandIntoOp(_FusedExpandBase):
         mult = J.frontier_multiplicity(pos, present, n=npad)
         m_b = _pad_mask(gi.label_mask(base.far_labels, ctx), npad)
         m_c = _pad_mask(gi.label_mask(final_hop.far_labels, ctx), npad)
+        MXU_TIER_COUNTS["dense"] += 1
         return int(
             J.mxu_close_count(
                 a1, a2, cm, mult, m_b, m_c, block=GraphIndex.DENSE_BLOCK
             )
         )
+
+    def _mxu_close_count_tiled(self, gi, ctx, hops, id_col, src_is_base):
+        """Triangle/cycle close count on the TILED MXU tier (see
+        ``_mxu_distinct_pairs_tiled``)."""
+        got = _mxu_tiled_common(gi, ctx, hops)
+        if got is None:
+            return None
+        t1, t2, cell_bound, m_b, m_c = got
+        tc = gi.dense_tiles(self.types_key, not src_is_base, ctx)
+        if tc is None or cell_bound * max(tc.max_entry, 1) > (1 << 24):
+            return None
+        pos, present = gi.compact_of(id_col, ctx)
+        mult = J.frontier_multiplicity(pos, present, n=t1.npad)
+        MXU_TIER_COUNTS["tiled"] += 1
+        return int(J.mxu_close_count_tiled(t1, t2, tc, mult, m_b, m_c))
 
     def _native_close_count(self, gi, ctx, hops, id_col, src_is_base):
         """Host-tier triangle/cycle close count via the C++ stamping kernel
@@ -1006,13 +1088,16 @@ class CsrExpandIntoOp(_FusedExpandBase):
         rpc, cic, _ = gi.csr(self.types_key, not src_is_base, ctx)
         m1 = gi.label_mask(base.far_labels, ctx)
         m2 = gi.label_mask(final_hop.far_labels, ctx)
-        return native.two_hop_close_count_native(
+        got = native.two_hop_close_count_native(
             np.asarray(rp1), np.asarray(ci1), np.asarray(rp2), np.asarray(ci2),
             np.asarray(rpc), np.asarray(cic),
             fr, fr, gi.num_nodes,
             None if m1 is None else np.asarray(m1),
             None if m2 is None else np.asarray(m2),
         )
+        if got is not None:
+            NATIVE_TIER_COUNTS["close"] += 1
+        return got
 
     def _fused_table(self):
         if not self.header.expressions:
@@ -1238,6 +1323,15 @@ class CsrVarExpandOp(_FusedExpandBase):
             out.append(J.rel_rows_of_ids(sorted_ids, perm, col.data, col.valid))
         return tuple(out)
 
+    def _resolved_upper(self, ci) -> int:
+        """Unbounded '*' resolves to the matching-edge count: relationship
+        isomorphism bounds any duplicate-free walk by the number of edges,
+        and both walk loops exit at the empty-frontier fixpoint long before
+        that in practice."""
+        if self.upper is not None:
+            return self.upper
+        return max(int(np.asarray(ci).shape[0]), self.lower, 1)
+
     def _native_varlen_count(self, rp, ci, eo, pos, present, row_map, forbid):
         """count(*) of bounded var-length walks via the C++ DFS kernel;
         None when unavailable (callers keep the device frontier loop)."""
@@ -1264,10 +1358,11 @@ class CsrVarExpandOp(_FusedExpandBase):
         )
         got = native.varlen_count_native(
             np.asarray(rp), np.asarray(ci), np.asarray(eo), fr,
-            max(1, self.lower), self.upper, mask, fb,
+            max(1, self.lower), self._resolved_upper(ci), mask, fb,
         )
         if got is None:
             return None
+        NATIVE_TIER_COUNTS["varlen"] += 1
         return total + got
 
     def _fused_table(self):
@@ -1329,7 +1424,7 @@ class CsrVarExpandOp(_FusedExpandBase):
                 if k:
                     idx = J.mask_nonzero(keep, size=k)
                     levels.append(J.tree_take((row00, far), idx))
-        for level in range(1, self.upper + 1):
+        for level in range(1, self._resolved_upper(ci) + 1):
             deg, t_dev = J.expand_degrees_total(rp, pos, present)
             total = int(t_dev)
             if total == 0:

@@ -908,6 +908,65 @@ def mxu_distinct_pairs(a1, a2, present, mask_b, mask_c, block: int):
     )
 
 
+@jax.jit
+def _mxu_tile_acc(p2, a1_slice, a2_k):
+    """One (block, block) @ (block, Npad) contraction step, f32 accumulate."""
+    return p2 + jnp.dot(a1_slice, a2_k, preferred_element_type=jnp.float32)
+
+
+@jax.jit
+def _mxu_close_finish(p2, c_i, mask_c, mult_i):
+    prod = p2 * c_i.astype(jnp.float32) * mask_c[None, :].astype(jnp.float32)
+    row = jnp.sum(prod.astype(jnp.float64), axis=1)
+    return jnp.sum(jnp.round(row).astype(jnp.int64) * mult_i)
+
+
+@jax.jit
+def _mxu_distinct_finish(p2, mask_c, pres_i):
+    hit = (p2 > 0.5) & (mask_c[None, :] > 0.5) & pres_i[:, None]
+    return jnp.sum(hit.astype(jnp.int64))
+
+
+def _mxu_tiled_p2(t1, t2, mask_b):
+    """Shared tiled contraction: yields each row block's (i, P2_i) where
+    P2_i = (A1[Bi, :] masked) @ A2 accumulated in f32, one (block, block)
+    @ (block, Npad) MXU matmul per k — no (Npad, Npad) matrix resident."""
+    block, npad, nb = t1.block, t1.npad, t1.nblocks
+    mb = jnp.ones(npad, jnp.bfloat16) if mask_b is None else mask_b
+    for i in range(nb):
+        a1_i = t1.tile(i) * mb[None, :]
+        p2 = jnp.zeros((block, npad), jnp.float32)
+        for k in range(nb):
+            a1_slice = lax.dynamic_slice_in_dim(a1_i, k * block, block, 1)
+            p2 = _mxu_tile_acc(p2, a1_slice, t2.tile(k))
+        yield i, p2
+
+
+def mxu_close_count_tiled(t1, t2, tc, mult, mask_b, mask_c):
+    """Tiled variant of ``mxu_close_count``: the three adjacencies arrive
+    as ``DenseTiles`` row-block providers. Lifts the dense tier's
+    node-count cap (graphs larger than ``dense_adj``'s limit still ride
+    the MXU)."""
+    block = t1.block
+    mc = jnp.ones(t1.npad, jnp.bfloat16) if mask_c is None else mask_c
+    acc = 0
+    for i, p2 in _mxu_tiled_p2(t1, t2, mask_b):
+        mult_i = lax.dynamic_slice_in_dim(mult, i * block, block, 0)
+        acc += int(_mxu_close_finish(p2, tc.tile(i), mc, mult_i))
+    return acc
+
+
+def mxu_distinct_pairs_tiled(t1, t2, present, mask_b, mask_c):
+    """Tiled variant of ``mxu_distinct_pairs`` (see above)."""
+    block = t1.block
+    mc = jnp.ones(t1.npad, jnp.bfloat16) if mask_c is None else mask_c
+    acc = 0
+    for i, p2 in _mxu_tiled_p2(t1, t2, mask_b):
+        pres_i = lax.dynamic_slice_in_dim(present, i * block, block, 0)
+        acc += int(_mxu_distinct_finish(p2, mc, pres_i))
+    return acc
+
+
 @partial(jax.jit, static_argnames=("k", "name"))
 def segment_duration_agg(data, valid, seg, k: int, name: str):
     """Duration aggregates over the (months, days, micros) device triple —

@@ -390,19 +390,52 @@ class TpuTable(Table):
         lvalids = l_extra_valid + ((lk.valid,) if lk.valid is not None else ())
         rvalids = r_extra_valid + ((rk.valid,) if rk.valid is not None else ())
         left_rows = right_rows = None
-        if kind == "inner" and lk.kind == I64 and rk.kind == I64:
-            # mesh path: DELIBERATE hash-repartition join (all_to_all
-            # shuffle + per-shard local joins — the engines' shuffled hash
-            # join, SparkTable.scala:178) instead of relying on GSPMD to
-            # partition a global sort. None = no mesh / bucket overflow.
-            from ...parallel.shuffle import hash_repartition_join
+        packed_all_keys = False
+        if (
+            kind in ("inner", "left_outer", "full_outer")
+            and lk.kind == I64
+            and rk.kind == I64
+        ):
+            # mesh path: the broadcast tier when the build side is small
+            # (replicate + local probe, NO collective), else the DELIBERATE
+            # hash-repartition join (all_to_all shuffle + per-shard local
+            # joins — the engines' shuffled hash join, SparkTable.scala:178)
+            # instead of relying on GSPMD to partition a global sort.
+            # None = no mesh / bucket overflow. Outer shapes ride the same
+            # match pairs: the unmatched-row padding downstream is
+            # tier-independent.
+            from ...parallel.shuffle import (
+                broadcast_join,
+                combine_keys,
+                hash_repartition_join,
+            )
 
             lv = _fold_valids(lvalids)
             rv = _fold_valids(rvalids)
-            got = hash_repartition_join(lk.data, lv, rk.data, rv)
+            lkd, rkd = lk.data, rk.data
+            if len(join_cols) > 1 and all(
+                self._cols[l].kind == I64 and other._cols[r].kind == I64
+                for l, r in join_cols[1:]
+            ):
+                # composite keys: shuffle/broadcast on ONE mixed key over
+                # all columns (avoids first-key blowup when the leading key
+                # is low-cardinality); hash collisions are screened by the
+                # post-verification of EVERY key column below
+                lkd = combine_keys(
+                    (lkd,) + tuple(self._cols[l].data for l, _ in join_cols[1:])
+                )
+                rkd = combine_keys(
+                    (rkd,) + tuple(other._cols[r].data for _, r in join_cols[1:])
+                )
+                packed_all_keys = True
+            got = broadcast_join(lkd, lv, rkd, rv)
+            if got is None:
+                got = hash_repartition_join(lkd, lv, rkd, rv)
             if got is not None:
                 left_rows, right_rows = got
                 total = int(left_rows.shape[0])
+            else:
+                packed_all_keys = False
         if left_rows is None:
             is_f64 = lk.kind == F64
             is_bool = lk.kind == BOOL
@@ -417,10 +450,13 @@ class TpuTable(Table):
             total = int(total_dev)
             # phase 3: materialize match pairs (one dispatch, static total)
             left_rows, right_rows = J.join_materialize(r_idx_valid, lo, counts, total=total)
-        if len(join_cols) > 1 and total:
+        # packed-key matches verify EVERY key column (hash collisions);
+        # otherwise the probe key matched exactly and only extras need it
+        post_cols = join_cols if packed_all_keys else join_cols[1:]
+        if post_cols and total:
             never_match = False
             l_datas, l_valids2, r_datas, r_valids2, kinds = [], [], [], [], []
-            for (lcn, rcn) in join_cols[1:]:
+            for (lcn, rcn) in post_cols:
                 lc, rc = self._cols[lcn], other._cols[rcn]
                 if lc.kind == STR or rc.kind == STR:
                     if lc.kind != STR or rc.kind != STR:

@@ -41,6 +41,73 @@ def encode_ldt(dt: _dt.datetime) -> int:
     return days * US_PER_DAY + tod
 
 
+US_PER_HOUR = 3600 * US_PER_SECOND
+
+
+def offset_seconds_of(v) -> int:
+    """Fixed zone offset of an aware datetime/time value, in seconds."""
+    off = v.utcoffset()
+    return int(off.total_seconds())
+
+
+def offset_str(off_seconds: int) -> str:
+    from ...api.values import format_utc_offset
+
+    return format_utc_offset(off_seconds)
+
+
+def parse_offset_str(s: str) -> int:
+    sign = -1 if s.startswith("-") else 1
+    parts = s.lstrip("+-").split(":")
+    total = int(parts[0]) * 3600 + int(parts[1]) * 60
+    if len(parts) > 2:
+        total += int(parts[2])
+    return sign * total
+
+
+def encode_zdt(v: _dt.datetime) -> int:
+    """Aware datetime -> UTC microseconds since epoch (the device lane;
+    the column-level offset rides separately)."""
+    off = offset_seconds_of(v)
+    return encode_ldt(v.replace(tzinfo=None)) - off * US_PER_SECOND
+
+
+def decode_zdt(utc_us: int, off_seconds: int) -> _dt.datetime:
+    local = decode_ldt(int(utc_us) + off_seconds * US_PER_SECOND)
+    return local.replace(
+        tzinfo=_dt.timezone(_dt.timedelta(seconds=off_seconds))
+    )
+
+
+def encode_time_of_day(t: _dt.time) -> int:
+    return (
+        (t.hour * 3600 + t.minute * 60 + t.second) * US_PER_SECOND
+        + t.microsecond
+    )
+
+
+def encode_zt(t: _dt.time) -> int:
+    """Aware time -> UTC-adjusted micros of day (comparable instants;
+    wraps modulo 24h the way zoned times order on the clock face)."""
+    off = offset_seconds_of(t)
+    return (encode_time_of_day(t) - off * US_PER_SECOND) % US_PER_DAY
+
+
+def decode_zt(adj_us: int, off_seconds: int) -> _dt.time:
+    local = (int(adj_us) + off_seconds * US_PER_SECOND) % US_PER_DAY
+    return decode_lt(local).replace(
+        tzinfo=_dt.timezone(_dt.timedelta(seconds=off_seconds))
+    )
+
+
+def decode_lt(us: int) -> _dt.time:
+    us = int(us)
+    secs, micro = divmod(us, US_PER_SECOND)
+    h, rem = divmod(secs, 3600)
+    m, sec = divmod(rem, 60)
+    return _dt.time(h % 24, m, sec, micro)
+
+
 def decode_ldt(us: int) -> _dt.datetime:
     days, tod = divmod(int(us), US_PER_DAY)
     secs, micro = divmod(tod, US_PER_SECOND)

@@ -40,8 +40,6 @@ QUANTIFIERS = {"any", "all", "none", "single"}
 
 _CLAUSE_STARTS = {
     "MATCH",
-    "CALL",
-    "YIELD",
     "OPTIONAL",
     "WITH",
     "RETURN",

@@ -292,14 +292,29 @@ class FSGraphSource(PropertyGraphDataSource):
         with open(os.path.join(d, METADATA_FILE), "w") as f:
             json.dump({"format": self.fmt, "version": 1}, f)
         ctx = _plain_ctx(graph)
-        for combo in schema.label_combinations:
-            df, types = canonical_node_columns(graph, combo, ctx)
-            self._write_df(df, types, self._part(os.path.join(d, "nodes", _combo_dir(combo))))
-        for rt in schema.relationship_types:
-            df, types = canonical_rel_columns(graph, rt, ctx)
-            self._write_df(
-                df, types, self._part(os.path.join(d, "relationships", _rel_dir(rt)))
-            )
+        # table EXTRACTION stays serial (it drives the device); each file
+        # WRITE is submitted to a thread pool AS extracted, so at most
+        # pool-depth DataFrames are live at once and failures propagate
+        # after all complete — the reference's async write discipline
+        # (``AbstractPropertyGraphDataSource.scala:186``)
+        from concurrent.futures import ThreadPoolExecutor
+
+        with ThreadPoolExecutor(max_workers=8) as pool:
+            futures = []
+            for combo in schema.label_combinations:
+                df, types = canonical_node_columns(graph, combo, ctx)
+                path = self._part(os.path.join(d, "nodes", _combo_dir(combo)))
+                futures.append(pool.submit(self._write_df, df, types, path))
+                del df
+            for rt in schema.relationship_types:
+                df, types = canonical_rel_columns(graph, rt, ctx)
+                path = self._part(
+                    os.path.join(d, "relationships", _rel_dir(rt))
+                )
+                futures.append(pool.submit(self._write_df, df, types, path))
+                del df
+            for f in futures:
+                f.result()  # re-raises the worker's exception
 
     def graph(self, name: str, session):
         schema = self.schema(name)

@@ -324,12 +324,13 @@ class IRBuilder:
                 if rp.length is None:
                     lo, hi = 1, 1
                 else:
+                    # hi None = unbounded '*' — resolved at relational
+                    # planning to the matching-edge count (relationship
+                    # isomorphism bounds any walk by the number of edges),
+                    # with the frontier loop exiting at the empty-frontier
+                    # fixpoint. The reference REJECTS unbounded (flink
+                    # scenario_blacklist:6-7) — we execute it.
                     lo, hi = rp.length
-                    if hi is None:
-                        raise IRBuildError(
-                            "Unbounded variable-length patterns are not supported; "
-                            "specify an upper bound (e.g. *1..10)"
-                        )
                 ir.topology[rname] = Connection(src, dst, direction, lo, hi)
                 if rp.properties is not None:
                     var = E.Var(rname).with_type(rt)
@@ -638,6 +639,14 @@ class IRBuilder:
                         clones.append((n, n))
                         cloned.add(n)
                     continue
+                prev = new_pattern.node_types.get(n)
+                if prev is not None:
+                    # the same new node re-referenced by a later NEW clause:
+                    # label sets UNION (overwriting would drop the first
+                    # declaration's labels)
+                    t = T.CTNodeType(
+                        prev.material.labels | t.material.labels
+                    )
                 new_pattern.node_types[n] = t
             for r, t in ir.rel_types.items():
                 new_pattern.rel_types[r] = t
@@ -871,8 +880,21 @@ class IRBuilder:
                 t = T.CTAny.nullable
             else:
                 t = dict(m.fields).get(key, T.CTNull)
-        elif isinstance(m, (T.CTDateType, T.CTLocalDateTimeType)):
-            t = T.CTInteger
+        elif isinstance(
+            m,
+            (
+                T.CTDateType,
+                T.CTLocalDateTimeType,
+                T.CTDateTimeType,
+                T.CTTimeType,
+                T.CTLocalTimeType,
+            ),
+        ):
+            t = (
+                T.CTString
+                if key.lower() in ("timezone", "offset")
+                else T.CTInteger
+            )
         elif isinstance(m, T.CTDurationType):
             t = T.CTInteger
         elif isinstance(m, T.CTListType):

@@ -402,6 +402,130 @@ def _f_localdatetime(v=None):
     raise CypherTypeError("localdatetime() expects a string or map")
 
 
+def _tzinfo_of(spec: str) -> _dt.tzinfo:
+    """'+01:00' / 'Z' fixed offsets, else an IANA name via zoneinfo (the
+    reference resolves zone ids on the JVM; ``TemporalUdfs.scala:40``)."""
+    s = spec.strip()
+    if s in ("Z", "z", "UTC"):
+        return _dt.timezone.utc
+    if s and s[0] in "+-":
+        t = _dt.datetime.fromisoformat(f"2000-01-01T00:00:00{s}")
+        return t.tzinfo
+    from zoneinfo import ZoneInfo
+
+    return ZoneInfo(s)
+
+
+def _f_datetime(v=None):
+    """Zoned datetime (reference CTDateTime / TemporalUdfs): ISO strings
+    with offsets, 'Z', or a bracketed zone name; maps with a ``timezone``
+    key (DST-correct via zoneinfo); epoch selectors."""
+    if v is None:
+        return _dt.datetime.now(_dt.timezone.utc)
+    if isinstance(v, _dt.datetime):
+        return v if v.tzinfo is not None else v.replace(tzinfo=_dt.timezone.utc)
+    if isinstance(v, str):
+        s = v.strip()
+        zone = None
+        if s.endswith("]") and "[" in s:
+            s, _, z = s.rpartition("[")
+            zone = _tzinfo_of(z[:-1])
+        if s.endswith(("Z", "z")):
+            s = s[:-1] + "+00:00"
+        out = _dt.datetime.fromisoformat(s)
+        if zone is not None:
+            if out.tzinfo is None:
+                out = out.replace(tzinfo=zone)
+            else:
+                out = out.astimezone(zone)
+        elif out.tzinfo is None:
+            out = out.replace(tzinfo=_dt.timezone.utc)
+        return out
+    if isinstance(v, dict):
+        v = {k.lower(): x for k, x in v.items()}
+        tz = _tzinfo_of(str(v.get("timezone", "UTC")))
+        if "epochseconds" in v or "epochmillis" in v:
+            us = int(v.get("epochseconds", 0)) * 1_000_000
+            us += int(v.get("epochmillis", 0)) * 1000
+            return _dt.datetime.fromtimestamp(us / 1e6, _dt.timezone.utc).astimezone(tz)
+        return _dt.datetime(
+            int(v.get("year", 1)),
+            int(v.get("month", 1)),
+            int(v.get("day", 1)),
+            int(v.get("hour", 0)),
+            int(v.get("minute", 0)),
+            int(v.get("second", 0)),
+            int(v.get("millisecond", 0)) * 1000 + int(v.get("microsecond", 0)),
+            tzinfo=tz,
+        )
+    raise CypherTypeError("datetime() expects a string or map")
+
+
+def _parse_time_body(s: str) -> _dt.time:
+    if len(s) == 2:
+        s += ":00"
+    elif len(s) == 4 and ":" not in s:
+        s = s[:2] + ":" + s[2:]
+    elif len(s) == 6 and ":" not in s:
+        s = s[:2] + ":" + s[2:4] + ":" + s[4:]
+    return _dt.time.fromisoformat(s)
+
+
+def _f_time(v=None):
+    if v is None:
+        return _dt.datetime.now(_dt.timezone.utc).timetz()
+    if isinstance(v, _dt.time):
+        return v if v.tzinfo is not None else v.replace(tzinfo=_dt.timezone.utc)
+    if isinstance(v, str):
+        s = v.strip()
+        if s.endswith(("Z", "z")):
+            s = s[:-1] + "+00:00"
+        out = _parse_time_body(s)
+        if out.tzinfo is None:
+            out = out.replace(tzinfo=_dt.timezone.utc)
+        return out
+    if isinstance(v, dict):
+        v = {k.lower(): x for k, x in v.items()}
+        tz = _tzinfo_of(str(v.get("timezone", "UTC")))
+        # named zones resolve their offset against the CURRENT date (the
+        # Neo4j rule) — a fixed reference date would freeze DST
+        off = tz.utcoffset(_dt.datetime.now())
+        return _dt.time(
+            int(v.get("hour", 0)),
+            int(v.get("minute", 0)),
+            int(v.get("second", 0)),
+            int(v.get("millisecond", 0)) * 1000 + int(v.get("microsecond", 0)),
+            tzinfo=_dt.timezone(off),
+        )
+    raise CypherTypeError("time() expects a string or map")
+
+
+def _f_localtime(v=None):
+    if v is None:
+        return _dt.datetime.now().time()
+    if isinstance(v, _dt.time):
+        return v.replace(tzinfo=None)
+    if isinstance(v, str):
+        return _parse_time_body(v.strip())
+    if isinstance(v, dict):
+        v = {k.lower(): x for k, x in v.items()}
+        return _dt.time(
+            int(v.get("hour", 0)),
+            int(v.get("minute", 0)),
+            int(v.get("second", 0)),
+            int(v.get("millisecond", 0)) * 1000 + int(v.get("microsecond", 0)),
+        )
+    raise CypherTypeError("localtime() expects a string or map")
+
+
+def _f_datetime_truncate(unit, v):
+    if not isinstance(v, _dt.datetime) or v.tzinfo is None:
+        raise CypherTypeError("datetime.truncate() expects a zoned datetime")
+    tz = v.tzinfo
+    out = _truncate_temporal(unit, v.replace(tzinfo=None), allow_sub_day=True)
+    return out.replace(tzinfo=tz)
+
+
 def _f_duration(v):
     if isinstance(v, str):
         return _parse_iso_duration(v)
@@ -576,10 +700,14 @@ def _f_duration_inseconds(a, b):
 
 _register("date", _f_date, T.CTDate, min_args=0, max_args=1)
 _register("localdatetime", _f_localdatetime, T.CTLocalDateTime, min_args=0, max_args=1)
+_register("datetime", _f_datetime, T.CTDateTime, min_args=0, max_args=1)
+_register("time", _f_time, T.CTTime, min_args=0, max_args=1)
+_register("localtime", _f_localtime, T.CTLocalTime, min_args=0, max_args=1)
 _register("date.truncate", _f_date_truncate, T.CTDate, min_args=2)
 _register(
     "localdatetime.truncate", _f_ldt_truncate, T.CTLocalDateTime, min_args=2
 )
+_register("datetime.truncate", _f_datetime_truncate, T.CTDateTime, min_args=2)
 _register("duration", _f_duration, T.CTDuration)
 _register("duration.between", _f_duration_between, T.CTDuration, min_args=2)
 _register("duration.inmonths", _f_duration_inmonths, T.CTDuration, min_args=2)
@@ -603,7 +731,49 @@ TEMPORAL_ACCESSORS: Dict[str, Callable] = {
     "second": lambda d: d.second,
     "millisecond": lambda d: d.microsecond // 1000,
     "microsecond": lambda d: d.microsecond,
+    # zone accessors (aware datetime/time only — zoneless values raise a
+    # typed CypherTypeError, never a raw AttributeError)
+    "timezone": lambda d: _zone_name(d),
+    "offset": lambda d: _offset_str(d),
+    "offsetminutes": lambda d: _offset_total_seconds(d) // 60,
+    "offsetseconds": lambda d: _offset_total_seconds(d),
+    "epochseconds": lambda d: _epoch_micros(d) // 1_000_000,
+    "epochmillis": lambda d: _epoch_micros(d) // 1000,
 }
+
+
+def _offset_total_seconds(d) -> int:
+    off = getattr(d, "utcoffset", lambda: None)()
+    if off is None:
+        raise CypherTypeError(
+            f"offset accessor on a zoneless temporal {d!r}"
+        )
+    return int(off.total_seconds())
+
+
+def _epoch_micros(d) -> int:
+    # aware datetimes ONLY: a naive value's timestamp() would silently
+    # read the HOST machine's timezone — nondeterministic across machines
+    if not isinstance(d, _dt.datetime) or d.tzinfo is None:
+        raise CypherTypeError(
+            f"epoch accessor on a non-zoned temporal {d!r}"
+        )
+    delta = d - _dt.datetime(1970, 1, 1, tzinfo=_dt.timezone.utc)
+    return (delta.days * 86400 + delta.seconds) * 1_000_000 + delta.microseconds
+
+
+def _offset_str(d) -> str:
+    from ..api.values import format_utc_offset
+
+    return format_utc_offset(_offset_total_seconds(d))
+
+
+def _zone_name(d) -> str:
+    tz = getattr(d, "tzinfo", None)
+    if tz is None:
+        raise CypherTypeError("timezone accessor on a zoneless temporal")
+    key = getattr(tz, "key", None)  # zoneinfo.ZoneInfo region name
+    return key if key is not None else _offset_str(d)
 
 DURATION_ACCESSORS: Dict[str, Callable] = {
     "years": lambda d: d.months // 12,

@@ -329,9 +329,14 @@ class Optional(BinaryOp):
 @dataclass(frozen=True)
 class ExistsSubQuery(BinaryOp):
     """rhs existence flag bound to ``target_field`` (reference
-    ``ExistsSubQuery``, planned as semijoin flag ``RelationalPlanner.scala:224-246``)."""
+    ``ExistsSubQuery``, planned as semijoin flag ``RelationalPlanner.scala:224-246``).
+
+    ``correlated``: the lhs fields the subquery actually references — the
+    semijoin key. Joining on ALL common columns would break under null
+    outer columns (OPTIONAL MATCH): null keys never match."""
 
     target_field: str
+    correlated: Tuple[str, ...] = ()
 
     @property
     def fields(self) -> FieldsT:
@@ -350,6 +355,7 @@ class PatternComprehension(BinaryOp):
     projection: Expr
     target_field: str
     list_type: CypherType
+    correlated: Tuple[str, ...] = ()
 
     @property
     def fields(self) -> FieldsT:
@@ -407,7 +413,7 @@ class BoundedVarLengthExpand(BinaryOp):
     target: str
     direction: str
     lower: int
-    upper: int
+    upper: Opt[int]  # None = unbounded '*' (resolved at relational planning)
     # when a named path spans this rel, intermediate hop nodes are captured
     # (per-hop node-scan joins + hidden companion list column) so the path
     # value carries full node elements, not id-only placeholders

@@ -302,10 +302,8 @@ class LogicalPlanner:
             new_node = c.target if src_solved else c.source
             scan = L.NodeScan(L.Start(graph, ()), new_node, pattern.node_types[new_node])
             return L.Expand(plan, scan, c.source, rel, rel_type, c.target, c.direction)
-        # var-length
+        # var-length; upper None = unbounded, resolved at relational planning
         upper = c.upper
-        if upper is None:
-            raise LogicalPlanningError("Unbounded var-length expand not supported")
         capture = any(rel in fields for fields in pattern.paths.values())
         if dst_solved and not src_solved:
             # the walk reached this connection from its TARGET: the classic
@@ -362,20 +360,43 @@ class LogicalPlanner:
                 raise LogicalPlanningError(
                     f"{type(ep).__name__} missing IR pattern"
                 )
+            # the lhs fields the subquery actually references: pattern vars
+            # plus free vars of its predicates/projection (including inside
+            # nested subquery bodies). These are the semijoin/group keys —
+            # joining on ALL common columns breaks under null outer columns
+            # (OPTIONAL MATCH): null keys never match, silently emptying
+            # the subquery result
+            lhs_fields = {n for n, _ in plan.fields}
+            used = (
+                set(sub_pattern.node_types)
+                | set(sub_pattern.rel_types)
+                | set(sub_pattern.paths)
+            )
+            for p in getattr(ep, "_ir_predicates", ()):
+                used |= _subquery_free_vars(p)
             if isinstance(ep, E.ExistsPattern):
+                correlated = tuple(sorted(used & lhs_fields))
                 target = ep.target_field or self.fresh("exists")
                 rhs = self._plan_pattern(sub_pattern, plan)
                 for p in getattr(ep, "_ir_predicates", ()):
                     rhs = self._plan_predicate(p, rhs)
-                plan = L.ExistsSubQuery(plan, rhs, target)
+                plan = L.ExistsSubQuery(plan, rhs, target, correlated)
                 mapping[ep] = E.Var(target).with_type(T.CTBoolean)
                 continue
             target = ep.target_field or self.fresh("pc")
-            # expand from DISTINCT outer rows: bag-duplicate lhs rows (UNWIND
-            # [1,1] ...) must not multiply the collected list — the list
-            # depends only on the correlated bindings, and the join-back
-            # re-attaches it to every duplicate
-            dedup = L.Distinct(plan, tuple(n for n, _ in plan.fields))
+            used |= _subquery_free_vars(ep._ir_projection)
+            correlated = tuple(sorted(used & lhs_fields))
+            # expand from outer rows deduplicated on the CORRELATED fields
+            # (the collect group keys): outer rows that are distinct in
+            # other columns but share the correlated bindings must drive
+            # the pattern exactly once, or the collected list is inflated
+            # by the duplicate count. An UNcorrelated comprehension is
+            # driven by a single row (DistinctOp treats an empty field list
+            # as distinct-over-all, which would keep the duplicates).
+            if correlated:
+                dedup: L.LogicalOperator = L.Distinct(plan, correlated)
+            else:
+                dedup = L.Limit(plan, E.Lit(1).with_type(T.CTInteger))
             rhs = self._plan_pattern(sub_pattern, dedup)
             for pname, fields in sorted(sub_pattern.paths.items()):
                 rhs = L.BindPath(rhs, pname, tuple(fields))
@@ -384,7 +405,9 @@ class LogicalPlanner:
             # nested comprehensions/exists in the projection extract into rhs
             proj, rhs = self._extract_exists(ep._ir_projection, rhs)
             list_type = T.CTListType(proj.cypher_type)
-            plan = L.PatternComprehension(plan, rhs, proj, target, list_type)
+            plan = L.PatternComprehension(
+                plan, rhs, proj, target, list_type, correlated
+            )
             mapping[ep] = E.Var(target).with_type(list_type)
         if mapping:
             expr = E.substitute(expr, mapping)
@@ -393,6 +416,32 @@ class LogicalPlanner:
     def _plan_predicate(self, pred: E.Expr, plan: L.LogicalOperator) -> L.LogicalOperator:
         pred, plan = self._extract_exists(pred, plan)
         return L.Filter(plan, pred)
+
+
+def _subquery_free_vars(expr: E.Expr) -> set:
+    """Variable names an expression references, INCLUDING inside nested
+    subquery bodies (exists patterns / pattern comprehensions), whose inner
+    expressions are boxed away from generic traversal."""
+    out = set()
+    stack = [expr]
+    while stack:
+        e = stack.pop()
+        for n in e.iter_nodes():
+            if isinstance(n, E.Var):
+                out.add(n.name)
+            if isinstance(n, (E.ExistsPattern, E.PatternComprehension)):
+                sub = getattr(n, "_ir_pattern", None)
+                if sub is not None:
+                    out |= (
+                        set(sub.node_types)
+                        | set(sub.rel_types)
+                        | set(sub.paths)
+                    )
+                stack.extend(getattr(n, "_ir_predicates", ()))
+                inner = getattr(n, "_ir_projection", None)
+                if inner is not None:
+                    stack.append(inner)
+    return out
 
 
 def plan_logical(ir, ctx: Opt[LogicalPlannerContext] = None) -> L.LogicalOperator:

@@ -66,6 +66,21 @@ def _mix64(k):
     return k ^ (k >> jnp.uint64(31))
 
 
+@jax.jit
+def combine_keys(keys):
+    """Fold several int64 key columns into ONE mixed 61-bit join key (the
+    composite-key shuffle/broadcast path; the reference serializes
+    multi-column keys via codegen ``Serialize.scala``). Collisions are
+    possible — callers MUST post-verify every key column on the matched
+    pairs."""
+    acc = jnp.zeros(keys[0].shape, jnp.uint64)
+    for k in keys:
+        acc = acc * jnp.uint64(0x9E3779B97F4A7C15) ^ k.astype(jnp.uint64)
+        acc = (acc ^ (acc >> jnp.uint64(30))) * jnp.uint64(0xBF58476D1CE4E5B9)
+        acc = acc ^ (acc >> jnp.uint64(31))
+    return (acc & jnp.uint64((1 << 61) - 1)).astype(jnp.int64)
+
+
 def _bucketize(keys, rows, nsh: int, cap: int, pad_key: int, axis: str):
     """Route (key, global row) pairs to shard ``mix(key) % nsh`` with ONE
     tiled all_to_all. Keys arrive doubled (even); ``pad_key`` is this
@@ -195,6 +210,138 @@ def _pad_sharded(arr_np: np.ndarray, nsh: int, fill, mesh, axis):
             [arr_np, np.full(pad, fill, dtype=arr_np.dtype)]
         )
     return jax.device_put(arr_np, NamedSharding(mesh, P(axis)))
+
+
+_BCAST_COUNT_CACHE: Dict[Any, Any] = {}
+_BCAST_MAT_CACHE: Dict[Any, Any] = {}
+
+
+def _broadcast_limit() -> int:
+    import os
+
+    return int(os.environ.get("TPU_CYPHER_BROADCAST_LIMIT", "4096"))
+
+
+def _bcast_count_fn(mesh, axis):
+    key = (mesh, axis)
+    got = _BCAST_COUNT_CACHE.get(key)
+    if got is not None:
+        return got
+
+    def local(lk, rk):
+        _, _, counts = _local_probe(lk, rk)
+        return jnp.sum(counts)[None]
+
+    fn = jax.jit(
+        shard_map(
+            local,
+            mesh=mesh,
+            in_specs=(P(axis), P(None)),
+            out_specs=P(axis),
+        )
+    )
+    _BCAST_COUNT_CACHE[key] = fn
+    return fn
+
+
+def _bcast_materialize_fn(mesh, axis, out_cap):
+    key = (mesh, axis, out_cap)
+    got = _BCAST_MAT_CACHE.get(key)
+    if got is not None:
+        return got
+
+    def local(lk, lrow, rk, rrow):
+        r_order, lo, counts = _local_probe(lk, rk)
+        rrow_sorted = jnp.take(rrow, r_order)
+        off = jnp.cumsum(counts)
+        total = off[-1] if counts.shape[0] else jnp.asarray(0, jnp.int64)
+        slot = jnp.arange(out_cap, dtype=jnp.int64)
+        src = jnp.searchsorted(off, slot, side="right")
+        src_c = jnp.minimum(src, counts.shape[0] - 1)
+        within = slot - jnp.take(off - counts, src_c)
+        valid = slot < total
+        l_out = jnp.where(valid, jnp.take(lrow, src_c), 0)
+        r_idx = jnp.take(lo, src_c) + within
+        r_out = jnp.where(
+            valid,
+            jnp.take(rrow_sorted, jnp.minimum(r_idx, rrow_sorted.shape[0] - 1)),
+            0,
+        )
+        return l_out, r_out, valid
+
+    fn = jax.jit(
+        shard_map(
+            local,
+            mesh=mesh,
+            in_specs=(P(axis), P(axis), P(None), P(None)),
+            out_specs=(P(axis), P(axis), P(axis)),
+        )
+    )
+    _BCAST_MAT_CACHE[key] = fn
+    return fn
+
+
+def broadcast_join(
+    l_key, l_valid, r_key, r_valid
+) -> Optional[Tuple[Any, Any]]:
+    """Broadcast (replicated-build) equi-join over the active mesh: when
+    the build (right) side is small, shuffling it through ``all_to_all`` is
+    the wrong plan — replicate it to every device and probe the row-sharded
+    left side LOCALLY, with NO collective in the join at all (the engines'
+    broadcast join, delegated to Catalyst in the reference; SURVEY §2.3
+    "broadcast small relations"). Returns matching global row-index pairs,
+    or None when no mesh is active or the build side exceeds
+    ``TPU_CYPHER_BROADCAST_LIMIT`` rows (default 4096)."""
+    mesh = current_mesh()
+    nsh = mesh_size()
+    if mesh is None or nsh <= 1:
+        return None
+    n_l, n_r = int(l_key.shape[0]), int(r_key.shape[0])
+    if n_l == 0 or n_r == 0 or n_r > _broadcast_limit():
+        return None
+    for arr in (l_key, l_valid, r_key, r_valid):
+        if arr is not None and not getattr(arr, "is_fully_addressable", True):
+            return None
+    axis = mesh.axis_names[0]
+
+    lk_np = np.asarray(l_key, dtype=np.int64)
+    rk_np = np.asarray(r_key, dtype=np.int64)
+    lrow_np = np.arange(n_l, dtype=np.int64)
+    rrow_np = np.arange(n_r, dtype=np.int64)
+    if l_valid is not None:
+        keep = np.asarray(l_valid)
+        lk_np, lrow_np = lk_np[keep], lrow_np[keep]
+    if r_valid is not None:
+        keep = np.asarray(r_valid)
+        rk_np, rrow_np = rk_np[keep], rrow_np[keep]
+    if len(lk_np) == 0 or len(rk_np) == 0:
+        z = jnp.zeros(0, jnp.int64)
+        return z, z
+    if (
+        np.abs(lk_np).max(initial=0) >= _KEY_LIMIT
+        or np.abs(rk_np).max(initial=0) >= _KEY_LIMIT
+    ):
+        return None
+    lk = _pad_sharded(lk_np * 2, nsh, _L_PAD, mesh, axis)
+    lrow = _pad_sharded(lrow_np, nsh, 0, mesh, axis)
+    repl = NamedSharding(mesh, P(None))
+    rk = jax.device_put(rk_np * 2, repl)
+    rrow = jax.device_put(rrow_np, repl)
+
+    counts = _bcast_count_fn(mesh, axis)(lk, rk)
+    counts_np = np.asarray(counts)
+    out_cap = int(counts_np.max()) if counts_np.size else 0
+    if out_cap == 0:
+        z = jnp.zeros(0, jnp.int64)
+        return z, z
+    l_out, r_out, valid = _bcast_materialize_fn(mesh, axis, out_cap)(
+        lk, lrow, rk, rrow
+    )
+    from ..backend.tpu.jit_ops import mask_nonzero, tree_take
+
+    total = int(counts_np.sum())
+    idx = mask_nonzero(valid, size=total)
+    return tree_take((l_out, r_out), idx)
 
 
 def hash_repartition_join(

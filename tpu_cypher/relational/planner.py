@@ -230,6 +230,17 @@ class RelationalPlanner:
         plan = AddOp(plan, expr, fld)
         return plan, E.Var(fld).with_type(expr.cypher_type)
 
+    @staticmethod
+    def _correlated_names(op, lhs, rhs) -> List[str]:
+        """Semijoin/group keys for a subquery: the fields the subquery
+        actually references (``op.correlated``), restricted to those present
+        on both sides. NOT all common columns — lhs columns the subquery
+        never touches may be null (OPTIONAL MATCH), and null join keys
+        would silently empty the subquery result."""
+        lvars = {v.name for v in lhs.header.vars}
+        rvars = {v.name for v in rhs.header.vars}
+        return [n for n in op.correlated if n in lvars and n in rvars]
+
     def _common_join_pairs(
         self, lhs: RelationalOperator, rhs: RelationalOperator
     ) -> List[Tuple[E.Expr, E.Expr]]:
@@ -259,11 +270,7 @@ class RelationalPlanner:
 
     def _plan_ExistsSubQuery(self, op: L.ExistsSubQuery) -> RelationalOperator:
         lhs, rhs = self.process(op.lhs), self.process(op.rhs)
-        common = [
-            v.name
-            for v in rhs.header.vars
-            if any(v.name == lv.name for lv in lhs.header.vars)
-        ]
+        common = self._correlated_names(op, lhs, rhs)
         rhs_sel = DistinctOp(SelectOp(rhs, common), common)
         flag = self.fresh("flag")
         rhs_flag = AddOp(rhs_sel, E.Lit(True).with_type(T.CTBoolean), flag)
@@ -282,11 +289,7 @@ class RelationalPlanner:
         the value, group by the correlated outer vars collecting a list,
         left-outer-join the lists back, and default no-match rows to []."""
         lhs, rhs = self.process(op.lhs), self.process(op.rhs)
-        common = [
-            v.name
-            for v in rhs.header.vars
-            if any(v.name == lv.name for lv in lhs.header.vars)
-        ]
+        common = self._correlated_names(op, lhs, rhs)
         val = self.fresh("pcval")
         rhs_val = AddOp(rhs, op.projection, val)
         rhs_sel = SelectOp(rhs_val, common + [val])
@@ -431,6 +434,33 @@ class RelationalPlanner:
     def _plan_var_expand_classic(self, op: L.BoundedVarLengthExpand) -> RelationalOperator:
         lhs = self.process(op.lhs)
         rhs = self.process(op.rhs)
+        if op.upper is not None:
+            branches = self._var_expand_branches(op, lhs, rhs, op.upper)
+            out = branches[0]
+            for b in branches[1:]:
+                out = UnionAllOp(out, b)
+            return out
+        # unbounded '*': the step loop runs at TABLE time (FixpointVarExpandOp)
+        # so planning stays lazy — relationship isomorphism bounds the walk
+        # by the matching-edge count and the loop exits at the first empty
+        # step. The reference rejects unbounded outright
+        # (flink-cypher-tck/.../scenario_blacklist:6-7).
+        return FixpointVarExpandOp(self, op, lhs, rhs)
+
+    def _var_expand_branches(
+        self,
+        op: L.BoundedVarLengthExpand,
+        lhs: RelationalOperator,
+        rhs: RelationalOperator,
+        upper: int,
+        probe: bool = False,
+        ctx: Opt[RelationalRuntimeContext] = None,
+    ) -> List[RelationalOperator]:
+        """Per-length result branches of the unrolled cascade. ``probe``
+        (fixpoint evaluation) pulls each step's table and stops as soon as a
+        step yields no rows; ``ctx`` overrides the planning context so
+        branches built at table time inside a cloned plan use ITS context."""
+        ctx = ctx or self.ctx
         graph = rhs.graph
         out_fields = [v.name for v in lhs.header.vars] + [op.target, op.rel]
         rel_elem_type = op.rel_type.material
@@ -462,9 +492,9 @@ class RelationalPlanner:
         step_vars: List[str] = []
         node_vars: List[str] = []  # intermediate hop nodes (named paths only)
         prev_end: E.Expr = self._id_of(lhs, op.source)
-        for step in range(1, op.upper + 1):
+        for step in range(1, upper + 1):
             step_var = self.fresh(f"step_{op.rel}")
-            scan = graph.scan_operator(step_var, rel_elem_type, self.ctx)
+            scan = graph.scan_operator(step_var, rel_elem_type, ctx)
             if op.direction == "-":
                 scan = self._undirected(scan, step_var)
             current = JoinOp(
@@ -479,6 +509,8 @@ class RelationalPlanner:
                 current = FilterOp(current, neq)
             step_vars.append(step_var)
             prev_end = self._end_of(current, step_var)
+            if probe and step > op.lower and int(current.table.size) == 0:
+                break
             if step >= op.lower:
                 branch = JoinOp(
                     current, rhs, [(prev_end, self._id_of(rhs, op.target))]
@@ -492,19 +524,58 @@ class RelationalPlanner:
                 branch = with_companion(branch, node_vars)
                 branch = SelectOp(branch, out_fields)
                 branches.append(branch)
-            if capture and step < op.upper:
+            if capture and step < upper:
                 # join the full node element at this hop boundary so named
                 # paths carry real intermediate nodes, not id-only stubs
                 nv = self.fresh(f"pn_{op.rel}")
-                nscan = graph.scan_operator(nv, node_elem_type, self.ctx)
+                nscan = graph.scan_operator(nv, node_elem_type, ctx)
                 current = JoinOp(
                     current, nscan, [(prev_end, self._id_of(nscan, nv))]
                 )
                 node_vars.append(nv)
+        return branches
+
+
+class FixpointVarExpandOp(RelationalOperator):
+    """Unbounded ``*`` var-length expand: evaluates the unrolled cascade
+    step by step at table-compute time, stopping at the empty-frontier
+    fixpoint, with the matching-edge count as the hard bound (relationship
+    isomorphism forbids longer walks). The count tier is handled upstream by
+    the fused CSR op; this is the materializing tier."""
+
+    def __init__(self, planner: "RelationalPlanner", op, lhs, rhs):
+        super().__init__(lhs, rhs)
+        self._planner = planner
+        self._op = op
+
+    def _compute_header(self) -> RecordHeader:
+        lhs, rhs = self.children
+        shape = self._planner._var_expand_branches(
+            self._op, lhs, rhs, max(self._op.lower, 1), ctx=lhs.context
+        )
+        return shape[0].header
+
+    def _compute_table(self):
+        lhs, rhs = self.children
+        ctx = lhs.context
+        op = self._op
+        probe = rhs.graph.scan_operator(
+            self._planner.fresh(f"cnt_{op.rel}"), op.rel_type.material, ctx
+        )
+        upper = max(int(probe.table.size), op.lower, 1)
+        branches = self._planner._var_expand_branches(
+            op, lhs, rhs, upper, probe=True, ctx=ctx
+        )
         out = branches[0]
         for b in branches[1:]:
             out = UnionAllOp(out, b)
-        return out
+        return out.table
+
+    def _show_inner(self) -> str:
+        return (
+            f"({self._op.source})-[{self._op.rel}*{self._op.lower}..]->"
+            f"({self._op.target})"
+        )
 
 
 def plan_relational(
