@@ -1,0 +1,282 @@
+Feature: AggregationTck
+  # Provenance: TRANSCRIBED from the openCypher TCK aggregation family
+  # (tck/features/expressions/aggregation/*.feature text).
+
+  Scenario: count(*) on an empty graph returns zero
+    Given an empty graph
+    When executing query:
+      """
+      MATCH (n) RETURN count(*) AS c
+      """
+    Then the result should be, in any order:
+      | c |
+      | 0 |
+    And no side effects
+
+  Scenario: sum over no rows is zero, min and max are null
+    Given an empty graph
+    When executing query:
+      """
+      MATCH (n) RETURN sum(n.v) AS s, min(n.v) AS mn, max(n.v) AS mx
+      """
+    Then the result should be, in any order:
+      | s | mn   | mx   |
+      | 0 | null | null |
+    And no side effects
+
+  Scenario: Grouping keys with nulls form their own group
+    Given an empty graph
+    And having executed:
+      """
+      CREATE ({g: 'a', v: 1}), ({g: 'a', v: 2}), ({v: 3}), ({v: 4})
+      """
+    When executing query:
+      """
+      MATCH (n) RETURN n.g AS g, sum(n.v) AS s
+      """
+    Then the result should be, in any order:
+      | g    | s |
+      | 'a'  | 3 |
+      | null | 7 |
+    And no side effects
+
+  Scenario: count DISTINCT values
+    Given an empty graph
+    And having executed:
+      """
+      CREATE ({v: 1}), ({v: 1}), ({v: 2}), ()
+      """
+    When executing query:
+      """
+      MATCH (n) RETURN count(DISTINCT n.v) AS c
+      """
+    Then the result should be, in any order:
+      | c |
+      | 2 |
+    And no side effects
+
+  Scenario: avg of mixed integers and floats
+    Given an empty graph
+    And having executed:
+      """
+      CREATE ({v: 1}), ({v: 2.0}), ({v: 3})
+      """
+    When executing query:
+      """
+      MATCH (n) RETURN avg(n.v) AS a
+      """
+    Then the result should be, in any order:
+      | a   |
+      | 2.0 |
+    And no side effects
+
+  Scenario: min and max over strings
+    Given an empty graph
+    And having executed:
+      """
+      CREATE ({s: 'b'}), ({s: 'a'}), ({s: 'c'})
+      """
+    When executing query:
+      """
+      MATCH (n) RETURN min(n.s) AS mn, max(n.s) AS mx
+      """
+    Then the result should be, in any order:
+      | mn  | mx  |
+      | 'a' | 'c' |
+    And no side effects
+
+  Scenario: collect DISTINCT
+    Given an empty graph
+    And having executed:
+      """
+      CREATE ({v: 1}), ({v: 1}), ({v: 2})
+      """
+    When executing query:
+      """
+      MATCH (n) WITH n.v AS v ORDER BY v
+      RETURN collect(DISTINCT v) AS l
+      """
+    Then the result should be, in any order:
+      | l      |
+      | [1, 2] |
+    And no side effects
+
+  Scenario: Aggregation of an expression over grouped rows
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:X {g: 1, v: 2}), (:X {g: 1, v: 3}), (:X {g: 2, v: 5})
+      """
+    When executing query:
+      """
+      MATCH (n:X) RETURN n.g AS g, sum(n.v * 10) AS s
+      """
+    Then the result should be, in any order:
+      | g | s  |
+      | 1 | 50 |
+      | 2 | 50 |
+    And no side effects
+
+  Scenario: Expression over an aggregation result
+    Given an empty graph
+    And having executed:
+      """
+      CREATE ({v: 1}), ({v: 2})
+      """
+    When executing query:
+      """
+      MATCH (n) RETURN count(*) + 1 AS c
+      """
+    Then the result should be, in any order:
+      | c |
+      | 3 |
+    And no side effects
+
+  Scenario: Aggregation grouped by an element keeps element identity
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (a {name: 'a'})-[:T]->(), (a)-[:T]->(),
+             (b {name: 'b'})-[:T]->()
+      """
+    When executing query:
+      """
+      MATCH (n)-[:T]->()
+      RETURN n.name AS name, count(*) AS c
+      """
+    Then the result should be, in any order:
+      | name | c |
+      | 'a'  | 2 |
+      | 'b'  | 1 |
+    And no side effects
+
+  Scenario: stDev of a single value is zero
+    Given an empty graph
+    And having executed:
+      """
+      CREATE ({v: 5})
+      """
+    When executing query:
+      """
+      MATCH (n) RETURN stDev(n.v) AS s
+      """
+    Then the result should be, in any order:
+      | s   |
+      | 0.0 |
+    And no side effects
+
+  Scenario: percentileDisc returns an actual value
+    Given an empty graph
+    And having executed:
+      """
+      CREATE ({v: 10}), ({v: 20}), ({v: 30})
+      """
+    When executing query:
+      """
+      MATCH (n) RETURN percentileDisc(n.v, 0.5) AS p
+      """
+    Then the result should be, in any order:
+      | p  |
+      | 20 |
+    And no side effects
+
+  Scenario: percentileCont interpolates
+    Given an empty graph
+    And having executed:
+      """
+      CREATE ({v: 10.0}), ({v: 20.0})
+      """
+    When executing query:
+      """
+      MATCH (n) RETURN percentileCont(n.v, 0.5) AS p
+      """
+    Then the result should be, in any order:
+      | p    |
+      | 15.0 |
+    And no side effects
+
+  Scenario: ORDER BY an aggregate
+    Given an empty graph
+    And having executed:
+      """
+      CREATE ({g: 'a'}), ({g: 'a'}), ({g: 'b'})
+      """
+    When executing query:
+      """
+      MATCH (n)
+      RETURN n.g AS g, count(*) AS c ORDER BY c DESC, g
+      """
+    Then the result should be, in ORDER:
+      | g   | c |
+      | 'a' | 2 |
+      | 'b' | 1 |
+    And no side effects
+
+  Scenario: WITH aggregation then further MATCH
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (a {name: 'a'})-[:T]->(), (a)-[:T]->(), (:Other)
+      """
+    When executing query:
+      """
+      MATCH (n)-[:T]->()
+      WITH n, count(*) AS deg
+      MATCH (m:Other)
+      RETURN n.name AS n, deg, labels(m) AS m
+      """
+    Then the result should be, in any order:
+      | n   | deg | m         |
+      | 'a' | 2   | ['Other'] |
+    And no side effects
+
+  Scenario: Aggregates inside a CASE-guarded expression
+    Given an empty graph
+    And having executed:
+      """
+      CREATE ({v: 1}), ({v: 5})
+      """
+    When executing query:
+      """
+      MATCH (n)
+      RETURN sum(CASE WHEN n.v > 2 THEN n.v ELSE 0 END) AS s
+      """
+    Then the result should be, in any order:
+      | s |
+      | 5 |
+    And no side effects
+
+  Scenario: Multiple aggregates in one projection
+    Given an empty graph
+    And having executed:
+      """
+      CREATE ({v: 1}), ({v: 2}), ({v: 3})
+      """
+    When executing query:
+      """
+      MATCH (n)
+      RETURN count(*) AS c, sum(n.v) AS s, min(n.v) AS mn,
+             max(n.v) AS mx, avg(n.v) AS a
+      """
+    Then the result should be, in any order:
+      | c | s | mn | mx | a   |
+      | 3 | 6 | 1  | 3  | 2.0 |
+    And no side effects
+
+  Scenario: count on a rel type grouped by endpoint property
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (a {city: 'x'})-[:LIVES]->(h1), (b {city: 'x'})-[:LIVES]->(h1),
+             (c {city: 'y'})-[:LIVES]->(h2)
+      """
+    When executing query:
+      """
+      MATCH (p)-[:LIVES]->()
+      RETURN p.city AS city, count(*) AS c
+      """
+    Then the result should be, in any order:
+      | city | c |
+      | 'x'  | 2 |
+      | 'y'  | 1 |
+    And no side effects

@@ -321,6 +321,7 @@ class IRBuilder:
                     src, dst, direction = prev_node, nxt, OUTGOING
                 else:
                     src, dst, direction = prev_node, nxt, BOTH
+                var_syntax = rp.length is not None
                 if rp.length is None:
                     lo, hi = 1, 1
                 else:
@@ -331,7 +332,9 @@ class IRBuilder:
                     # fixpoint. The reference REJECTS unbounded (flink
                     # scenario_blacklist:6-7) — we execute it.
                     lo, hi = rp.length
-                ir.topology[rname] = Connection(src, dst, direction, lo, hi)
+                ir.topology[rname] = Connection(
+                    src, dst, direction, lo, hi, var_syntax
+                )
                 if rp.properties is not None:
                     var = E.Var(rname).with_type(rt)
                     for k, v in zip(rp.properties.keys, rp.properties.values):

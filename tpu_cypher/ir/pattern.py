@@ -32,11 +32,15 @@ class Connection:
     target: str
     direction: str  # OUTGOING | BOTH
     lower: int = 1
-    upper: Optional[int] = 1  # None = unbounded (rejected later); (1,1) = single hop
+    upper: Optional[int] = 1  # None = unbounded '*'; (1,1) = single hop
+    # True when the pattern WROTE var-length syntax: '*1..1' binds a
+    # LIST of one relationship, not the relationship itself (openCypher
+    # "Handle fixed-length variable length pattern")
+    var_syntax: bool = False
 
     @property
     def is_var_length(self) -> bool:
-        return not (self.lower == 1 and self.upper == 1)
+        return self.var_syntax or not (self.lower == 1 and self.upper == 1)
 
 
 @dataclass

@@ -36,6 +36,9 @@ class LogicalPlanner:
     def __init__(self, ctx: LogicalPlannerContext):
         self.ctx = ctx
         self._fresh = itertools.count()
+        # path var -> member entity fields (shadowing checks: a projection
+        # that rebinds a member name must not corrupt later path reads)
+        self._path_entities: Dict[str, Tuple[str, ...]] = {}
 
     def fresh(self, prefix: str) -> str:
         return f"__{prefix}_{next(self._fresh)}"
@@ -74,11 +77,52 @@ class LogicalPlanner:
                 for name, ex in blk.items
                 if not (isinstance(ex, E.Var) and ex.name == name)
             }
+
+            # paths materialize LAZILY from their member columns, so a
+            # projection that rebinds a member name (RETURN x.name AS x
+            # with p = (x)-->(y) in scope) would corrupt every later path
+            # read. Re-alias the shadowed members to hidden names and
+            # re-register the path over them BEFORE any rebinding — the
+            # hidden names are never assigned, so the path survives both
+            # same-block reads (p IS NULL) and being carried forward (p).
+            in_fields = dict(plan.fields)
+            for pname, fields in list(self._path_entities.items()):
+                if pname in assigned or pname not in in_fields:
+                    # the path name itself is rebound / out of scope: it is
+                    # no longer a live path — drop the stale registration
+                    self._path_entities.pop(pname, None)
+                    continue
+                if not any(m in assigned for m in fields):
+                    continue
+                new_fields = []
+                for m in fields:
+                    if m in assigned and m in in_fields:
+                        hid = self.fresh("pmem")
+                        plan = L.Project(
+                            plan, E.Var(m).with_type(in_fields[m]), hid
+                        )
+                        new_fields.append(hid)
+                    else:
+                        new_fields.append(m)
+                plan = L.BindPath(plan, pname, tuple(new_fields))
+                self._path_entities[pname] = tuple(new_fields)
+
+            def _referenced(ex: E.Expr) -> set:
+                # a PATH var reference depends on its member entities too
+                names = {v.name for v in E.walk_vars(ex)}
+                for n in list(names):
+                    names |= set(self._path_entities.get(n, ()))
+                return names
+
+            item_refs = [
+                (name, ex, _referenced(ex))
+                for name, ex in blk.items
+                if not (isinstance(ex, E.Var) and ex.name == name)
+            ]
             needs_temps = any(
-                name in (v.name for v in E.walk_vars(ex)) and name != other
-                for other, ex in blk.items
+                name in refs and name != other
+                for other, _, refs in item_refs
                 for name in assigned
-                if not (isinstance(ex, E.Var) and ex.name == other)
             )
             if needs_temps:
                 renames: List[Tuple[str, E.Expr]] = []
@@ -192,12 +236,14 @@ class LogicalPlanner:
             rhs = self._plan_pattern(blk.pattern, plan)
             for pname, fields in sorted(blk.pattern.paths.items()):
                 rhs = L.BindPath(rhs, pname, tuple(fields))
+                self._path_entities[pname] = tuple(fields)
             for p in blk.predicates:
                 rhs = self._plan_predicate(p, rhs)
             return L.Optional(plan, rhs)
         plan = self._plan_pattern(blk.pattern, plan)
         for pname, fields in sorted(blk.pattern.paths.items()):
             plan = L.BindPath(plan, pname, tuple(fields))
+            self._path_entities[pname] = tuple(fields)
         for p in blk.predicates:
             plan = self._plan_predicate(p, plan)
         return plan
