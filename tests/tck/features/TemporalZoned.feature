@@ -150,6 +150,40 @@ Feature: TemporalZoned
       | 25  | 14 | '+01:00' |
     And no side effects
 
+  Scenario: Stored zoned datetimes plus a duration clamp month ends
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:E {ts: datetime('2020-01-31T12:00+01:00')})
+      """
+    When executing query:
+      """
+      MATCH (e:E)
+      WITH e.ts + duration('P1M') AS d
+      RETURN d.month AS m, d.day AS day, d.offset AS o
+      """
+    Then the result should be, in any order:
+      | m | day | o        |
+      | 2 | 29  | '+01:00' |
+    And no side effects
+
+  Scenario: Stored local datetimes minus a duration
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:E {t: localdatetime('2020-03-01T00:30')})
+      """
+    When executing query:
+      """
+      MATCH (e:E)
+      WITH e.t - duration('PT45M') AS d
+      RETURN d.month AS m, d.day AS day, d.hour AS h, d.minute AS mi
+      """
+    Then the result should be, in any order:
+      | m | day | h  | mi |
+      | 2 | 29  | 23 | 45 |
+    And no side effects
+
   Scenario: time from a string with an offset
     Given an empty graph
     When executing query:

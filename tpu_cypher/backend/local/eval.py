@@ -659,21 +659,28 @@ def _scale_duration(d: Duration, factor) -> Duration:
 def _add_duration(dt_val, dur: Duration):
     import datetime as _dt
 
-    months = dt_val.month - 1 + dur.months
-    year = dt_val.year + months // 12
-    month = months % 12 + 1
     try:
-        base = dt_val.replace(year=year, month=month)
-    except ValueError:
-        # clamp day to month end
-        import calendar
+        months = dt_val.month - 1 + dur.months
+        year = dt_val.year + months // 12
+        month = months % 12 + 1
+        try:
+            base = dt_val.replace(year=year, month=month)
+        except ValueError:
+            # clamp day to month end
+            import calendar
 
-        day = min(dt_val.day, calendar.monthrange(year, month)[1])
-        base = dt_val.replace(year=year, month=month, day=day)
-    delta = _dt.timedelta(days=dur.days, seconds=dur.seconds, microseconds=dur.microseconds)
-    if isinstance(base, _dt.datetime):
-        return base + delta
-    result = _dt.datetime(base.year, base.month, base.day) + delta
+            day = min(dt_val.day, calendar.monthrange(year, month)[1])
+            base = dt_val.replace(year=year, month=month, day=day)
+        delta = _dt.timedelta(
+            days=dur.days, seconds=dur.seconds, microseconds=dur.microseconds
+        )
+        if isinstance(base, _dt.datetime):
+            return base + delta
+        result = _dt.datetime(base.year, base.month, base.day) + delta
+    except (ValueError, OverflowError) as exc:
+        # years outside [1, 9999]: a TYPED engine error, not a raw
+        # ValueError (the device backend defers to this exact error)
+        raise CypherTypeError(f"temporal result out of range: {exc}") from exc
     if isinstance(dt_val, _dt.datetime):
         return result
     return result.date() if (result.hour, result.minute, result.second, result.microsecond) == (0, 0, 0, 0) else result

@@ -703,6 +703,67 @@ class TpuEvaluator:
                 )
                 return Column(DUR, out, _and_valid(l, r))
             raise TpuUnsupportedExpr(f"{type(expr).__name__} on durations")
+        # temporal +/- duration on device (oracle: eval._add_duration —
+        # months with day clamp, then days, then the time remainder).
+        # DATE stays a host island: its result type is data-dependent
+        # (a sub-day remainder demotes to a datetime per row).
+        if (
+            isinstance(expr, (E.Add, E.Subtract))
+            and (
+                (l.kind in (LDT, ZDT) and r.kind == DUR)
+                or (
+                    isinstance(expr, E.Add)
+                    and l.kind == DUR
+                    and r.kind in (LDT, ZDT)
+                )
+            )
+        ):
+            if isinstance(self.table, _ShimTable):
+                # needs the eager bound check below (a data-dependent raise
+                # cannot live inside a traced program)
+                raise TpuUnsupportedExpr("temporal arithmetic is eager")
+            from .temporal import (
+                US_PER_SECOND,
+                add_duration_micros,
+                encode_ldt,
+                parse_offset_str,
+            )
+            import datetime as _dt
+
+            t, dur = (l, r) if l.kind in (LDT, ZDT) else (r, l)
+            months = dur.data[:, 0]
+            ddays = dur.data[:, 1]
+            dmic = dur.data[:, 2]
+            if isinstance(expr, E.Subtract):
+                months, ddays, dmic = -months, -ddays, -dmic
+            valid = _and_valid(l, r)
+            off = 0
+            local = t.data
+            if t.kind == ZDT:
+                # the arithmetic runs on the LOCAL clock (Python aware
+                # datetime + timedelta semantics); the offset is unchanged
+                off = parse_offset_str((t.vocab or ["+00:00"])[0])
+                local = t.data + off * US_PER_SECOND
+            out = add_duration_micros(local, months, ddays, dmic)
+            # Python datetimes span years [1, 9999]; results beyond that
+            # must raise the oracle's typed range error, not silently hold
+            # a proleptic value — route the expression to the host island
+            # (the oracle raises CypherTypeError there). One min/max sync.
+            vm = (
+                valid
+                if valid is not None
+                else jnp.ones(out.shape[0], bool)
+            )
+            lo_us = encode_ldt(_dt.datetime(1, 1, 1))
+            hi_us = encode_ldt(_dt.datetime(9999, 12, 31, 23, 59, 59, 999999))
+            probe = jnp.where(vm, out, lo_us)
+            if out.shape[0] and bool(
+                jnp.any((probe < lo_us) | (probe > hi_us))
+            ):
+                raise TpuUnsupportedExpr("temporal result out of range")
+            if t.kind == LDT:
+                return Column(LDT, out, valid)
+            return Column(ZDT, out - off * US_PER_SECOND, valid, t.vocab)
         if l.kind not in (I64, F64) or r.kind not in (I64, F64):
             raise TpuUnsupportedExpr(f"arithmetic on {l.kind}/{r.kind}")
         valid = _and_valid(l, r)

@@ -148,6 +148,31 @@ def days_from_civil(y, m, d):
     return era * 146097 + doe - 719468
 
 
+# host constant; converted per-trace (a module-level device array would
+# bake an int32 before column.py enables x64)
+_DAYS_IN_MONTH = (31, 28, 31, 30, 31, 30, 31, 31, 30, 31, 30, 31)
+
+
+def add_duration_micros(us, months, ddays, dmicros):
+    """local-micros + (months, days, micros) with the oracle's semantics
+    (``eval._add_duration``): months first with end-of-month day clamping,
+    then whole days, then the time remainder. All inputs are traced int64
+    arrays; jnp's floored // and non-negative % match Python on negative
+    month totals."""
+    days, tod = split_ldt(us)
+    y, m, d = civil_from_days(days)
+    tot = y * 12 + (m - 1) + months
+    ny = tot // 12
+    nm = tot % 12 + 1
+    leap = ((ny % 4 == 0) & (ny % 100 != 0)) | (ny % 400 == 0)
+    dim = jnp.take(
+        jnp.asarray(_DAYS_IN_MONTH, jnp.int64), nm - 1
+    ) + jnp.where((nm == 2) & leap, 1, 0)
+    nd = jnp.minimum(d, dim)
+    days2 = days_from_civil(ny, nm, nd)
+    return (days2 + ddays) * US_PER_DAY + tod + dmicros
+
+
 def iso_weekday(z):
     """ISO day of week (Mon=1..Sun=7); 1970-01-01 (day 0) was a Thursday.
     ``jnp.mod`` is floor-mod, so negative days (pre-1970) wrap correctly."""
