@@ -221,6 +221,30 @@ Feature: TemporalZoned
       | 12 | 60  |
     And no side effects
 
+  Scenario: time plus a duration wraps the clock and keeps the offset
+    Given an empty graph
+    When executing query:
+      """
+      WITH time('23:30+01:00') + duration('PT45M') AS t
+      RETURN t.hour AS h, t.minute AS m, t.offset AS o
+      """
+    Then the result should be, in any order:
+      | h | m  | o        |
+      | 0 | 15 | '+01:00' |
+    And no side effects
+
+  Scenario: localtime minus a duration wraps backwards
+    Given an empty graph
+    When executing query:
+      """
+      WITH localtime('00:15') - duration('PT30M') AS t
+      RETURN t.hour AS h, t.minute AS m
+      """
+    Then the result should be, in any order:
+      | h  | m  |
+      | 23 | 45 |
+    And no side effects
+
   Scenario: zoned time properties stored and filtered
     Given an empty graph
     And having executed:

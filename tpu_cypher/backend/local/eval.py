@@ -446,6 +446,10 @@ class Evaluator:
                     return _add_duration(rr, l)
                 if isinstance(rr, Duration) and isinstance(l, (_dt.date, _dt.datetime)):
                     return _add_duration(l, rr)
+                if isinstance(l, Duration) and isinstance(rr, _dt.time):
+                    return _add_duration_time(rr, l)
+                if isinstance(rr, Duration) and isinstance(l, _dt.time):
+                    return _add_duration_time(l, rr)
                 if _num(l) and _num(rr):
                     return l + rr
                 raise CypherTypeError(f"Cannot add {type(l).__name__} and {type(rr).__name__}")
@@ -454,6 +458,8 @@ class Evaluator:
                     return l - rr
                 if isinstance(l, (_dt.date, _dt.datetime)) and isinstance(rr, Duration):
                     return _add_duration(l, -rr)
+                if isinstance(l, _dt.time) and isinstance(rr, Duration):
+                    return _add_duration_time(l, -rr)
                 if _num(l) and _num(rr):
                     return l - rr
                 raise CypherTypeError("Cannot subtract")
@@ -654,6 +660,23 @@ def _scale_duration(d: Duration, factor) -> Duration:
         seconds=d.seconds * factor,
         microseconds=d.microseconds * factor,
     )
+
+
+def _add_duration_time(t_val, dur: Duration):
+    """time/localtime +/- duration: only the sub-day components apply and
+    the clock wraps modulo 24h (Neo4j time arithmetic); the zone offset is
+    preserved."""
+    import datetime as _dt
+
+    us = (
+        (t_val.hour * 3600 + t_val.minute * 60 + t_val.second) * 1_000_000
+        + t_val.microsecond
+    )
+    us = (us + dur.seconds * 1_000_000 + dur.microseconds) % 86_400_000_000
+    secs, micro = divmod(us, 1_000_000)
+    h, rem = divmod(secs, 3600)
+    m, s = divmod(rem, 60)
+    return _dt.time(int(h), int(m), int(s), int(micro), tzinfo=t_val.tzinfo)
 
 
 def _add_duration(dt_val, dur: Duration):

@@ -723,6 +723,7 @@ class TpuEvaluator:
                 # cannot live inside a traced program)
                 raise TpuUnsupportedExpr("temporal arithmetic is eager")
             from .temporal import (
+                US_PER_DAY,
                 US_PER_SECOND,
                 add_duration_micros,
                 encode_ldt,
@@ -744,11 +745,13 @@ class TpuEvaluator:
                 # datetime + timedelta semantics); the offset is unchanged
                 off = parse_offset_str((t.vocab or ["+00:00"])[0])
                 local = t.data + off * US_PER_SECOND
-            out = add_duration_micros(local, months, ddays, dmic)
+            out, mid_days = add_duration_micros(local, months, ddays, dmic)
             # Python datetimes span years [1, 9999]; results beyond that
             # must raise the oracle's typed range error, not silently hold
-            # a proleptic value — route the expression to the host island
-            # (the oracle raises CypherTypeError there). One min/max sync.
+            # a proleptic value. The oracle raises at the MONTH step, so
+            # the month-shifted intermediate is probed too — route either
+            # violation to the host island (the oracle raises
+            # CypherTypeError there). One any() sync.
             vm = (
                 valid
                 if valid is not None
@@ -756,9 +759,16 @@ class TpuEvaluator:
             )
             lo_us = encode_ldt(_dt.datetime(1, 1, 1))
             hi_us = encode_ldt(_dt.datetime(9999, 12, 31, 23, 59, 59, 999999))
+            lo_d, hi_d = lo_us // US_PER_DAY, hi_us // US_PER_DAY
             probe = jnp.where(vm, out, lo_us)
+            probe_mid = jnp.where(vm, mid_days, lo_d)
             if out.shape[0] and bool(
-                jnp.any((probe < lo_us) | (probe > hi_us))
+                jnp.any(
+                    (probe < lo_us)
+                    | (probe > hi_us)
+                    | (probe_mid < lo_d)
+                    | (probe_mid > hi_d)
+                )
             ):
                 raise TpuUnsupportedExpr("temporal result out of range")
             if t.kind == LDT:

@@ -170,7 +170,9 @@ def add_duration_micros(us, months, ddays, dmicros):
     ) + jnp.where((nm == 2) & leap, 1, 0)
     nd = jnp.minimum(d, dim)
     days2 = days_from_civil(ny, nm, nd)
-    return (days2 + ddays) * US_PER_DAY + tod + dmicros
+    # (result, month-shifted intermediate days): the oracle raises its
+    # range error at the month step, so callers must bound-check BOTH
+    return (days2 + ddays) * US_PER_DAY + tod + dmicros, days2
 
 
 def iso_weekday(z):
