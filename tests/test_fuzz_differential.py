@@ -204,3 +204,96 @@ def test_fuzz_differential_adversarial(fuzz_graphs_adversarial, qseed):
         want = gl.cypher(q).records.to_bag()
         got = gt.cypher(q).records.to_bag()
         assert got == want, f"\nquery: {q}\ntpu: {got!r}\nlocal: {want!r}"
+
+
+# ---------------------------------------------------------------------------
+# Temporal fuzz: zoned datetime / date properties + accessor, comparison,
+# ordering, aggregation, and duration-arithmetic shapes (round-5 de-bias:
+# VERDICT r4 asked the generator to cover the temporal-zoned family)
+# ---------------------------------------------------------------------------
+
+
+def _temporal_graph(seed):
+    import datetime as dt
+
+    rng = np.random.default_rng(seed)
+    tz = dt.timezone(dt.timedelta(hours=2))
+    ids = np.arange(N, dtype=np.int64) * 3 + 1
+
+    def zdt():
+        if rng.random() < 0.12:
+            return None
+        return dt.datetime(
+            int(rng.integers(1999, 2026)), int(rng.integers(1, 13)),
+            int(rng.integers(1, 29)), int(rng.integers(0, 24)),
+            int(rng.integers(0, 60)), int(rng.integers(0, 60)),
+            int(rng.integers(0, 1_000_000)), tzinfo=tz,
+        )
+
+    def d():
+        if rng.random() < 0.12:
+            return None
+        return dt.date(
+            int(rng.integers(1999, 2026)), int(rng.integers(1, 13)),
+            int(rng.integers(1, 29)),
+        )
+
+    ts = [zdt() for _ in range(N)]
+    ds = [d() for _ in range(N)]
+    return ids, ts, ds
+
+
+def _build_temporal(session, ids, ts, ds):
+    nm = (
+        NodeMappingBuilder.on("id")
+        .with_implied_label("N")
+        .with_property_keys("ts", "d")
+        .build()
+    )
+    nodes = session.table_cls.from_columns(
+        {"id": ids.tolist(), "ts": ts, "d": ds}
+    )
+    return session.read_from(ElementTable(nm, nodes))
+
+
+def _gen_temporal_query(rng) -> str:
+    dur = f"P{rng.integers(0, 25)}M{rng.integers(-50, 50)}DT{rng.integers(0, 30)}H"
+    cmp_dt = f"datetime('20{rng.integers(10, 25)}-0{rng.integers(1, 9)}-15T12:00+02:00')"
+    acc = rng.choice(["year", "month", "day", "hour", "epochSeconds"])
+    shapes = [
+        f"MATCH (n:N) WHERE n.ts > {cmp_dt} RETURN count(*) AS c",
+        f"MATCH (n:N) WHERE n.ts.{acc} % 2 = 0 RETURN count(*) AS c",
+        f"MATCH (n:N) RETURN max(n.ts).{acc} AS x, min(n.d) AS mn",
+        f"MATCH (n:N) RETURN n.ts AS t ORDER BY t SKIP 2 LIMIT 7",
+        f"MATCH (n:N) WHERE n.d IS NOT NULL "
+        f"RETURN (n.d + duration('P{rng.integers(0, 30)}M{rng.integers(-40, 40)}D')).day AS x "
+        f"ORDER BY x LIMIT 9",
+        f"MATCH (n:N) WHERE n.ts IS NOT NULL "
+        f"RETURN (n.ts + duration('{dur}')).{acc} AS x ORDER BY x LIMIT 9",
+        f"MATCH (n:N) WHERE n.ts IS NOT NULL "
+        f"RETURN (n.ts - duration('{dur}')).offset AS o LIMIT 3",
+        "MATCH (n:N) RETURN count(DISTINCT n.ts) AS c, count(DISTINCT n.d) AS cd",
+        f"MATCH (n:N) WHERE n.d < date('2015-0{rng.integers(1, 9)}-01') "
+        "RETURN collect(n.d.year) AS ys",
+    ]
+    return str(rng.choice(shapes))
+
+
+@pytest.fixture(scope="module")
+def fuzz_graphs_temporal():
+    args = _temporal_graph(20260801)
+    return (
+        _build_temporal(CypherSession.local(), *args),
+        _build_temporal(CypherSession.tpu(), *args),
+    )
+
+
+@pytest.mark.parametrize("qseed", range(5))
+def test_fuzz_differential_temporal(fuzz_graphs_temporal, qseed):
+    gl, gt = fuzz_graphs_temporal
+    rng = np.random.default_rng(5000 + qseed)
+    for _ in range(6):
+        q = _gen_temporal_query(rng)
+        want = gl.cypher(q).records.to_bag()
+        got = gt.cypher(q).records.to_bag()
+        assert got == want, f"\nquery: {q}\ntpu: {got!r}\nlocal: {want!r}"

@@ -689,6 +689,30 @@ class TpuEvaluator:
             valid = valid & hit
         return Column(BOOL, hit & valid, valid)
 
+    def _temporal_dur_operands(self, expr, l, r, kinds):
+        """Shared preamble of the temporal +/- duration device paths: match
+        the (temporal, duration) operand shape for the given temporal
+        ``kinds``, force eager evaluation (the bound checks below raise
+        data-dependently, which a traced program cannot), split operands,
+        and negate the duration for Subtract. None = not this shape."""
+        is_t_dur = l.kind in kinds and r.kind == DUR
+        is_dur_t = (
+            isinstance(expr, E.Add) and l.kind == DUR and r.kind in kinds
+        )
+        if not isinstance(expr, (E.Add, E.Subtract)) or not (
+            is_t_dur or is_dur_t
+        ):
+            return None
+        if isinstance(self.table, _ShimTable):
+            raise TpuUnsupportedExpr("temporal arithmetic is eager")
+        t, dur = (l, r) if is_t_dur else (r, l)
+        months = dur.data[:, 0]
+        ddays = dur.data[:, 1]
+        dmic = dur.data[:, 2]
+        if isinstance(expr, E.Subtract):
+            months, ddays, dmic = -months, -ddays, -dmic
+        return t, months, ddays, dmic, _and_valid(l, r)
+
     def _arith(self, expr) -> Column:
         l, r = self.eval(expr.lhs), self.eval(expr.rhs)
         if l.kind == DUR and r.kind == DUR:
@@ -707,21 +731,56 @@ class TpuEvaluator:
         # months with day clamp, then days, then the time remainder).
         # DATE stays a host island: its result type is data-dependent
         # (a sub-day remainder demotes to a datetime per row).
-        if (
-            isinstance(expr, (E.Add, E.Subtract))
-            and (
-                (l.kind in (LDT, ZDT) and r.kind == DUR)
-                or (
-                    isinstance(expr, E.Add)
-                    and l.kind == DUR
-                    and r.kind in (LDT, ZDT)
-                )
+        got = self._temporal_dur_operands(expr, l, r, (DATE, ZT, LT))
+        if got is not None:
+            from .temporal import (
+                US_PER_DAY,
+                add_duration_micros,
+                encode_date,
             )
-        ):
-            if isinstance(self.table, _ShimTable):
-                # needs the eager bound check below (a data-dependent raise
-                # cannot live inside a traced program)
-                raise TpuUnsupportedExpr("temporal arithmetic is eager")
+            import datetime as _dt
+
+            t, months, ddays, dmic, valid = got
+            if t.kind in (ZT, LT):
+                # time/localtime: only sub-day components apply, the clock
+                # wraps modulo 24h, the offset is unchanged (the oracle's
+                # _add_duration_time; months/days are whole days = 0 mod 24h)
+                out = (t.data + dmic) % US_PER_DAY
+                return Column(t.kind, out, valid, t.vocab)
+            # DATE + duration: the oracle demotes to a datetime when a
+            # sub-day remainder survives — a data-dependent result TYPE the
+            # column model cannot hold, so only whole-day durations stay on
+            # device (one any() sync; the host island handles the rest)
+            out_us, mid_days = add_duration_micros(
+                t.data.astype(jnp.int64) * US_PER_DAY, months, ddays, dmic
+            )
+            days = out_us // US_PER_DAY
+            lo_d = encode_date(_dt.date(1, 1, 1))
+            hi_d = encode_date(_dt.date(9999, 12, 31))
+            vm = (
+                valid
+                if valid is not None
+                else jnp.ones(days.shape[0], bool)
+            )
+            probe = jnp.where(vm, days, lo_d)
+            probe_mid = jnp.where(vm, mid_days, lo_d)
+            # ONE fused sync: sub-day remainders (the oracle demotes those
+            # rows to datetimes — a result type the column cannot hold) and
+            # out-of-range results both route to the host island
+            bad = (
+                jnp.any(dmic % US_PER_DAY != 0)
+                | (probe < lo_d).any()
+                | (probe > hi_d).any()
+                | (probe_mid < lo_d).any()
+                | (probe_mid > hi_d).any()
+            )
+            if days.shape[0] and bool(bad):
+                raise TpuUnsupportedExpr(
+                    "date arithmetic needs the host island"
+                )
+            return Column(DATE, days.astype(jnp.int32), valid)
+        got = self._temporal_dur_operands(expr, l, r, (LDT, ZDT))
+        if got is not None:
             from .temporal import (
                 US_PER_DAY,
                 US_PER_SECOND,
@@ -731,13 +790,7 @@ class TpuEvaluator:
             )
             import datetime as _dt
 
-            t, dur = (l, r) if l.kind in (LDT, ZDT) else (r, l)
-            months = dur.data[:, 0]
-            ddays = dur.data[:, 1]
-            dmic = dur.data[:, 2]
-            if isinstance(expr, E.Subtract):
-                months, ddays, dmic = -months, -ddays, -dmic
-            valid = _and_valid(l, r)
+            t, months, ddays, dmic, valid = got
             off = 0
             local = t.data
             if t.kind == ZDT:
