@@ -51,6 +51,29 @@ class TpuUnsupportedExpr(TpuBackendError):
     pass
 
 
+def _temporal_range_gate(out, mid, lo, hi, vm, mid_scale=1, extra_bad=None):
+    """Python datetimes span years [1, 9999]; device temporal arithmetic
+    beyond that must raise the oracle's typed range error, not silently
+    hold a proleptic value. The oracle raises at the MONTH step, so the
+    month-shifted intermediate (``mid``, in days — scaled when ``out`` is
+    in micros) is probed too. ONE any() sync; a violation routes the
+    expression to the host island where the oracle raises."""
+    if not out.shape[0]:
+        return
+    probe = jnp.where(vm, out, lo)
+    probe_mid = jnp.where(vm, mid, lo // mid_scale)
+    bad = (
+        (probe < lo)
+        | (probe > hi)
+        | (probe_mid < lo // mid_scale)
+        | (probe_mid > hi // mid_scale)
+    )
+    if extra_bad is not None:
+        bad = bad | extra_bad
+    if bool(jnp.any(bad)):
+        raise TpuUnsupportedExpr("temporal arithmetic needs the host island")
+
+
 # functions that must evaluate per row (never const-fold / vocab-map)
 _NONDETERMINISTIC = frozenset({"rand", "randomuuid"})
 
@@ -757,27 +780,18 @@ class TpuEvaluator:
             days = out_us // US_PER_DAY
             lo_d = encode_date(_dt.date(1, 1, 1))
             hi_d = encode_date(_dt.date(9999, 12, 31))
+            # sub-day remainders on VALID rows: the oracle demotes those to
+            # datetimes — a result type the column cannot hold — so they
+            # join the out-of-range probes in ONE fused island-routing sync
             vm = (
                 valid
                 if valid is not None
                 else jnp.ones(days.shape[0], bool)
             )
-            probe = jnp.where(vm, days, lo_d)
-            probe_mid = jnp.where(vm, mid_days, lo_d)
-            # ONE fused sync: sub-day remainders (the oracle demotes those
-            # rows to datetimes — a result type the column cannot hold) and
-            # out-of-range results both route to the host island
-            bad = (
-                jnp.any(dmic % US_PER_DAY != 0)
-                | (probe < lo_d).any()
-                | (probe > hi_d).any()
-                | (probe_mid < lo_d).any()
-                | (probe_mid > hi_d).any()
+            subday = jnp.where(vm, dmic, 0) % US_PER_DAY != 0
+            _temporal_range_gate(
+                days, mid_days, lo_d, hi_d, vm, extra_bad=subday
             )
-            if days.shape[0] and bool(bad):
-                raise TpuUnsupportedExpr(
-                    "date arithmetic needs the host island"
-                )
             return Column(DATE, days.astype(jnp.int32), valid)
         got = self._temporal_dur_operands(expr, l, r, (LDT, ZDT))
         if got is not None:
@@ -799,12 +813,6 @@ class TpuEvaluator:
                 off = parse_offset_str((t.vocab or ["+00:00"])[0])
                 local = t.data + off * US_PER_SECOND
             out, mid_days = add_duration_micros(local, months, ddays, dmic)
-            # Python datetimes span years [1, 9999]; results beyond that
-            # must raise the oracle's typed range error, not silently hold
-            # a proleptic value. The oracle raises at the MONTH step, so
-            # the month-shifted intermediate is probed too — route either
-            # violation to the host island (the oracle raises
-            # CypherTypeError there). One any() sync.
             vm = (
                 valid
                 if valid is not None
@@ -812,18 +820,9 @@ class TpuEvaluator:
             )
             lo_us = encode_ldt(_dt.datetime(1, 1, 1))
             hi_us = encode_ldt(_dt.datetime(9999, 12, 31, 23, 59, 59, 999999))
-            lo_d, hi_d = lo_us // US_PER_DAY, hi_us // US_PER_DAY
-            probe = jnp.where(vm, out, lo_us)
-            probe_mid = jnp.where(vm, mid_days, lo_d)
-            if out.shape[0] and bool(
-                jnp.any(
-                    (probe < lo_us)
-                    | (probe > hi_us)
-                    | (probe_mid < lo_d)
-                    | (probe_mid > hi_d)
-                )
-            ):
-                raise TpuUnsupportedExpr("temporal result out of range")
+            _temporal_range_gate(
+                out, mid_days, lo_us, hi_us, vm, mid_scale=US_PER_DAY
+            )
             if t.kind == LDT:
                 return Column(LDT, out, valid)
             return Column(ZDT, out - off * US_PER_SECOND, valid, t.vocab)

@@ -60,6 +60,15 @@ class RelationalCypherRecords:
 
         return Bag(self.collect())
 
+    def to_pandas(self):
+        """Result rows as a pandas DataFrame (the reference's
+        ``DataFrameOutputExample`` direction: ``records.asDataFrame``).
+        Elements render as their Cypher-value objects; plain columns keep
+        native dtypes via the value rows."""
+        import pandas as pd
+
+        return pd.DataFrame(self.collect(), columns=self.columns)
+
     def show(self, n: int = 20) -> str:
         from ..utils.printer import format_rows
 
