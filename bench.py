@@ -387,6 +387,23 @@ def pallas_vs_xla_probe() -> dict:
     return entry
 
 
+# libtpu env hints that make `import jax` try (and on a half-configured
+# host, CRASH) TPU plugin init even under JAX_PLATFORMS=cpu — observed in
+# round 5: missing TPU_ACCELERATOR_TYPE/TPU_WORKER_HOSTNAMES took the whole
+# bench down with rc=1 before a JSON line was printed. Once the probe has
+# decided CPU, scrub them so the fallback import is genuinely CPU-only.
+_TPU_ENV_HINTS = (
+    "TPU_LIBRARY_PATH",
+    "LIBTPU_INIT_ARGS",
+    "TPU_ACCELERATOR_TYPE",
+    "TPU_WORKER_HOSTNAMES",
+    "TPU_WORKER_ID",
+    "TPU_CHIPS_PER_HOST_BOUNDS",
+    "TPU_HOST_BOUNDS",
+    "TPU_SKIP_MDS_QUERY",
+)
+
+
 def main():
     force_cpu = os.environ.get("TPU_CYPHER_BENCH_FORCE_CPU") == "1"
     timeouts = [
@@ -401,6 +418,8 @@ def main():
         tpu_ok = probe_tpu(timeouts, probe_log)
     if not tpu_ok:
         os.environ["JAX_PLATFORMS"] = "cpu"
+        for k in _TPU_ENV_HINTS:
+            os.environ.pop(k, None)
     import jax
 
     if not tpu_ok:
@@ -464,4 +483,26 @@ def main():
 
 
 if __name__ == "__main__":
-    main()
+    try:
+        main()
+    except Exception:
+        # the bench trajectory must NEVER flatline at null: whatever broke,
+        # print a valid JSON line carrying the error and exit 0 (the driver
+        # records stdout; rc=1 with no line records nothing)
+        import traceback
+
+        tb = traceback.format_exc()
+        sys.stderr.write(tb)
+        print(
+            json.dumps(
+                {
+                    "metric": "edge_expansions_per_sec_2hop_engine",
+                    "value": 0.0,
+                    "unit": "expansions/s",
+                    "vs_baseline": 0.0,
+                    "validated_vs_engine": False,
+                    "tpu_init_failed": True,
+                    "error": tb[-800:],
+                }
+            )
+        )

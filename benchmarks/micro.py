@@ -32,20 +32,30 @@ import numpy as np
 
 
 def _bench(fn, reps):
+    """(median warm seconds, warm-phase compile count). A nonzero compile
+    count in the TIMED phase means the metric is measuring XLA, not the
+    kernel — the compiled-once/run-many regression signal per metric."""
+    from tpu_cypher.backend.tpu import bucketing
+
     fn()  # warm (compile caches, vocab builds)
+    before = bucketing.compile_snapshot()
     times = []
     for _ in range(reps):
         t0 = time.perf_counter()
         fn()
         times.append(time.perf_counter() - t0)
-    return float(np.median(times))
+    compiles = bucketing.compile_delta(before)["compiles"]
+    return float(np.median(times)), int(compiles)
 
 
 def main():
     rows = int(os.environ.get("MICRO_ROWS", "200000"))
     reps = int(os.environ.get("MICRO_REPS", "3"))
 
+    from tpu_cypher.backend.tpu import bucketing
     from tpu_cypher.backend.tpu.table import TpuTable
+
+    bucketing.install_compile_listener()
 
     rng = np.random.default_rng(11)
     build_n = rows // 2
@@ -53,12 +63,16 @@ def main():
     build_ids = np.arange(build_n, dtype=np.int64)
     payload = rng.standard_normal(build_n)
 
-    def emit(metric, secs, n=rows, **extra):
+    def emit(metric, bench_out, n=rows, **extra):
+        secs, compiles = bench_out
         out = {
             "metric": metric,
             "value": round(n / secs, 1),
             "unit": "rows/s",
             "seconds": round(secs, 6),
+            # compiles observed in the TIMED (warm) reps: nonzero means the
+            # metric measured XLA compilation, not the kernel
+            "compiles_warm": compiles,
         }
         out.update(extra)
         print(json.dumps(out))
@@ -120,6 +134,81 @@ def main():
         _bench(lambda: s1.union_all(s2), reps),
         n=2 * vhalf,
     )
+
+    # -- engine cold vs warm: plan -> records latency --------------------
+    # The production signal behind shape bucketing + the persistent cache:
+    # a COLD query pays parse/plan/compile, a WARM re-run of the same plan
+    # should pay dispatch only (compiles_warm == 0). With
+    # TPU_CYPHER_BUCKET set, re-running at a different MICRO_ROWS keeps
+    # compiles_cold near zero too once the bucket lattice is warm.
+    from tpu_cypher import CypherSession
+    from tpu_cypher.io.ldbc import generate_snb
+    from tpu_cypher.relational.session import PropertyGraph
+
+    session = CypherSession.tpu()
+    g = PropertyGraph(session, generate_snb(0.1, session))
+    two_hop = (
+        "MATCH (a:Person)-[:KNOWS]->(b:Person)-[:KNOWS]->(c:Person) "
+        "RETURN count(*) AS c"
+    )
+
+    def run_once():
+        t0 = time.perf_counter()
+        before = bucketing.compile_snapshot()
+        session.cypher(two_hop, graph=g).records.collect()
+        compiles = bucketing.compile_delta(before)["compiles"]
+        return (time.perf_counter() - t0) * 1000.0, int(compiles)
+
+    cold_ms, cold_compiles = run_once()
+    warm = [run_once() for _ in range(reps)]
+    warm_ms = float(np.median([w[0] for w in warm]))
+    print(json.dumps({
+        "metric": "plan_to_result_ms_2hop",
+        "value": round(warm_ms, 3),
+        "unit": "ms",
+        "cold_ms": round(cold_ms, 3),
+        "warm_ms": round(warm_ms, 3),
+        "compiles_cold": cold_compiles,
+        "compiles_warm": int(sum(w[1] for w in warm)),
+        "bucket_mode": bucketing.mode(),
+    }))
+    # -- bucket-reuse proof: a DIFFERENT row count, zero new compiles ----
+    # With TPU_CYPHER_BUCKET set, re-running the warmed join at another
+    # size INSIDE the warmed bucket must compile nothing: the acceptance
+    # signal that the lattice, not the exact size, keys programs. The
+    # second size is derived from the bucket (3/4 of the bucket cap is
+    # always in (cap/2, cap], i.e. the same bucket as ``rows``) — a naive
+    # fraction of MICRO_ROWS can fall into the bucket below.
+    if bucketing.enabled():
+        cap = bucketing.round_size(rows)
+        rows2 = cap * 3 // 4 if cap * 3 // 4 != rows else cap * 5 // 8
+        build2 = rows2 // 2
+        l2 = TpuTable.from_numpy(
+            {"k": rng.integers(0, build2, rows2).astype(np.int64)}
+        )
+        r2 = TpuTable.from_numpy(
+            {"j": np.arange(build2, dtype=np.int64),
+             "p": rng.standard_normal(build2)}
+        )
+        before = bucketing.compile_snapshot()
+        l2.join(r2, "inner", [("k", "j")])
+        print(json.dumps({
+            "metric": "join_rebucket_compiles",
+            "value": bucketing.compile_delta(before)["compiles"],
+            "unit": "xla_compiles",
+            "rows": rows2,
+            "warmed_rows": rows,
+            "bucket_mode": bucketing.mode(),
+        }))
+
+    print(json.dumps({
+        "metric": "compile_count",
+        "value": bucketing.compile_count(),
+        "unit": "xla_compiles",
+        **bucketing.compile_snapshot(),
+        "bucket_mode": bucketing.mode(),
+        "persistent_cache_dir": bucketing.persistent_cache_dir(),
+    }))
 
 
 if __name__ == "__main__":

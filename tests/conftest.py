@@ -16,6 +16,7 @@ if "xla_force_host_platform_device_count" not in flags:
 os.environ.setdefault("JAX_ENABLE_X64", "1")
 
 import jax
+import pytest
 
 # belt and braces: some environments pre-select an accelerator platform
 # before env vars are read (e.g. an externally initialized plugin)
@@ -23,3 +24,35 @@ try:
     jax.config.update("jax_platforms", "cpu")
 except Exception:
     pass
+
+
+def _memory_map_count() -> int:
+    try:
+        with open("/proc/self/maps") as f:
+            return sum(1 for _ in f)
+    except OSError:  # non-Linux: no 65530 vm.max_map_count default either
+        return 0
+
+
+# Every compiled XLA executable pins a handful of memory mappings (JIT code
+# + guard pages); a full-suite run compiles tens of thousands of programs
+# and walks the process into the kernel's vm.max_map_count ceiling (65530
+# by default), at which point the NEXT LLVM compile mmap fails and the
+# whole pytest process dies with SIGSEGV/SIGABRT mid-suite. Dropping the
+# jit caches releases the executables (verified: maps fall back to
+# baseline), so flush them between modules once the table gets high — a
+# cross-module jit cache hit is rare enough that the recompiles cost far
+# less than losing the rest of the suite. Threshold: the largest single
+# module accumulates ~35k maps from a clean slate, so flushing above 25k
+# keeps even (threshold-1) + worst-module under the 65530 ceiling.
+_MAPS_FLUSH_THRESHOLD = 25_000
+
+
+@pytest.fixture(autouse=True, scope="module")
+def _bound_jit_code_maps():
+    yield
+    if _memory_map_count() > _MAPS_FLUSH_THRESHOLD:
+        import gc
+
+        gc.collect()  # drop dead tracers/arrays holding executables first
+        jax.clear_caches()

@@ -1,0 +1,266 @@
+"""Shape-bucketed execution (``TPU_CYPHER_BUCKET``): correctness + the
+compiled-once/run-many regression.
+
+Two guarantees under test:
+
+* DIFFERENTIAL — bucketing changes WHICH static sizes programs compile at
+  (rounded up the lattice, true counts traced, pad lanes masked dead), and
+  must never change a result: every corpus query returns the identical
+  record bag under ``pow2``/``1.25`` and ``off``.
+* NO-RECOMPILE — the whole point of the lattice: re-running the same plan
+  shape at a DIFFERENT data size whose counts share the warmed buckets
+  must compile zero new XLA programs (the ``jax.monitoring``-fed counter
+  in ``backend.tpu.bucketing`` observes real ``backend_compile`` events
+  only — jit-cache hits count nothing).
+"""
+
+import numpy as np
+import pytest
+
+from tpu_cypher import CypherSession
+from tpu_cypher.backend.tpu import bucketing
+
+
+@pytest.fixture
+def bucket_mode(request):
+    """In-process override of TPU_CYPHER_BUCKET, always reset."""
+    bucketing.MODE.set(request.param)
+    yield request.param
+    bucketing.MODE.reset()
+
+
+# ---------------------------------------------------------------------------
+# differential: bucketed records == off records, query corpus
+# ---------------------------------------------------------------------------
+
+# one seeded random graph: labels, props with nulls, parallel structure,
+# loops excluded (kept simple — loop semantics are covered elsewhere)
+def _create_query(n=29, e=61, seed=7):
+    rng = np.random.default_rng(seed)
+    parts = []
+    for i in range(n):
+        props = [f"id:{i * 3 + 1}"]
+        if i % 4 != 0:  # every 4th node: null age
+            props.append(f"age:{int(rng.integers(18, 70))}")
+        props.append(f"name:'p{i:02d}'")
+        label = "Person" if i % 5 else "Admin:Person"
+        parts.append(f"(n{i}:{label} {{{', '.join(props)}}})")
+    src = rng.integers(0, n, e)
+    dst = rng.integers(0, n, e)
+    for s, d in zip(src, dst):
+        if s == d:
+            continue
+        since = int(rng.integers(2000, 2024))
+        parts.append(f"(n{s})-[:KNOWS {{since:{since}}}]->(n{d})")
+    return "CREATE " + ", ".join(parts)
+
+
+# the acceptance-suite shapes, one query per device code path: scans,
+# filters, expands (directed/undirected/2-hop/into/var-length/optional),
+# joins, aggregation, distinct, order/limit, union, unwind, coalesce
+CORPUS = [
+    "MATCH (a:Person) RETURN a.name, a.age ORDER BY a.name",
+    "MATCH (a:Person) WHERE a.age > 40 RETURN count(*) AS c",
+    "MATCH (a:Person) WHERE a.age IS NULL RETURN a.name ORDER BY a.name",
+    "MATCH (a:Admin) RETURN count(*) AS c",
+    "MATCH (a:Person)-[r:KNOWS]->(b:Person) RETURN a.name, b.name, r.since",
+    "MATCH (a:Person)-[:KNOWS]->(b) WHERE b.age >= 30 RETURN a.name, b.age",
+    "MATCH (a)-[:KNOWS]-(b) RETURN count(*) AS c",
+    "MATCH (a:Person)-[:KNOWS]->(b:Person)-[:KNOWS]->(c:Person) "
+    "RETURN count(*) AS c",
+    "MATCH (a:Person)-[:KNOWS]->(b:Person)-[:KNOWS]->(c:Person) "
+    "WITH DISTINCT a, c RETURN count(*) AS pairs",
+    "MATCH (a)-[:KNOWS]->(b), (b)-[:KNOWS]->(c), (a)-[:KNOWS]->(c) "
+    "RETURN count(*) AS tri",
+    "MATCH (a:Person)-[:KNOWS*1..3]->(b:Person) RETURN count(*) AS walks",
+    "MATCH (a:Person) OPTIONAL MATCH (a)-[:KNOWS]->(b) "
+    "RETURN a.name, b.name",
+    "MATCH (a:Person)-[r:KNOWS]->(b) RETURN r.since AS y, count(*) AS c "
+    "ORDER BY c DESC, y LIMIT 7",
+    "MATCH (a:Person) RETURN DISTINCT a.age AS age ORDER BY age",
+    "MATCH (a:Person) RETURN sum(a.age) AS s, min(a.age) AS lo, "
+    "max(a.age) AS hi, avg(a.age) AS m",
+    "MATCH (a:Admin) RETURN a.name AS x UNION ALL "
+    "MATCH (b:Person) WHERE b.age < 25 RETURN b.name AS x",
+    "UNWIND [1, 2, 3, 4] AS v RETURN v * 2 AS d",
+    "MATCH (a:Person) RETURN coalesce(a.age, -1) AS age ORDER BY age",
+    "MATCH (a:Person) WITH a.age AS age WHERE age > 30 "
+    "RETURN count(*) AS c",
+    "MATCH (a:Person)-[:KNOWS]->(b) WHERE a.age > b.age "
+    "RETURN a.name, b.name",
+]
+
+
+@pytest.mark.parametrize("bucket_mode", ["pow2", "1.25"], indirect=True)
+def test_bucketed_records_identical_to_off(bucket_mode):
+    create = _create_query()
+    bucketing.MODE.set("off")
+    g_off = CypherSession.tpu().create_graph_from_create_query(create)
+    expected = {q: g_off.cypher(q).records.to_bag() for q in CORPUS}
+    bucketing.MODE.set(bucket_mode)
+    g_on = CypherSession.tpu().create_graph_from_create_query(create)
+    for q in CORPUS:
+        got = g_on.cypher(q).records.to_bag()
+        assert got == expected[q], (
+            f"\nbucket mode {bucket_mode} diverged\nquery: {q}"
+            f"\ngot: {got!r}\nexpected: {expected[q]!r}"
+        )
+
+
+# ---------------------------------------------------------------------------
+# no-recompile regression: same plan, different data sizes, shared buckets
+# ---------------------------------------------------------------------------
+
+
+def _ring_graph(session, n):
+    """Deterministic n-cycle: every intermediate count equals n, so all
+    sizes in (32, 64] land in identical buckets across the whole plan."""
+    parts = [f"(n{i}:P {{x:{i}}})" for i in range(n)]
+    parts += [f"(n{i})-[:R]->(n{(i + 1) % n})" for i in range(n)]
+    return session.create_graph_from_create_query("CREATE " + ", ".join(parts))
+
+
+@pytest.mark.parametrize("bucket_mode", ["pow2"], indirect=True)
+def test_two_hop_no_recompile_across_graph_sizes(bucket_mode):
+    session = CypherSession.tpu()
+    query = "MATCH (a:P)-[:R]->(b:P)-[:R]->(c:P) RETURN a.x, c.x"
+
+    def run(n):
+        # the window covers ingest + index build + plan execution: every
+        # compile anywhere on the path counts
+        before = bucketing.compile_snapshot()
+        g = _ring_graph(session, n)
+        result = g.cypher(query)
+        rows = result.records.collect()
+        assert len(rows) == n  # ring: exactly one 2-hop path per node
+        assert result.compile_stats is not None
+        return bucketing.compile_delta(before)["compiles"]
+
+    run(40)  # cold: compiles the bucket-64 lattice programs
+    # warmed: 48- and 56-ring intermediates share every bucket with the
+    # 40-ring — each jit composite must have compiled AT MOST once above
+    assert run(48) == 0
+    assert run(56) == 0
+
+
+@pytest.mark.parametrize("bucket_mode", ["pow2"], indirect=True)
+def test_join_no_recompile_within_bucket(bucket_mode):
+    from tpu_cypher.backend.tpu.table import TpuTable
+
+    def join_at(n):
+        # unique build keys: every probe row matches exactly once, so the
+        # match total is n — all of 40/48/56 share the 64 bucket end to end
+        left = TpuTable.from_numpy({"k": np.arange(n, dtype=np.int64)})
+        right = TpuTable.from_numpy(
+            {
+                "j": np.arange(n, dtype=np.int64),
+                "p": np.arange(n, dtype=np.int64) * 10,
+            }
+        )
+        before = bucketing.compile_snapshot()
+        out = left.join(right, "inner", [("k", "j")])
+        assert out.size == n
+        return bucketing.compile_delta(before)["compiles"]
+
+    join_at(40)  # cold
+    assert join_at(48) == 0
+    assert join_at(56) == 0
+
+
+@pytest.mark.parametrize("bucket_mode", ["pow2"], indirect=True)
+def test_filter_no_recompile_within_bucket(bucket_mode):
+    # materializing filter: scan -> bucketed predicate + compaction ->
+    # host delivery (terminal EXACT-size eager ops like aggregation are
+    # out of the bucketing contract and would compile per size)
+    session = CypherSession.tpu()
+    query = "MATCH (a:P) WHERE a.x >= 2 RETURN a.x"
+
+    def run(n):
+        before = bucketing.compile_snapshot()
+        g = _ring_graph(session, n)
+        result = g.cypher(query)
+        assert len(result.records.collect()) == n - 2
+        return bucketing.compile_delta(before)["compiles"]
+
+    run(40)
+    assert run(48) == 0
+    assert run(56) == 0
+
+
+# ---------------------------------------------------------------------------
+# warmup + telemetry surface
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("bucket_mode", ["pow2"], indirect=True)
+def test_warmup_second_pass_compiles_nothing(bucket_mode):
+    session = CypherSession.tpu()
+    g = _ring_graph(session, 40)
+    corpus = [
+        "MATCH (a:P)-[:R]->(b:P) RETURN a.x, b.x",
+        "MATCH (a:P) WHERE a.x > 5 RETURN count(*) AS c",
+    ]
+    first = session.warmup(corpus, graph=g)
+    assert first["queries"] == 2
+    assert len(first["per_query"]) == 2
+    second = session.warmup(corpus, graph=g)
+    assert second["compiles"] == 0
+
+
+def test_compile_stats_always_populated():
+    g = CypherSession.tpu().create_graph_from_create_query(
+        "CREATE (a:P {x:1})-[:R]->(b:P {x:2})"
+    )
+    result = g.cypher("MATCH (a:P)-[:R]->(b:P) RETURN a.x, b.x")
+    result.records.collect()
+    assert result.compile_stats is not None
+    assert set(result.compile_stats) == {"compiles", "compile_seconds"}
+    assert result.compile_stats["compiles"] >= 0
+
+
+# ---------------------------------------------------------------------------
+# the lattice itself
+# ---------------------------------------------------------------------------
+
+
+def test_round_size_off_is_identity():
+    bucketing.MODE.set("off")
+    try:
+        assert [bucketing.round_size(n) for n in (0, 1, 7, 100)] == [0, 1, 7, 100]
+    finally:
+        bucketing.MODE.reset()
+
+
+def test_round_size_pow2_lattice():
+    bucketing.MODE.set("pow2")
+    try:
+        assert bucketing.round_size(0) == 0  # empty keeps its own program
+        assert bucketing.round_size(1) == 32  # floor
+        assert bucketing.round_size(33) == 64
+        assert bucketing.round_size(64) == 64
+        assert bucketing.round_size(65) == 128
+    finally:
+        bucketing.MODE.reset()
+
+
+def test_round_size_125_lattice_monotone():
+    bucketing.MODE.set("1.25")
+    try:
+        sizes = [bucketing.round_size(n) for n in range(1, 4000, 13)]
+        assert all(
+            s >= n for s, n in zip(sizes, range(1, 4000, 13))
+        )
+        assert sizes == sorted(sizes)
+        # <= 25% overhead above the floor
+        for n in (100, 500, 3000):
+            assert bucketing.round_size(n) <= int(n * 1.25) + 1
+    finally:
+        bucketing.MODE.reset()
+
+
+def test_round_up_pow2_shared_helper():
+    assert bucketing.round_up_pow2(1) == 1
+    assert bucketing.round_up_pow2(3) == 4
+    assert bucketing.round_up_pow2(16) == 16
+    assert bucketing.round_up_pow2(17) == 32
+    assert bucketing.round_up_pow2(5, floor=16) == 16

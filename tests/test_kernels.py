@@ -187,9 +187,18 @@ def test_microbenchmarks_run(monkeypatch):
         )
     lines = [l for l in buf.getvalue().splitlines() if l.strip()]
     assert len(lines) >= 8
+    kernel_lines = 0
     for l in lines:
         rec = json.loads(l)
-        assert rec["value"] > 0 and rec["unit"] == "rows/s"
+        if rec["unit"] == "rows/s":
+            kernel_lines += 1
+            assert rec["value"] > 0
+            assert rec["compiles_warm"] >= 0
+        elif rec["unit"] == "ms":  # cold-vs-warm plan_to_result latency
+            assert rec["value"] > 0 and rec["cold_ms"] > 0 and rec["warm_ms"] > 0
+        else:  # compile telemetry summary lines
+            assert rec["unit"] == "xla_compiles" and rec["value"] >= 0
+    assert kernel_lines >= 8
 
 
 def test_pallas_frontier_degree_sum_matches_jnp():

@@ -1,0 +1,193 @@
+"""Shape bucketing + compile telemetry: kill recompilation on the hot path.
+
+Every data-dependent output size in the TPU backend (a join match count, an
+expand frontier total, a filter survivor count) is baked STATIC into its
+jitted materialize program (``jnp.nonzero(size=..)``,
+``total_repeat_length=..``), so two queries whose intermediates differ only
+in row count compile two distinct XLA programs. Under production traffic
+the relational plan is stable while data sizes vary per request — making
+per-query recompilation the dominant latency term (EmptyHeaded and TrieJax
+both get their wins from compiled-once/run-many relational kernels).
+
+This module is the shared policy for the fix:
+
+* ``round_size(n)`` rounds a data-dependent size UP to a bucket lattice
+  (``TPU_CYPHER_BUCKET=off|pow2|1.25``); materialize programs run at the
+  bucketed size with the TRUE count carried as a traced operand and the pad
+  lanes masked invalid — the same pad-masking discipline already proven for
+  mesh-sharding pads (``Column.pad`` / ``compact_lookup`` validity gating).
+  Two row counts in the same bucket now hit the same compiled program.
+* a process-wide XLA compile counter fed by ``jax.monitoring`` (one
+  ``backend_compile`` event per real compilation) — surfaced as
+  ``result.compile_stats``, ``session.warmup(..)`` deltas, and the
+  ``compile_count`` metrics in ``benchmarks/micro.py``.
+* the persistent compilation cache wiring (``enable_persistent_cache``), so
+  warm caches survive process restarts.
+
+Bucketing is OFF by default: enable with ``TPU_CYPHER_BUCKET=pow2`` (or the
+coarser-memory/finer-latency ``1.25`` lattice). Differential tests pin
+bucketed results bit-identical to ``off``.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, Optional
+
+import numpy as np
+
+from ...utils.config import ConfigOption
+
+# off  — no bucketing (every size compiles its own program; seed behavior)
+# pow2 — next power of two at/above _BUCKET_FLOOR (<= 2x memory overhead)
+# 1.25 — geometric lattice of ratio 1.25 (<= 25% overhead, more programs)
+MODE = ConfigOption("TPU_CYPHER_BUCKET", "off", str)
+
+# smallest nonzero bucket: tiny intermediates all share one program
+_BUCKET_FLOOR = 32
+
+# 2^62: sorts/compares above every real element id or probe key (graph tags
+# live at bits 54+); the pad sentinel for id-sorted device arrays
+ID_SENTINEL = np.int64(1) << 62
+
+
+def mode() -> str:
+    m = MODE.get().strip().lower()
+    return m if m in ("off", "pow2", "1.25") else "off"
+
+
+def enabled() -> bool:
+    return mode() != "off"
+
+
+def round_up_pow2(n: int, floor: int = 1) -> int:
+    """Smallest power of two >= max(n, floor). THE shared rounding helper —
+    also used by ``parallel.shuffle``'s bucket capacities so the shard_map
+    program caches collapse onto one lattice."""
+    n = max(int(n), int(floor))
+    return 1 << (n - 1).bit_length() if n > 1 else 1
+
+
+# 1.25-lattice, grown lazily; starts at the floor
+_LATTICE_125 = [_BUCKET_FLOOR]
+_LATTICE_LOCK = threading.Lock()
+
+
+def _round_125(n: int) -> int:
+    with _LATTICE_LOCK:
+        while _LATTICE_125[-1] < n:
+            prev = _LATTICE_125[-1]
+            _LATTICE_125.append(max(prev + 1, int(prev * 1.25)))
+        import bisect
+
+        return _LATTICE_125[bisect.bisect_left(_LATTICE_125, n)]
+
+
+def round_size(n: int) -> int:
+    """Bucketed size for a data-dependent count ``n`` (0 stays 0 — the
+    empty case keeps its own trivially-cheap program). Identity when
+    bucketing is off."""
+    n = int(n)
+    if n <= 0:
+        return 0
+    m = mode()
+    if m == "off":
+        return n
+    if m == "1.25":
+        return _round_125(n)
+    return round_up_pow2(n, _BUCKET_FLOOR)
+
+
+def bucket_pad_host(arr: np.ndarray, fill):
+    """Host-side tail pad of ``arr``'s leading dim up to ``round_size``.
+    Returns ``(padded array, pad)``; identity when bucketing is off."""
+    arr = np.asarray(arr)
+    if not enabled() or arr.ndim == 0:
+        return arr, 0
+    n = arr.shape[0]
+    pad = round_size(n) - n
+    if pad <= 0:
+        return arr, 0
+    tail = np.full((pad,) + arr.shape[1:], fill, dtype=arr.dtype)
+    return np.concatenate([arr, tail]), pad
+
+
+# ---------------------------------------------------------------------------
+# compile telemetry: count real XLA compilations via jax.monitoring
+# ---------------------------------------------------------------------------
+
+_COMPILES = 0
+_COMPILE_SECONDS = 0.0
+_LISTENER_INSTALLED = False
+
+
+def _on_event_duration(name: str, secs: float, **_kw) -> None:
+    global _COMPILES, _COMPILE_SECONDS
+    # '/jax/core/compile/backend_compile_duration' fires once per actual
+    # XLA compilation (cache hits emit no event)
+    if name.endswith("backend_compile_duration"):
+        _COMPILES += 1
+        _COMPILE_SECONDS += float(secs)
+
+
+def install_compile_listener() -> None:
+    """Idempotently hook the process-wide compile counter into
+    ``jax.monitoring``. Cheap: one string check per monitoring event."""
+    global _LISTENER_INSTALLED
+    if _LISTENER_INSTALLED:
+        return
+    import jax.monitoring
+
+    jax.monitoring.register_event_duration_secs_listener(_on_event_duration)
+    _LISTENER_INSTALLED = True
+
+
+def compile_count() -> int:
+    return _COMPILES
+
+
+def compile_snapshot() -> Dict[str, float]:
+    return {"compiles": _COMPILES, "compile_seconds": round(_COMPILE_SECONDS, 6)}
+
+
+def compile_delta(before: Dict[str, float]) -> Dict[str, float]:
+    now = compile_snapshot()
+    return {
+        "compiles": now["compiles"] - before.get("compiles", 0),
+        "compile_seconds": round(
+            now["compile_seconds"] - before.get("compile_seconds", 0.0), 6
+        ),
+    }
+
+
+# ---------------------------------------------------------------------------
+# persistent compilation cache
+# ---------------------------------------------------------------------------
+
+_CACHE_DIR: Optional[str] = None
+
+
+def enable_persistent_cache(cache_dir: str) -> None:
+    """Point JAX's persistent compilation cache at ``cache_dir`` so warm
+    caches survive process restarts (the disk tier under the in-process
+    jit caches; shape bucketing keeps the entry count bounded). Safe to
+    call repeatedly with the same directory."""
+    global _CACHE_DIR
+    import jax
+
+    jax.config.update("jax_compilation_cache_dir", cache_dir)
+    # default thresholds skip small/fast programs — the engine's composites
+    # are exactly those, and they are the ones worth persisting
+    for k, v in (
+        ("jax_persistent_cache_min_compile_time_secs", 0.0),
+        ("jax_persistent_cache_min_entry_size_bytes", -1),
+    ):
+        try:
+            jax.config.update(k, v)
+        except Exception:  # older/newer JAX without the knob
+            pass
+    _CACHE_DIR = cache_dir
+
+
+def persistent_cache_dir() -> Optional[str]:
+    return _CACHE_DIR

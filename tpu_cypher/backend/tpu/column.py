@@ -81,6 +81,20 @@ INTEGRAL_KINDS = (I64, BOOL, STR, DATE, LDT, ZDT, ZT, LT)
 _NULL_CODE = np.int32(-1)
 
 
+def device_padded(host_arr, fill):
+    """Host array -> device array tail-padded with ``fill`` to the shape
+    bucket (``bucketing.round_size``, identity when ``TPU_CYPHER_BUCKET`` is
+    off) and then to a mesh-shard multiple. Returns ``(device array, total
+    pad)``. THE ingest-side sizing discipline: bucketed ingestion makes two
+    graphs/tables whose row counts share a bucket hit the same compiled
+    programs downstream (pad rows are always marked/treated invalid)."""
+    from .bucketing import bucket_pad_host
+
+    arr, bpad = bucket_pad_host(np.asarray(host_arr), fill)
+    dev, mpad = padded_to_mesh(arr, fill)
+    return dev, bpad + mpad
+
+
 def _obj_array(vals) -> np.ndarray:
     """ALWAYS-1-D object array (np.array() on equal-length list values
     silently builds 2-D, breaking concat and row gathers)."""
@@ -176,14 +190,14 @@ class Column:
     @staticmethod
     def _ingest(data_np: np.ndarray, valid_np: Optional[np.ndarray], fill):
         """Host arrays -> (device data, device valid, pad, pad_synth) with
-        mesh-sharding padding: pad rows are ALWAYS invalid (the valid mask
-        is synthesized when the logical column has none)."""
-        data, pad = padded_to_mesh(data_np, fill)
+        shape-bucket + mesh-sharding padding: pad rows are ALWAYS invalid
+        (the valid mask is synthesized when the logical column has none)."""
+        data, pad = device_padded(data_np, fill)
         if valid_np is not None:
-            v, _ = padded_to_mesh(valid_np, False)
+            v, _ = device_padded(valid_np, False)
             return data, v, pad, False
         if pad:
-            v, _ = padded_to_mesh(np.ones(len(data_np), bool), False)
+            v, _ = device_padded(np.ones(len(data_np), bool), False)
             return data, v, pad, True
         return data, None, pad, False
 
@@ -200,7 +214,7 @@ class Column:
             data, v, pad, ps = Column._ingest(data_np, hv, fill)
             iflag = None
             if iflag_np is not None and iflag_np.any():
-                iflag = padded_to_mesh(iflag_np, False)[0]
+                iflag = device_padded(iflag_np, False)[0]
             return Column(
                 kind, data, v, vocab, int_flag=iflag,
                 _np_cache=data_np, _np_valid=hv, pad=pad, pad_synth=ps,
@@ -674,6 +688,19 @@ def mask_to_idx(mask) -> Tuple[Any, int]:
     from .jit_ops import mask_to_idx as _jit_mask_to_idx
 
     return _jit_mask_to_idx(mask)
+
+
+def mask_to_idx_bucketed(mask) -> Tuple[Any, int]:
+    """``mask_to_idx`` with the index array padded to the shape bucket:
+    returns (index array of ``round_size(count)`` lanes, true count). Pad
+    lanes hold index 0 (duplicates of a real row) — consumers mark lanes at
+    or past ``count`` invalid (``jit_ops.cols_take_counted``), keeping the
+    tail-pad invariant. One scalar sync, same as the exact form."""
+    from .bucketing import round_size
+    from .jit_ops import mask_nonzero, mask_sum
+
+    count = int(mask_sum(mask))
+    return mask_nonzero(mask, size=round_size(count)), count
 
 
 def constant_column(value: Any, n: int) -> Column:

@@ -767,8 +767,19 @@ class TpuEvaluator:
             if t.kind in (ZT, LT):
                 # time/localtime: only sub-day components apply, the clock
                 # wraps modulo 24h, the offset is unchanged (the oracle's
-                # _add_duration_time; months/days are whole days = 0 mod 24h)
-                out = (t.data + dmic) % US_PER_DAY
+                # _add_duration_time; months/days are whole days = 0 mod
+                # 24h). The ZT lane is signed UNWRAPPED local-minus-offset
+                # micros: wrap on the LOCAL clock, then re-subtract the
+                # offset ((data + off + dmic) mod day - off)
+                off_us = 0
+                if t.kind == ZT:
+                    from .temporal import US_PER_SECOND, parse_offset_str
+
+                    off_us = (
+                        parse_offset_str((t.vocab or ["+00:00"])[0])
+                        * US_PER_SECOND
+                    )
+                out = (t.data + off_us + dmic) % US_PER_DAY - off_us
                 return Column(t.kind, out, valid, t.vocab)
             # DATE + duration: the oracle demotes to a datetime when a
             # sub-day remainder survives — a data-dependent result TYPE the
@@ -953,6 +964,23 @@ class TpuEvaluator:
             return Column(I64, args[0].data.astype(jnp.int64), args[0].valid)
         if name == "coalesce":
             kinds = {a.kind for a in args}
+
+            def obj_blend(blend_args):
+                # host-side blend: OBJ columns (lists/elements) are numpy
+                # object arrays, null encoded as None
+                import numpy as np
+
+                out_vals = list(blend_args[-1].data)
+                for a in reversed(blend_args[:-1]):
+                    out_vals = [
+                        v if v is not None else o
+                        for v, o in zip(list(a.data), out_vals)
+                    ]
+                arr = np.empty(len(out_vals), dtype=object)
+                for i, v in enumerate(out_vals):
+                    arr[i] = v
+                return Column(OBJ, arr, None)
+
             if kinds <= {I64, F64} and len(kinds) > 1:
                 args = [a.as_f64_keeping_intness() for a in args]
             elif kinds == {STR}:
@@ -962,20 +990,16 @@ class TpuEvaluator:
                 merged = sorted({s for a in args for s in (a.vocab or [])})
                 args = [_remap(a, merged) for a in args]
             elif kinds == {OBJ}:
-                # host-side blend: OBJ columns (lists/elements) are numpy
-                # object arrays, null encoded as None
-                import numpy as np
-
-                out_vals = list(args[-1].data)
-                for a in reversed(args[:-1]):
-                    out_vals = [
-                        v if v is not None else o
-                        for v, o in zip(list(a.data), out_vals)
-                    ]
-                arr = np.empty(len(out_vals), dtype=object)
-                for i, v in enumerate(out_vals):
-                    arr[i] = v
-                return Column(OBJ, arr, None)
+                return obj_blend(args)
+            elif kinds in ({ZDT}, {ZT}) and len(
+                {tuple(a.vocab or ()) for a in args}
+            ) > 1:
+                # DIFFERENT column zone offsets: the vocab carries one
+                # offset for the whole result, so blending device lanes
+                # would silently re-zone rows taken from the other
+                # arguments — the exact zone loss ``Column._concat``
+                # guards against. Blend host-exact instead.
+                return obj_blend([a.to_obj() for a in args])
             elif len(kinds) > 1:
                 raise TpuUnsupportedExpr("heterogeneous coalesce")
             out = args[-1]

@@ -32,8 +32,15 @@ from ...ir import expr as E
 from ...parallel.mesh import current_mesh, mesh_size
 from ...relational.header import RecordHeader
 from ...relational.ops import RelationalOperator
+from . import bucketing
 from . import jit_ops as J
-from .column import OBJ, Column, TpuBackendError, mask_to_idx as _mask_to_idx
+from .column import (
+    OBJ,
+    Column,
+    TpuBackendError,
+    mask_to_idx as _mask_to_idx,
+    mask_to_idx_bucketed as _mask_to_idx_bucketed,
+)
 from .graph_index import CANON_NODE, CANON_REL, GraphIndex, GraphIndexError, rekey_element_expr
 
 
@@ -146,6 +153,7 @@ def _fused_chain_walk(
     mask_pairs = mask_pairs or {}
     carried: Dict[str, Any] = {}
     last = hops[0]
+    bucketed = bucketing.enabled()
     for hop in reversed(hops):
         rp, ci, eo = gi.csr(hop.types_key, hop.backwards, ctx)
         mask = gi.label_mask(hop.far_labels, ctx)
@@ -153,24 +161,32 @@ def _fused_chain_walk(
         total = int(t_dev)
         if total == 0:
             return 0
+        # bucketed: the static materialize size rounds up to the lattice;
+        # the true count rides as a traced operand (``nvalid``) and pad
+        # lanes come out dead (present=False / excluded from the final sum)
+        size = bucketing.round_size(total)
+        # always pass the traced count when bucketing (even on an exact
+        # bucket hit) so each bucket size compiles exactly ONE program
+        nvalid = t_dev if bucketed else None
         order = tuple(sorted(carried))
         prevs = tuple(carried[r] for r in order)
         midx = tuple(order.index(r) for r in mask_pairs.get(hop.rel_fld, ()))
         if hop is last:
             return final(
-                rp, ci, eo, pos, deg, akey, mask, prevs, order, midx, total
+                rp, ci, eo, pos, deg, akey, mask, prevs, order, midx, size,
+                nvalid,
             )
         if order or hop.rel_fld in carry_rels:
             akey, pos, orig, prevs_out, present = J.unique_hop_materialize(
                 rp, ci, eo, pos, deg, akey, mask, prevs,
-                total=total, mask_idx=midx,
+                total=size, mask_idx=midx, nvalid=nvalid,
             )
             carried = dict(zip(order, prevs_out))
             if hop.rel_fld in carry_rels:
                 carried[hop.rel_fld] = orig
         else:
             akey, pos, present = J.distinct_hop_materialize(
-                rp, ci, pos, deg, akey, mask, total=total
+                rp, ci, pos, deg, akey, mask, total=size, nvalid=nvalid
             )
     raise AssertionError("unreachable: loop always hits hops[0]")
 
@@ -294,10 +310,18 @@ class _FusedExpandBase(RelationalOperator):
         """Materializing-path enforcement: mask rows violating any enforced
         pair and compact (``extras``: whatever arrays ride along — far
         rows, swapped flags). Shared by the expand and expand-into
-        materializers so the keep/compact discipline cannot diverge."""
+        materializers so the keep/compact discipline cannot diverge. Under
+        bucketing the arrays may carry pad lanes past ``n_out`` (masked
+        dead) and the compaction itself is bucket-sized."""
         if not self.enforced_pairs or not n_out:
             return row, orig, extras, n_out
         keep = self._enforce_pair_ids(gi, ctx, row, orig)
+        if bucketing.enabled():
+            if int(row.shape[0]) != n_out:
+                keep = keep & J.row_tail_mask(row, n_out)
+            idx, n2 = _mask_to_idx_bucketed(keep)
+            taken = J.tree_take((row, orig) + tuple(extras), idx)
+            return taken[0], taken[1], tuple(taken[2:]), n2
         n2 = int(J.mask_sum(keep))
         if n2 != n_out:
             idx = J.mask_nonzero(keep, size=n2)
@@ -333,6 +357,7 @@ class _FusedExpandBase(RelationalOperator):
         plan: Dict[str, Tuple[Column, str]],
         idx_by_tag: Dict[str, Any],
         null_mask_by_tag: Optional[Dict[str, Any]] = None,
+        count: Optional[int] = None,
     ) -> Dict[str, Column]:
         """Execute a tagged gather plan: ONE jitted dispatch per index
         source for all device columns, host path for OBJ columns. A tag
@@ -340,7 +365,9 @@ class _FusedExpandBase(RelationalOperator):
         rows where the mask is False come out null. Empty source columns
         (zero-row scans) take the per-column path, whose empty-source
         branch emits all-null rows instead of a non-empty take from an
-        empty axis."""
+        empty axis. ``count``: bucketed true row count — index arrays
+        longer than it carry pad lanes, gathered device rows past it come
+        out invalid, OBJ columns gather the exact prefix."""
         masks = null_mask_by_tag or {}
         out: Dict[str, Column] = {}
         for tag, idx in idx_by_tag.items():
@@ -348,22 +375,40 @@ class _FusedExpandBase(RelationalOperator):
             if not group:
                 continue
             mask = masks.get(tag)
+            size = int(idx.shape[0])
+            counted = count is not None and mask is None and size != count
             dev = {
                 c: (s.data, s.valid, s.int_flag)
                 for c, s in group.items()
                 if s.kind != OBJ and not (mask is not None and len(s) == 0)
             }
             if dev:
-                taken = (
-                    J.cols_take(dev, idx)
-                    if mask is None
-                    else J.cols_take_or_null(dev, idx, mask)
-                )
+                if counted:
+                    taken = J.cols_take_counted(dev, idx, count)
+                else:
+                    taken = (
+                        J.cols_take(dev, idx)
+                        if mask is None
+                        else J.cols_take_or_null(dev, idx, mask)
+                    )
                 for c, (d, v, i) in taken.items():
                     s = group[c]
-                    out[c] = Column(s.kind, d, v, s.vocab, int_flag=i)
+                    if counted:
+                        out[c] = Column(
+                            s.kind, d, v, s.vocab, int_flag=i,
+                            pad=size - count,
+                            pad_synth=s.valid is None or s.pad_synth,
+                        )
+                    else:
+                        out[c] = Column(s.kind, d, v, s.vocab, int_flag=i)
+            idx_host = None
             for c, s in group.items():
                 if c in out:
+                    continue
+                if counted:
+                    if idx_host is None:
+                        idx_host = np.asarray(idx)[:count]
+                    out[c] = s.take(idx_host)
                     continue
                 out[c] = s.take(idx) if mask is None else s.take_or_null(idx, mask)
         return out
@@ -433,12 +478,24 @@ class _FusedExpandBase(RelationalOperator):
                 plan[col] = (node_cols[node_header.column(key)], "far")
                 continue
             raise GraphIndexError(f"unmapped expr {e!r}")
-        out = self._gather_plan(plan, {"row": row, "orig": orig, "far": far_rows})
+        count = n_out if bucketing.enabled() else None
+        out = self._gather_plan(
+            plan, {"row": row, "orig": orig, "far": far_rows}, count=count
+        )
         for c, (a, b) in swap_plan.items():
             data, valid = J.gather_swapped(
                 a.data, b.data, a.valid, b.valid, orig, swapped
             )
-            out[c] = Column(a.kind, data, valid, a.vocab)
+            size = int(data.shape[0])
+            if count is not None and size != count:
+                live = J.row_tail_mask(data, count)
+                valid = live if valid is None else valid & live
+                out[c] = Column(
+                    a.kind, data, valid, a.vocab, pad=size - count,
+                    pad_synth=a.valid is None and b.valid is None,
+                )
+            else:
+                out[c] = Column(a.kind, data, valid, a.vocab)
         return TpuTable(out, n_out)
 
 
@@ -497,16 +554,29 @@ class CsrExpandOp(_FusedExpandBase):
         return f"({self.frontier_fld}){arrow}[{self.rel_fld}:{t}]({self.far_fld}){uniq}"
 
     def _expand_half(self, gi: GraphIndex, pos, present, reverse: bool, drop_loops: bool):
+        """One CSR expand half. Returns ``(row, nbr, orig, count)`` where
+        ``count`` is the TRUE emission count; under bucketing the arrays
+        are tail-padded past it (pad lanes sanitized to row 0)."""
         ctx = self.context
         rp, ci, eo = gi.csr(self.types_key, reverse, ctx)
         deg, t_dev = J.expand_degrees_total(rp, pos, present)
         total = int(t_dev)
+        if bucketing.enabled():
+            size = bucketing.round_size(total)
+            row, nbr, orig, live = J.expand_materialize_counted(
+                rp, ci, eo, pos, deg, t_dev, size=size
+            )
+            if drop_loops and total:
+                keep = J.drop_loops_mask(nbr, pos, row) & live
+                idx, total = _mask_to_idx_bucketed(keep)
+                row, nbr, orig = J.tree_take((row, nbr, orig), idx)
+            return row, nbr, orig, total
         row, nbr, orig = J.expand_materialize(rp, ci, eo, pos, deg, total=total)
         if drop_loops and total:
             keep = J.drop_loops_mask(nbr, pos, row)
-            idx, _ = _mask_to_idx(keep)
+            idx, total = _mask_to_idx(keep)
             row, nbr, orig = J.tree_take((row, nbr, orig), idx)
-        return row, nbr, orig
+        return row, nbr, orig, total
 
     def _chain_hops(self) -> List["CsrExpandOp"]:
         """Walk the input chain of directly-stacked CsrExpandOps over the
@@ -561,11 +631,12 @@ class CsrExpandOp(_FusedExpandBase):
                 )
             carry, mask_pairs, _ = spec
 
-            def final(rp, ci, eo, pos, deg, akey, mask, prevs, order, midx, total):
+            def final(rp, ci, eo, pos, deg, akey, mask, prevs, order, midx,
+                      total, nvalid=None):
                 return int(
                     J.chain_count_final_unique(
                         rp, ci, eo, pos, deg, mask, prevs,
-                        total=total, mask_idx=midx,
+                        total=total, mask_idx=midx, nvalid=nvalid,
                     )
                 )
 
@@ -681,7 +752,8 @@ class CsrExpandOp(_FusedExpandBase):
                 if got is not None:
                     return got
 
-            def final(rp, ci, eo, pos, deg, akey, mask, prevs, order, midx, total):
+            def final(rp, ci, eo, pos, deg, akey, mask, prevs, order, midx,
+                      total, nvalid=None):
                 # final hop: fused materialize + distinct count
                 if midx:
                     return int(
@@ -689,6 +761,7 @@ class CsrExpandOp(_FusedExpandBase):
                             rp, ci, eo, pos, deg, akey, mask, prevs,
                             total=total, use_a=use_a, use_c=use_c,
                             num_nodes=gi.num_nodes, mask_idx=midx,
+                            nvalid=nvalid,
                         )
                     )
                 n = gi.num_nodes
@@ -700,14 +773,14 @@ class CsrExpandOp(_FusedExpandBase):
                         J.distinct_bitmap_final(
                             rp, ci, pos, deg, akey, mask,
                             total=total, use_a=use_a, use_c=use_c,
-                            num_nodes=n,
+                            num_nodes=n, nvalid=nvalid,
                         )
                     )
                 return int(
                     J.distinct_pairs_count_final(
                         rp, ci, pos, deg, akey, mask,
                         total=total, use_a=use_a, use_c=use_c,
-                        num_nodes=gi.num_nodes,
+                        num_nodes=gi.num_nodes, nvalid=nvalid,
                     )
                 )
 
@@ -798,24 +871,52 @@ class CsrExpandOp(_FusedExpandBase):
         id_col = in_t._cols[in_op.header.column(in_op.header.id_expr(frontier_var))]
         pos, present = gi.compact_of(id_col, ctx)
         primary_reverse = self.backwards
-        row, nbr, orig = self._expand_half(
+        bucketed = bucketing.enabled()
+        row, nbr, orig, n_live = self._expand_half(
             gi, pos, present, reverse=primary_reverse, drop_loops=False
         )
         swapped = None
         if self.undirected:
-            row2, nbr2, orig2 = self._expand_half(
+            row2, nbr2, orig2, n2 = self._expand_half(
                 gi, pos, present, reverse=not primary_reverse, drop_loops=True
             )
+            if bucketed:
+                live = J.concat_pair(
+                    J.row_tail_mask(row, n_live), J.row_tail_mask(row2, n2)
+                )
             row, nbr, orig, swapped = J.concat_expand_halves(
                 row, nbr, orig, row2, nbr2, orig2
             )
+            n_live += n2
+            if bucketed and int(row.shape[0]) != n_live:
+                # the halves' tail pads land mid-array after the concat:
+                # compact back to the tail-pad form (pad lanes duplicate
+                # lane 0, dead past ``n_live``)
+                idx = J.mask_nonzero(live, size=bucketing.round_size(n_live))
+                row, nbr, orig, swapped = J.tree_take(
+                    (row, nbr, orig, swapped), idx
+                )
         # far-end label filter + node-table row lookup in one gather
         _, _, row_map = gi.node_scan(self.far_labels, ctx)
         if gi.num_nodes and not self.far_labels:
             # unrestricted far end: every neighbour is in the scan, so the
             # keep mask is all-true by construction — skip the count sync
             far_rows, _ = J.far_lookup(row_map, nbr)
-            n_out = int(row.shape[0])
+            n_out = n_live if bucketed else int(row.shape[0])
+        elif gi.num_nodes and bucketed:
+            far_rows, keep = J.far_lookup(row_map, nbr)
+            if int(row.shape[0]) != n_live:
+                # pad lanes duplicate a real neighbour and would pass the
+                # label probe — they are not rows
+                keep = keep & J.row_tail_mask(keep, n_live)
+            idx, n_out = _mask_to_idx_bucketed(keep)
+            if n_out != n_live or int(idx.shape[0]) != int(row.shape[0]):
+                if swapped is not None:
+                    row, orig, far_rows, swapped = J.tree_take(
+                        (row, orig, far_rows, swapped), idx
+                    )
+                else:
+                    row, orig, far_rows = J.tree_take((row, orig, far_rows), idx)
         elif gi.num_nodes:
             far_rows, keep = J.far_lookup(row_map, nbr)
             n_out = int(J.mask_sum(keep))
@@ -895,13 +996,21 @@ class CsrExpandIntoOp(_FusedExpandBase):
         )
 
     def _probe(self, gi: GraphIndex, keys, s_pos, t_pos, ok, drop_loops: bool):
+        """Closing-edge probe + materialize. Returns ``(row, orig, count)``;
+        under bucketing the arrays are tail-padded past the true count."""
         ctx = self.context
         _, _, eo = gi.csr(self.types_key, False, ctx)
         lo, counts, total_dev = J.into_probe(
             keys, s_pos, t_pos, ok, gi.num_nodes, drop_loops=drop_loops
         )
         total = int(total_dev)
-        return J.into_materialize(eo, lo, counts, total=total)
+        if bucketing.enabled():
+            row, orig, _ = J.into_materialize_counted(
+                eo, lo, counts, total_dev, size=bucketing.round_size(total)
+            )
+            return row, orig, total
+        row, orig = J.into_materialize(eo, lo, counts, total=total)
+        return row, orig, total
 
     def _chain_close_count(self) -> Optional[int]:
         """count(*) over ExpandInto(fused expand chain) WITHOUT materializing
@@ -973,7 +1082,8 @@ class CsrExpandIntoOp(_FusedExpandBase):
                 )
 
                 def final_u(
-                    rp, ci, eo, pos, deg, akey, mask, prevs, order, midx, total
+                    rp, ci, eo, pos, deg, akey, mask, prevs, order, midx,
+                    total, nvalid=None,
                 ):
                     sub_idx = tuple(order.index(r) for r in sub_rels)
                     return int(
@@ -982,7 +1092,7 @@ class CsrExpandIntoOp(_FusedExpandBase):
                             total=total, src_is_base=src_is_base,
                             num_nodes=gi.num_nodes,
                             mask_idx=midx, sub_idx=sub_idx, sub_cur=sub_cur,
-                            dense=dense,
+                            dense=dense, nvalid=nvalid,
                         )
                     )
 
@@ -1008,13 +1118,15 @@ class CsrExpandIntoOp(_FusedExpandBase):
                     if got is not None:
                         return got
 
-            def final(rp, ci, eo, pos, deg, akey, mask, prevs, order, midx, total):
+            def final(rp, ci, eo, pos, deg, akey, mask, prevs, order, midx,
+                      total, nvalid=None):
                 return int(
                     J.into_close_count(
                         rp, ci, pos, deg, akey, mask, keys,
                         total=total, src_is_base=src_is_base,
                         num_nodes=gi.num_nodes,
                         undirected=self.undirected, dense=dense,
+                        nvalid=nvalid,
                     )
                 )
 
@@ -1119,12 +1231,24 @@ class CsrExpandIntoOp(_FusedExpandBase):
         t_pos, t_ok = gi.compact_of(t_col, ctx)
         ok = s_ok & t_ok
         keys = gi.edge_keys(self.types_key, ctx)
-        row, orig = self._probe(gi, keys, s_pos, t_pos, ok, drop_loops=False)
+        bucketed = bucketing.enabled()
+        row, orig, n_live = self._probe(gi, keys, s_pos, t_pos, ok, drop_loops=False)
         swapped = None
         if self.undirected:
-            row2, orig2 = self._probe(gi, keys, t_pos, s_pos, ok, drop_loops=True)
+            row2, orig2, n2 = self._probe(
+                gi, keys, t_pos, s_pos, ok, drop_loops=True
+            )
+            if bucketed:
+                live = J.concat_pair(
+                    J.row_tail_mask(row, n_live), J.row_tail_mask(row2, n2)
+                )
             row, orig, swapped = J.concat_into_halves(row, orig, row2, orig2)
-        n_out = int(row.shape[0])
+            n_live += n2
+            if bucketed and int(row.shape[0]) != n_live:
+                # restore the tail-pad form (see CsrExpandOp._fused_table)
+                idx = J.mask_nonzero(live, size=bucketing.round_size(n_live))
+                row, orig, swapped = J.tree_take((row, orig, swapped), idx)
+        n_out = n_live if bucketed else int(row.shape[0])
         extras = () if swapped is None else (swapped,)
         row, orig, extras, n_out = self._apply_enforced_pairs(
             gi, ctx, row, orig, extras, n_out
@@ -1182,7 +1306,12 @@ class CsrOptionalExpandOp(_FusedExpandBase):
             raise GraphIndexError("empty graph: classic outer join handles")
         pos, present = gi.compact_of(id_col, ctx)
         rp, ci, eo = gi.csr(self.types_key, self.backwards, ctx)
-        deg, counts, t_dev = J.optional_expand_degrees(rp, pos, present)
+        # bucket/shard pad rows are not input rows: they must emit NOTHING
+        # (an unmatched REAL row emits one null row; a pad row none)
+        nrows = in_t.size if in_t._phys != in_t.size else None
+        deg, counts, t_dev = J.optional_expand_degrees(
+            rp, pos, present, nrows=nrows
+        )
         total = int(t_dev)
         row, nbr, orig, matched = J.optional_expand_materialize(
             rp, ci, eo, pos, deg, counts, total=total
@@ -1424,13 +1553,19 @@ class CsrVarExpandOp(_FusedExpandBase):
                 if k:
                     idx = J.mask_nonzero(keep, size=k)
                     levels.append(J.tree_take((row00, far), idx))
+        bucketed = bucketing.enabled()
         for level in range(1, self._resolved_upper(ci) + 1):
             deg, t_dev = J.expand_degrees_total(rp, pos, present)
             total = int(t_dev)
             if total == 0:
                 break
+            # bucketed: every hop level whose emission count shares a
+            # bucket reuses ONE compiled hop program (the frontier loop's
+            # per-level sizes are the worst recompile driver otherwise)
             row0, nbr, orig, prev_edges, iso = J.varlen_hop(
-                rp, ci, eo, pos, deg, row0, prev_edges, total=total
+                rp, ci, eo, pos, deg, row0, prev_edges,
+                total=bucketing.round_size(total) if bucketed else total,
+                nvalid=t_dev if bucketed else None,
             )
             if level >= self.lower:
                 far, keep, k_dev = J.varlen_emit(nbr, iso, row_map)

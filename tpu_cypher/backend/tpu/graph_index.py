@@ -31,8 +31,8 @@ import jax.numpy as jnp
 
 from ...api import types as T
 from ...ir import expr as E
-from ...parallel.mesh import padded_to_mesh
-from .column import Column, TpuBackendError
+from .bucketing import ID_SENTINEL, bucket_pad_host
+from .column import Column, TpuBackendError, device_padded
 
 # canonical scan variable names (reserved: queries cannot produce '$' vars)
 CANON_NODE = "$gi_n"
@@ -138,9 +138,16 @@ class GraphIndex:
 
     @property
     def num_nodes(self) -> int:
+        """Size of the DEVICE compact-id space: the logical node count
+        rounded up to the shape bucket when bucketing is on (the device
+        ``node_ids`` array is tail-padded with an above-every-id sentinel).
+        Pad ids exist only on device — degree 0, row_map -1, label masks
+        False — so every kernel treats them as absent nodes; keeping the
+        static ``num_nodes`` argument on the bucket lattice is what lets
+        two graphs of different logical size share compiled programs."""
         if self._node_ids is None:
             raise GraphIndexError("node ids not built yet")
-        return int(self._node_ids[1].shape[0])
+        return int(self._node_ids[0].shape[0])
 
     def node_scan(self, labels: Tuple[str, ...], ctx):
         """Canonical node scan for a label set: (columns, header, row_map).
@@ -166,14 +173,19 @@ class GraphIndex:
                 sorted_ids = np.sort(ids_np)
                 if len(sorted_ids) and (sorted_ids[1:] == sorted_ids[:-1]).any():
                     raise GraphIndexError("duplicate node ids")
-                self._node_ids = (jnp.asarray(sorted_ids), sorted_ids)
+                # device id array tail-padded to the shape bucket with an
+                # above-every-id sentinel (searchsorted stays correct; no
+                # query id can equal 2^62); the HOST copy stays logical
+                dev_ids = bucket_pad_host(sorted_ids, ID_SENTINEL)[0]
+                self._node_ids = (jnp.asarray(dev_ids), sorted_ids)
         _, all_ids = self._node_ids
         n = len(all_ids)
         pos = np.searchsorted(all_ids, ids_np)
         pos = np.clip(pos, 0, max(n - 1, 0))
         if len(ids_np) and not (all_ids[pos] == ids_np).all():
             raise GraphIndexError("node scan id outside the graph id space")
-        row_map = np.full(n, -1, dtype=np.int64)
+        # device-space length (pad ids map to no scan row)
+        row_map = np.full(self.num_nodes, -1, dtype=np.int64)
         row_map[pos] = np.arange(len(ids_np), dtype=np.int64)
         self._row_map_np[key] = row_map
         out = (table._cols, header, jnp.asarray(row_map))
@@ -225,7 +237,10 @@ class GraphIndex:
             id_col = cols[header.column(header.id_expr(header.var(CANON_REL)))]
             ids = _host_logical(id_col, n)
             order = np.argsort(ids, kind="stable").astype(np.int64)
-            got = (jnp.asarray(ids[order]), jnp.asarray(order))
+            got = (
+                jnp.asarray(bucket_pad_host(ids[order], ID_SENTINEL)[0]),
+                jnp.asarray(bucket_pad_host(order, 0)[0]),
+            )
             self._rel_id_index[types_key] = got
         return got
 
@@ -239,16 +254,19 @@ class GraphIndex:
         start = cols[header.column(E.StartNode(rel))]
         end = cols[header.column(E.EndNode(rel))]
         _, all_ids = self.node_ids(ctx)
-        n = len(all_ids)
+        n_log = len(all_ids)
         s_ids = _host_logical(start, nrel)
         d_ids = _host_logical(end, nrel)
-        s = np.clip(np.searchsorted(all_ids, s_ids), 0, max(n - 1, 0)).astype(np.int64)
-        d = np.clip(np.searchsorted(all_ids, d_ids), 0, max(n - 1, 0)).astype(np.int64)
+        s = np.clip(np.searchsorted(all_ids, s_ids), 0, max(n_log - 1, 0)).astype(np.int64)
+        d = np.clip(np.searchsorted(all_ids, d_ids), 0, max(n_log - 1, 0)).astype(np.int64)
         if len(s_ids) and (
             not (all_ids[s] == s_ids).all() or not (all_ids[d] == d_ids).all()
         ):
             raise GraphIndexError("relationship endpoint not a graph node")
-        return s, d, n
+        # the returned node-space size is the DEVICE (bucketed) one: CSR
+        # row_ptrs, probe keys (src*N + dst), bitmaps and dense forms must
+        # all agree with the kernels' static num_nodes
+        return s, d, self.num_nodes
 
     @staticmethod
     def _sorted_csr(a: np.ndarray, b: np.ndarray, n: int):
@@ -272,15 +290,16 @@ class GraphIndex:
         degs = row_ptr[1:] - row_ptr[:-1]
         self._csr_max_deg[(types_key, reverse)] = int(degs.max()) if n else 0
         out = (
-            # row_ptr is node-dim (replicated); the edge-dim arrays shard
-            # over the active mesh, padded to a shard multiple — the
-            # hash-partitioned-relationship-table analog (SURVEY §2.3). Pad
-            # safety: every consumer reads edges through row_ptr ranges
-            # (all < the logical edge count) or clips gathers, so the -1
-            # col_idx / 0 edge_orig tail is never observed.
+            # row_ptr is node-dim (replicated); the edge-dim arrays pad to
+            # the shape bucket and shard over the active mesh (padded to a
+            # shard multiple) — the hash-partitioned-relationship-table
+            # analog (SURVEY §2.3). Pad safety: every consumer reads edges
+            # through row_ptr ranges (all < the logical edge count) or
+            # clips gathers, so the -1 col_idx / 0 edge_orig tail is never
+            # observed.
             jnp.asarray(row_ptr),
-            padded_to_mesh(b[order].astype(np.int32), -1)[0],
-            padded_to_mesh(order.astype(np.int64), 0)[0],
+            device_padded(b[order].astype(np.int32), -1)[0],
+            device_padded(order.astype(np.int64), 0)[0],
         )
         self._csr[(types_key, reverse)] = out
         if not reverse and types_key not in self._edge_keys:
@@ -288,7 +307,7 @@ class GraphIndex:
             # the pad sentinel sorts past every real (src*N + dst) key so
             # binary-search probes are unaffected
             keys = a_sorted.astype(np.int64) * n + b[order].astype(np.int64)
-            self._edge_keys[types_key] = padded_to_mesh(keys, (1 << 62))[0]
+            self._edge_keys[types_key] = device_padded(keys, (1 << 62))[0]
         if not reverse and types_key not in self._loop_count:
             loops = s[s == d]
             self._loop_count[types_key] = jnp.asarray(
@@ -319,8 +338,8 @@ class GraphIndex:
         row_ptr, order, _ = self._sorted_csr(a, b, n)
         out = (
             jnp.asarray(row_ptr),
-            padded_to_mesh(b[order].astype(np.int32), -1)[0],
-            padded_to_mesh(eo[order], 0)[0],
+            device_padded(b[order].astype(np.int32), -1)[0],
+            device_padded(eo[order], 0)[0],
         )
         self._csr_und[types_key] = out
         return out
@@ -348,7 +367,9 @@ class GraphIndex:
         if got is None:
             s, d, n = self._edge_endpoints(types_key, ctx)
             got = self._keys_by_orig[types_key] = jnp.asarray(
-                s.astype(np.int64) * n + d.astype(np.int64)
+                bucket_pad_host(
+                    s.astype(np.int64) * n + d.astype(np.int64), ID_SENTINEL
+                )[0]
             )
         return got
 

@@ -73,6 +73,14 @@ def _pack_fold(keys, pack):
     return acc
 
 
+def _live_lanes(total: int, nvalid):
+    """lane < nvalid over a bucket-padded axis (``total`` static lanes,
+    ``nvalid`` the traced true count). The shared bucket-pad liveness mask:
+    lanes at/past ``nvalid`` are pad lanes whose payload must be masked out
+    (see ``bucketing.round_size``)."""
+    return jnp.arange(total, dtype=jnp.int64) < nvalid
+
+
 # ---------------------------------------------------------------------------
 # masks / compaction
 # ---------------------------------------------------------------------------
@@ -81,6 +89,27 @@ def _pack_fold(keys, pack):
 @jax.jit
 def mask_sum(mask):
     return jnp.sum(mask)
+
+
+@jax.jit
+def row_tail_mask(template, n):
+    """bool[len(template)]: lane < n — the row-validity of a tail-padded
+    (bucketed/sharded) column axis, shaped off ``template``."""
+    return jnp.arange(template.shape[0], dtype=jnp.int64) < n
+
+
+@jax.jit
+def filter_keep_mask(data, valid, n):
+    """Filter keep mask over a bucket-padded table: predicate data AND its
+    validity AND lane < n (pad rows must never survive a filter even when
+    the predicate evaluates truthy on their duplicated payload)."""
+    keep = data & valid if valid is not None else data
+    return keep & (jnp.arange(keep.shape[0], dtype=jnp.int64) < n)
+
+
+@jax.jit
+def concat_pair(a, b):
+    return jnp.concatenate([a, b])
 
 
 @partial(jax.jit, static_argnames=("size",))
@@ -160,6 +189,26 @@ def tree_take(arrays, idx):
 
 
 @jax.jit
+def cols_take_counted(cols: Dict[str, Tuple[Any, Any, Any]], idx, count):
+    """``cols_take`` for a BUCKET-PADDED gather: ``idx`` has pad lanes past
+    the traced true ``count`` (filled with duplicate indices by the sizing
+    discipline); gathered rows at those lanes come out INVALID, so the
+    output is a tail-padded column set with ``count`` logical rows."""
+    live = jnp.arange(idx.shape[0], dtype=jnp.int64) < count
+    out = {}
+    for c, (data, valid, iflag) in cols.items():
+        d = jnp.take(data, idx, axis=0)
+        v = (
+            jnp.take(valid, idx, axis=0) & live
+            if valid is not None
+            else live
+        )
+        i = jnp.take(iflag, idx, axis=0) if iflag is not None else None
+        out[c] = (d, v, i)
+    return out
+
+
+@jax.jit
 def cols_concat(a_cols, b_cols):
     """UNION ALL for structurally simple columns: same kind/dtype/vocab on
     both sides — one dispatch for the whole table. Mixed valid/iflag
@@ -226,18 +275,43 @@ def expand_materialize(rp, ci, eo, pos, deg, total: int):
     return row, nbr, orig
 
 
+@partial(jax.jit, static_argnames=("size",))
+def expand_materialize_counted(rp, ci, eo, pos, deg, nvalid, size: int):
+    """``expand_materialize`` at a BUCKETED static ``size`` >= the true
+    total (``nvalid``, traced): pad lanes are sanitized to row/edge 0 (the
+    raw repeat pads run off the edge array — an out-of-bounds gather under
+    jit FILLS with int64 min, which must never escape as an index) and
+    reported dead via the returned ``live`` mask."""
+    row, edge = _expand_rows(jnp.take(rp, pos), deg, size)
+    live = _live_lanes(size, nvalid)
+    row = jnp.where(live, row, 0)
+    edge = jnp.where(live, edge, 0)
+    nbr = jnp.take(ci, edge).astype(jnp.int64)
+    orig = jnp.take(eo, edge)
+    nbr = jnp.where(live, nbr, 0)
+    orig = jnp.where(live, orig, 0)
+    return row, nbr, orig, live
+
+
 @jax.jit
 def drop_loops_mask(nbr, pos, row):
     return nbr != jnp.take(pos, row)
 
 
 @jax.jit
-def optional_expand_degrees(rp, pos, present):
+def optional_expand_degrees(rp, pos, present, nrows=None):
     """Row counts for a LEFT-OUTER expand: matched rows emit their degree,
-    unmatched (or absent-frontier) rows emit exactly ONE null-padded row."""
+    unmatched (or absent-frontier) rows emit exactly ONE null-padded row.
+    ``nrows`` (traced, optional): the table's LOGICAL row count — padding
+    tail rows (bucket/shard pads past it) are not input rows and emit
+    NOTHING (a pad row is not an unmatched row)."""
     deg = (jnp.take(rp, pos + 1) - jnp.take(rp, pos)).astype(jnp.int64)
     deg = jnp.where(present, deg, 0)
     counts = jnp.maximum(deg, 1)
+    if nrows is not None:
+        real = jnp.arange(counts.shape[0], dtype=jnp.int64) < nrows
+        deg = jnp.where(real, deg, 0)
+        counts = jnp.where(real, counts, 0)
     return deg, counts, jnp.sum(counts)
 
 
@@ -283,7 +357,7 @@ def into_probe(keys, s_pos, t_pos, ok, n, drop_loops: bool):
 def into_close_count(
     rp, ci, pos, deg, akey, mask, keys,
     total: int, src_is_base: bool, num_nodes: int, undirected: bool,
-    dense: bool = False,
+    dense: bool = False, nvalid=None,
 ):
     """Final hop of a count(*) triangle/cycle chain: expand the last hop's
     (base key, far position) pairs and, INSTEAD of materializing columns,
@@ -298,11 +372,20 @@ def into_close_count(
     the sorted key array (``GraphIndex.edge_bitmap``) — one gather per probe
     replaces two binary searches on host backends. Parallel edges are
     supported: the gathered value IS the count, summed exactly like the
-    searchsorted hi-lo range."""
+    searchsorted hi-lo range.
+
+    ``nvalid`` (traced, optional): true emission count when ``total`` is a
+    BUCKETED static size — pad lanes are sanitized and counted dead."""
     row, edge = _expand_rows(jnp.take(rp, pos), deg, total)
+    if nvalid is not None:
+        live = _live_lanes(total, nvalid)
+        row = jnp.where(live, row, 0)
+        edge = jnp.where(live, edge, 0)
     nbr = jnp.take(ci, edge).astype(jnp.int64)
     a = jnp.take(akey, row)
     ok = jnp.take(mask, nbr) if mask is not None else jnp.ones(total, bool)
+    if nvalid is not None:
+        ok = ok & live
     s, t = (a, nbr) if src_is_base else (nbr, a)
 
     def probe_count(s, t, ok):
@@ -331,6 +414,7 @@ def into_close_count_unique(
     rp, ci, eo, pos, deg, akey, mask, keys, keys_by_orig, prevs,
     total: int, src_is_base: bool, num_nodes: int,
     mask_idx: tuple, sub_idx: tuple, sub_cur: bool, dense: bool = False,
+    nvalid=None,
 ):
     """``into_close_count`` with openCypher relationship-uniqueness enforced
     IN the fused program (the reference gets the same semantics from explicit
@@ -350,10 +434,16 @@ def into_close_count_unique(
       already-subtracted edge — each distinct forbidden in-range edge
       subtracts once (parallel edges keep distinct scan rows — exact)."""
     row, edge = _expand_rows(jnp.take(rp, pos), deg, total)
+    if nvalid is not None:
+        live = _live_lanes(total, nvalid)
+        row = jnp.where(live, row, 0)
+        edge = jnp.where(live, edge, 0)
     nbr = jnp.take(ci, edge).astype(jnp.int64)
     orig = jnp.take(eo, edge)
     a = jnp.take(akey, row)
     ok = jnp.take(mask, nbr) if mask is not None else jnp.ones(total, bool)
+    if nvalid is not None:
+        ok = ok & live
     prevs_r = tuple(jnp.take(p, row) for p in prevs)
     for i in mask_idx:
         ok = ok & (orig != prevs_r[i])
@@ -383,6 +473,18 @@ def into_close_count_unique(
 def into_materialize(eo, lo, counts, total: int):
     row, edge = _expand_rows(lo, counts, total)
     return row, jnp.take(eo, edge)
+
+
+@partial(jax.jit, static_argnames=("size",))
+def into_materialize_counted(eo, lo, counts, nvalid, size: int):
+    """``into_materialize`` at a BUCKETED static ``size`` >= the true close
+    count (``nvalid``, traced): pad lanes are sanitized to row/edge 0 and
+    come out as tail pads masked dead downstream."""
+    row, edge = _expand_rows(lo, counts, size)
+    live = _live_lanes(size, nvalid)
+    row = jnp.where(live, row, 0)
+    edge = jnp.where(live, edge, 0)
+    return row, jnp.take(eo, edge), live
 
 
 @jax.jit
@@ -566,20 +668,26 @@ def rel_rows_of_ids(sorted_ids, perm, q, valid):
 
 
 @partial(jax.jit, static_argnames=("total",))
-def varlen_hop(rp, ci, eo, pos, deg, row0, prev_edges, total: int):
+def varlen_hop(rp, ci, eo, pos, deg, row0, prev_edges, total: int, nvalid=None):
     """One hop of a var-length expansion. State per partial path: origin
     input row ``row0`` (None on the first hop — the expansion row IS the
     origin), current node ``pos``, and the edge ids walked so far
     (``prev_edges``). Paths that would reuse an edge get ``iso=False`` and
     are dead: they emit nothing and expand no further (their next-hop
     degrees are masked to zero), exactly the unrolled planner's
-    ``id(step_i) <> id(step_j)`` filters."""
+    ``id(step_i) <> id(step_j)`` filters. ``nvalid`` (traced, optional):
+    true emission count when ``total`` is a BUCKETED static size — pad
+    lanes are sanitized and come out ``iso=False`` (dead paths)."""
     row, edge = _expand_rows(jnp.take(rp, pos), deg, total)
+    if nvalid is not None:
+        live = _live_lanes(total, nvalid)
+        row = jnp.where(live, row, 0)
+        edge = jnp.where(live, edge, 0)
     nbr = jnp.take(ci, edge).astype(jnp.int64)
     orig = jnp.take(eo, edge)
     new_row0 = jnp.take(row0, row) if row0 is not None else row
     new_prev = tuple(jnp.take(pe, row) for pe in prev_edges)
-    iso = jnp.ones(total, bool)
+    iso = jnp.ones(total, bool) if nvalid is None else _live_lanes(total, nvalid)
     for pe in new_prev:
         iso = iso & (orig != pe)
     return new_row0, nbr, orig, new_prev + (orig,), iso
@@ -625,28 +733,40 @@ _KEY_SENTINEL = (1 << 62) - 1  # sorts after every valid endpoint key
 
 
 @partial(jax.jit, static_argnames=("total",))
-def distinct_hop_materialize(rp, ci, pos, deg, akey, mask, total: int):
+def distinct_hop_materialize(rp, ci, pos, deg, akey, mask, total: int, nvalid=None):
     """One middle hop of a distinct-endpoints chain: expand (pos, akey)
     into per-edge (akey', pos', present') keeping ONLY the base key and the
     current node position — no column assembly at all. ``mask``: far-label
-    node mask or None."""
+    node mask or None. ``nvalid`` (traced, optional): true emission count
+    when ``total`` is bucketed — pad lanes come out present'=False."""
     row, edge = _expand_rows(jnp.take(rp, pos), deg, total)
+    if nvalid is not None:
+        live = _live_lanes(total, nvalid)
+        row = jnp.where(live, row, 0)
+        edge = jnp.where(live, edge, 0)
     nbr = jnp.take(ci, edge).astype(jnp.int64)
     akey_out = jnp.take(akey, row)
     present = jnp.take(mask, nbr) if mask is not None else jnp.ones(total, bool)
+    if nvalid is not None:
+        present = present & live
     return akey_out, nbr, present
 
 
 @partial(jax.jit, static_argnames=("total", "use_a", "use_c", "num_nodes"))
 def distinct_pairs_count_final(
     rp, ci, pos, deg, akey, mask, total: int, use_a: bool, use_c: bool,
-    num_nodes: int,
+    num_nodes: int, nvalid=None,
 ):
     """Final hop fused with the distinct count: materialize the last
     expansion's (base key, far position) pairs, pack them into one int64
     key, values-only sort (NO argsort payload — ~5x cheaper on TPU), and
-    count run boundaries. Masked-out rows sort to a sentinel tail."""
+    count run boundaries. Masked-out rows (and bucket-pad lanes past the
+    traced ``nvalid``) sort to a sentinel tail."""
     row, edge = _expand_rows(jnp.take(rp, pos), deg, total)
+    if nvalid is not None:
+        live = _live_lanes(total, nvalid)
+        row = jnp.where(live, row, 0)
+        edge = jnp.where(live, edge, 0)
     nbr = jnp.take(ci, edge).astype(jnp.int64)
     if use_a and use_c:
         key = jnp.take(akey, row) * num_nodes + nbr
@@ -654,8 +774,10 @@ def distinct_pairs_count_final(
         key = jnp.take(akey, row)
     else:
         key = nbr
-    if mask is not None:
-        present = jnp.take(mask, nbr)
+    present = jnp.take(mask, nbr) if mask is not None else None
+    if nvalid is not None:
+        present = live if present is None else (present & live)
+    if present is not None:
         key = jnp.where(present, key, _KEY_SENTINEL)
         valid_n = jnp.sum(present.astype(jnp.int64))
     else:
@@ -672,7 +794,7 @@ def distinct_pairs_count_final(
 @partial(jax.jit, static_argnames=("total", "use_a", "use_c", "num_nodes"))
 def distinct_bitmap_final(
     rp, ci, pos, deg, akey, mask,
-    total: int, use_a: bool, use_c: bool, num_nodes: int,
+    total: int, use_a: bool, use_c: bool, num_nodes: int, nvalid=None,
 ):
     """Host-backend variant of ``distinct_pairs_count_final``: scatter the
     packed endpoint keys into a presence bitmap and popcount — one random
@@ -681,6 +803,10 @@ def distinct_bitmap_final(
     TPU keeps the sort form (``lax.sort`` is fast there, scatter is not).
     Masked rows land in a spill slot past the counted range."""
     row, edge = _expand_rows(jnp.take(rp, pos), deg, total)
+    if nvalid is not None:
+        live = _live_lanes(total, nvalid)
+        row = jnp.where(live, row, 0)
+        edge = jnp.where(live, edge, 0)
     nbr = jnp.take(ci, edge).astype(jnp.int64)
     if use_a and use_c:
         key = jnp.take(akey, row) * num_nodes + nbr
@@ -691,8 +817,10 @@ def distinct_bitmap_final(
     else:
         key = nbr
         size = num_nodes
-    if mask is not None:
-        present = jnp.take(mask, nbr)
+    present = jnp.take(mask, nbr) if mask is not None else None
+    if nvalid is not None:
+        present = live if present is None else (present & live)
+    if present is not None:
         key = jnp.where(present, key, size)
     bitmap = jnp.zeros(size + 1, bool).at[key].set(True)
     return jnp.sum(bitmap[:size].astype(jnp.int64))
@@ -700,7 +828,8 @@ def distinct_bitmap_final(
 
 @partial(jax.jit, static_argnames=("total", "mask_idx"))
 def unique_hop_materialize(
-    rp, ci, eo, pos, deg, akey, mask, prevs, total: int, mask_idx: tuple
+    rp, ci, eo, pos, deg, akey, mask, prevs, total: int, mask_idx: tuple,
+    nvalid=None,
 ):
     """``distinct_hop_materialize`` carrying walked-edge scan rows for
     relationship uniqueness: expands into (akey', pos', edge', prevs',
@@ -709,11 +838,17 @@ def unique_hop_materialize(
     degrees zero out — the fused analog of the planner's per-step
     ``id(r_i) <> id(r_j)`` filters, same mechanism as ``varlen_hop``)."""
     row, edge = _expand_rows(jnp.take(rp, pos), deg, total)
+    if nvalid is not None:
+        live = _live_lanes(total, nvalid)
+        row = jnp.where(live, row, 0)
+        edge = jnp.where(live, edge, 0)
     nbr = jnp.take(ci, edge).astype(jnp.int64)
     orig = jnp.take(eo, edge)
     akey_out = jnp.take(akey, row)
     prevs_out = tuple(jnp.take(p, row) for p in prevs)
     present = jnp.take(mask, nbr) if mask is not None else jnp.ones(total, bool)
+    if nvalid is not None:
+        present = present & live
     for i in mask_idx:
         present = present & (orig != prevs_out[i])
     return akey_out, nbr, orig, prevs_out, present
@@ -721,16 +856,23 @@ def unique_hop_materialize(
 
 @partial(jax.jit, static_argnames=("total", "mask_idx"))
 def chain_count_final_unique(
-    rp, ci, eo, pos, deg, mask, prevs, total: int, mask_idx: tuple
+    rp, ci, eo, pos, deg, mask, prevs, total: int, mask_idx: tuple,
+    nvalid=None,
 ):
     """Final hop of a rel-unique chain count(*): materialize the last
     expansion's liveness only and sum it (the SpMV ``path_count_chain``
     cannot express per-path edge identity, so unique chains count via the
     walk)."""
     row, edge = _expand_rows(jnp.take(rp, pos), deg, total)
+    if nvalid is not None:
+        live = _live_lanes(total, nvalid)
+        row = jnp.where(live, row, 0)
+        edge = jnp.where(live, edge, 0)
     nbr = jnp.take(ci, edge).astype(jnp.int64)
     orig = jnp.take(eo, edge)
     ok = jnp.take(mask, nbr) if mask is not None else jnp.ones(total, bool)
+    if nvalid is not None:
+        ok = ok & live
     for i in mask_idx:
         ok = ok & (orig != jnp.take(prevs[i], row))
     return jnp.sum(ok.astype(jnp.int64))
@@ -743,11 +885,16 @@ def chain_count_final_unique(
 def distinct_pairs_count_final_unique(
     rp, ci, eo, pos, deg, akey, mask, prevs,
     total: int, use_a: bool, use_c: bool, num_nodes: int, mask_idx: tuple,
+    nvalid=None,
 ):
     """``distinct_pairs_count_final`` with walked-edge uniqueness masks:
     rows whose final edge equals a carried chain edge sort to the sentinel
     tail (they are not paths under openCypher rel-isomorphism)."""
     row, edge = _expand_rows(jnp.take(rp, pos), deg, total)
+    if nvalid is not None:
+        live = _live_lanes(total, nvalid)
+        row = jnp.where(live, row, 0)
+        edge = jnp.where(live, edge, 0)
     nbr = jnp.take(ci, edge).astype(jnp.int64)
     orig = jnp.take(eo, edge)
     if use_a and use_c:
@@ -757,6 +904,8 @@ def distinct_pairs_count_final_unique(
     else:
         key = nbr
     present = jnp.take(mask, nbr) if mask is not None else jnp.ones(total, bool)
+    if nvalid is not None:
+        present = present & live
     for i in mask_idx:
         present = present & (orig != jnp.take(prevs[i], row))
     key = jnp.where(present, key, _KEY_SENTINEL)
@@ -1340,6 +1489,62 @@ def join_materialize(r_idx_valid, lo, counts, total: int):
         jnp.take(r_idx_valid, flat) if total else jnp.zeros(0, jnp.int64)
     )
     return left_rows, right_rows
+
+
+@partial(jax.jit, static_argnames=("nvalid_cap", "is_f64", "is_bool"))
+def join_probe_bucketed(
+    rd, r_order, ld, lvalids, nvalid, nvalid_cap: int, is_f64: bool,
+    is_bool: bool,
+):
+    """``join_probe`` with the build-side valid count as a TRACED operand:
+    the static slice is the BUCKETED cap (``nvalid_cap`` >= nvalid), build
+    lanes at/past the true count are overwritten with a +max sentinel (the
+    array stays sorted: the valid-first build sort puts them at the tail),
+    and ``lo``/``hi`` clamp to ``nvalid`` so sentinel lanes can never match
+    — even a probe key equal to the sentinel value finds an empty range."""
+    lvalid = jnp.ones(ld.shape[0], bool)
+    for m in lvalids:
+        lvalid = lvalid & m
+    if is_f64:
+        lvalid = lvalid & ~jnp.isnan(ld)
+    if is_bool:
+        ld = ld.astype(jnp.int8)
+        rd = rd.astype(jnp.int8)
+    r_idx_valid = r_order[:nvalid_cap]
+    r_sorted = jnp.take(rd, r_idx_valid)
+    big = (
+        jnp.asarray(jnp.inf, r_sorted.dtype)
+        if is_f64
+        else jnp.asarray(jnp.iinfo(r_sorted.dtype).max, r_sorted.dtype)
+    )
+    lane = jnp.arange(nvalid_cap, dtype=jnp.int64)
+    r_sorted = jnp.where(lane < nvalid, r_sorted, big)
+    lo = jnp.minimum(jnp.searchsorted(r_sorted, ld, side="left"), nvalid)
+    hi = jnp.minimum(jnp.searchsorted(r_sorted, ld, side="right"), nvalid)
+    counts = jnp.where(lvalid, hi - lo, 0).astype(jnp.int64)
+    return r_idx_valid, lo, counts, jnp.sum(counts)
+
+
+@partial(jax.jit, static_argnames=("size",))
+def join_materialize_counted(r_idx_valid, lo, counts, nvalid, size: int):
+    """``join_materialize`` at a BUCKETED static ``size`` >= the true match
+    total (``nvalid``, traced): pad lanes are sanitized to pair (0, 0) and
+    reported dead via the returned ``live`` mask (the raw repeat pads run
+    past the build-row array — an out-of-bounds gather fill must never
+    escape as a row index)."""
+    left_rows, flat = _expand_rows(lo, counts, size)
+    live = _live_lanes(size, nvalid)
+    left_rows = jnp.where(live, left_rows, 0)
+    flat = jnp.where(live, flat, 0)
+    n_r = r_idx_valid.shape[0]
+    if n_r and size:
+        right_rows = jnp.take(
+            r_idx_valid, jnp.clip(flat, 0, n_r - 1)
+        )
+        right_rows = jnp.where(live, right_rows, 0)
+    else:
+        right_rows = jnp.zeros(size, jnp.int64)
+    return left_rows, right_rows, live
 
 
 @partial(jax.jit, static_argnames=("n",))

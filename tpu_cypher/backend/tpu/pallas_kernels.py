@@ -46,7 +46,9 @@ def _deg_sum_kernel(deg_ref, idx_ref, out_ref):
     idx = idx_ref[...]
     valid = idx >= 0  # padding / not-present slots are -1
     vals = deg_ref[jnp.clip(idx, 0, deg_ref.shape[0] - 1)]
-    out_ref[0, 0] = jnp.sum(jnp.where(valid, vals, 0))
+    # dtype pinned: under JAX_ENABLE_X64 jnp.sum accumulates int32 into
+    # int64 (numpy semantics), which the int32 out_ref rejects
+    out_ref[0, 0] = jnp.sum(jnp.where(valid, vals, 0), dtype=jnp.int32)
 
 
 @jax.jit

@@ -44,6 +44,7 @@ import jax.numpy as jnp
 from ...api import types as T
 from ...api.table import Table
 from ...api.types import CypherType
+from . import bucketing
 from . import jit_ops as J
 from .column import (
     BOOL,
@@ -59,6 +60,7 @@ from .column import (
     TpuBackendError,
     constant_column,
     mask_to_idx,
+    mask_to_idx_bucketed,
 )
 from .compiler import TpuEvaluator, TpuUnsupportedExpr
 
@@ -216,17 +218,18 @@ class TpuTable(Table):
         return self._nrows
 
     def column_values(self, col: str) -> List[Any]:
-        t = self._depad()
-        if t is not self:
-            return t.column_values(col)
-        return self._cols[col].to_values()
+        # decode the PHYSICAL column and slice host-side: a device depad
+        # here would compile one dynamic_slice program per logical row
+        # count (defeating shape bucketing on every result delivery); pad
+        # rows decode to None and fall off the list slice
+        return self._cols[col].to_values()[: self._nrows]
 
     def rows(self) -> Iterator[Dict[str, Any]]:
-        # NOTE: generator — an early `return other.rows()` would silently
-        # end iteration, so the depadded table is used inline
-        t = self._depad()
-        decoded = {c: col.to_values() for c, col in t._cols.items()}
-        for i in range(t._nrows):
+        # host-side decode + slice, same rationale as ``column_values``
+        decoded = {
+            c: col.to_values()[: self._nrows] for c, col in self._cols.items()
+        }
+        for i in range(self._nrows):
             yield {c: v[i] for c, v in decoded.items()}
 
     # -- simple ops --------------------------------------------------------
@@ -264,6 +267,37 @@ class TpuTable(Table):
                 out[c] = Column(col.kind, d, v, col.vocab, int_flag=i)
         return TpuTable(out, n)
 
+    def _take_counted(self, idx, count: int) -> "TpuTable":
+        """Bucketed gather: ``idx`` is padded to a shape bucket with
+        duplicate indices past the true ``count``; gathered device columns
+        come out tail-invalid past ``count`` (``cols_take_counted``), OBJ
+        columns gather the exact prefix. The bucketed analog of ``_take`` —
+        two tables whose counts share a bucket reuse one compiled gather."""
+        size = int(idx.shape[0])
+        if size == count:
+            return self._take(idx)
+        dev = {
+            c: (col.data, col.valid, col.int_flag)
+            for c, col in self._cols.items()
+            if col.kind != OBJ
+        }
+        taken = J.cols_take_counted(dev, idx, count) if dev else {}
+        idx_host = None
+        out: Dict[str, Column] = {}
+        for c, col in self._cols.items():
+            if col.kind == OBJ:
+                if idx_host is None:
+                    idx_host = np.asarray(idx)[:count]
+                out[c] = col.take(idx_host)
+            else:
+                d, v, i = taken[c]
+                out[c] = Column(
+                    col.kind, d, v, col.vocab, int_flag=i,
+                    pad=size - count,
+                    pad_synth=col.valid is None or col.pad_synth,
+                )
+        return TpuTable(out, count)
+
     def skip(self, n: int) -> "TpuTable":
         t = self._depad()
         if t is not self:
@@ -294,6 +328,8 @@ class TpuTable(Table):
     # -- filter ------------------------------------------------------------
 
     def filter(self, expr, header, parameters) -> "TpuTable":
+        if bucketing.enabled():
+            return self._filter_bucketed(expr, header, parameters)
         t = self._depad()
         if t is not self:
             return t.filter(expr, header, parameters)
@@ -306,12 +342,46 @@ class TpuTable(Table):
         idx, _ = self._mask_to_idx(J.and_valid_mask(c.data, c.valid))
         return self._take(idx)
 
+    def _filter_bucketed(self, expr, header, parameters) -> "TpuTable":
+        """Pad-aware filter: the predicate evaluates over the PHYSICAL
+        (bucket/shard-padded) arrays, the keep mask is AND-ed with the
+        row-tail validity (pad rows must never survive, whatever the
+        predicate computed on their duplicated payload — IS NULL is true on
+        them), and the survivor set compacts to a BUCKETED size. OBJ
+        columns are host arrays of logical length, so a table carrying one
+        takes the exact (depadded) path instead."""
+        phys = self._phys
+        if phys > self._nrows and any(
+            col.kind == OBJ for col in self._cols.values()
+        ):
+            t = self._depad()
+            return TpuTable.filter(t, expr, header, parameters)
+        try:
+            ev = TpuEvaluator(self, header, parameters)
+            ev.n = phys
+            c = ev.eval(expr)
+        except TpuUnsupportedExpr:
+            return self._from_local(
+                self._to_local('filter:expr').filter(expr, header, parameters)
+            )
+        if c.kind == OBJ:
+            return self._from_local(
+                self._to_local('filter:obj-mask').filter(expr, header, parameters)
+            )
+        keep = J.filter_keep_mask(c.data, c.valid, self._nrows)
+        idx, count = mask_to_idx_bucketed(keep)
+        return self._take_counted(idx, count)
+
     # -- join --------------------------------------------------------------
 
     def join(self, other: "TpuTable", kind, join_cols) -> "TpuTable":
-        t, o = self._depad(), other._depad()
-        if t is not self or o is not other:
-            return t.join(o, kind, join_cols)
+        # bucketed mode keeps pads: the device join folds explicit row-tail
+        # masks instead (pad rows can never match), so two inputs whose row
+        # counts share a bucket reuse one compiled join pipeline
+        if not bucketing.enabled():
+            t, o = self._depad(), other._depad()
+            if t is not self or o is not other:
+                return t.join(o, kind, join_cols)
         if kind == "cross":
             n, m = self._nrows, other._nrows
             li = jnp.repeat(jnp.arange(n), m)
@@ -389,7 +459,17 @@ class TpuTable(Table):
         )
         lvalids = l_extra_valid + ((lk.valid,) if lk.valid is not None else ())
         rvalids = r_extra_valid + ((rk.valid,) if rk.valid is not None else ())
+        bucketed = bucketing.enabled()
+        if bucketed:
+            # pad rows (bucket or shard tails) are NOT rows: fold explicit
+            # row-tail masks so they can never match, independent of any
+            # per-column mask bookkeeping
+            if int(lk.data.shape[0]) > self._nrows:
+                lvalids = lvalids + (J.row_tail_mask(lk.data, self._nrows),)
+            if int(rk.data.shape[0]) > other._nrows:
+                rvalids = rvalids + (J.row_tail_mask(rk.data, other._nrows),)
         left_rows = right_rows = None
+        match_bucketed = False  # match-pair arrays padded past ``total``
         packed_all_keys = False
         if (
             kind in ("inner", "left_outer", "full_outer")
@@ -443,13 +523,31 @@ class TpuTable(Table):
             # one scalar sync for the valid count)
             rd_s, r_order, nvalid_dev = J.join_build(rk.data, rvalids, is_f64=is_f64, is_bool=is_bool)
             nvalid = int(nvalid_dev)
-            # phase 2: probe by binary search (one dispatch, one sync)
-            r_idx_valid, lo, counts, total_dev = J.join_probe(
-                rd_s, r_order, lk.data, lvalids, nvalid=nvalid, is_f64=is_f64, is_bool=is_bool
-            )
-            total = int(total_dev)
-            # phase 3: materialize match pairs (one dispatch, static total)
-            left_rows, right_rows = J.join_materialize(r_idx_valid, lo, counts, total=total)
+            if bucketed:
+                # phases 2+3 at BUCKETED static sizes: the valid count and
+                # the match total ride as traced operands, so any inputs
+                # whose counts share buckets reuse these compiled programs
+                cap = min(
+                    bucketing.round_size(nvalid), int(r_order.shape[0])
+                )
+                r_idx_valid, lo, counts, total_dev = J.join_probe_bucketed(
+                    rd_s, r_order, lk.data, lvalids, nvalid_dev,
+                    nvalid_cap=cap, is_f64=is_f64, is_bool=is_bool,
+                )
+                total = int(total_dev)
+                size = bucketing.round_size(total)
+                left_rows, right_rows, _ = J.join_materialize_counted(
+                    r_idx_valid, lo, counts, total_dev, size=size
+                )
+                match_bucketed = size != total
+            else:
+                # phase 2: probe by binary search (one dispatch, one sync)
+                r_idx_valid, lo, counts, total_dev = J.join_probe(
+                    rd_s, r_order, lk.data, lvalids, nvalid=nvalid, is_f64=is_f64, is_bool=is_bool
+                )
+                total = int(total_dev)
+                # phase 3: materialize match pairs (one dispatch, static total)
+                left_rows, right_rows = J.join_materialize(r_idx_valid, lo, counts, total=total)
         # packed-key matches verify EVERY key column (hash collisions);
         # otherwise the probe key matched exactly and only extras need it
         post_cols = join_cols if packed_all_keys else join_cols[1:]
@@ -484,14 +582,33 @@ class TpuTable(Table):
             if never_match:
                 left_rows = jnp.zeros(0, jnp.int64)
                 right_rows = jnp.zeros(0, jnp.int64)
+                total = 0
+                match_bucketed = False
             elif kinds:
                 keep = J.extra_keys_keep(
                     tuple(l_datas), tuple(l_valids2), tuple(r_datas),
                     tuple(r_valids2), left_rows, right_rows, kinds=tuple(kinds),
                 )
-                idx, _ = self._mask_to_idx(keep)
-                left_rows, right_rows = J.tree_take((left_rows, right_rows), idx)
-        nmatched = int(left_rows.shape[0])
+                if match_bucketed:
+                    # pad lanes duplicate a real pair and might pass the
+                    # key check — they are not matches
+                    keep = keep & J.row_tail_mask(keep, total)
+                if bucketed:
+                    idx, total = mask_to_idx_bucketed(keep)
+                    left_rows, right_rows = J.tree_take((left_rows, right_rows), idx)
+                    match_bucketed = int(idx.shape[0]) != total
+                else:
+                    idx, _ = self._mask_to_idx(keep)
+                    left_rows, right_rows = J.tree_take((left_rows, right_rows), idx)
+        nmatched = total if bucketed else int(left_rows.shape[0])
+        if kind != "inner" and match_bucketed:
+            # outer shapes run the exact unmatched-row machinery: slice the
+            # tail-form match pairs to their true count first (one device
+            # slice; the outer pads would otherwise interleave with bucket
+            # pads and break the tail-pad invariant)
+            left_rows = left_rows[:nmatched]
+            right_rows = right_rows[:nmatched]
+            match_bucketed = False
         left_matched = None
         right_matched = None
         matched_right = right_rows
@@ -509,7 +626,8 @@ class TpuTable(Table):
                 nmiss=rnmiss, ncur=int(left_rows.shape[0]),
             )
         return self._combine(
-            other, left_rows, right_rows, right_matched, left_matched
+            other, left_rows, right_rows, right_matched, left_matched,
+            count=nmatched if match_bucketed else None,
         )
 
     def _join_empty_result(self, other: "TpuTable", kind) -> "TpuTable":
@@ -538,11 +656,18 @@ class TpuTable(Table):
         ri,
         right_in_bounds=None,
         left_in_bounds=None,
+        count: Optional[int] = None,
     ) -> "TpuTable":
+        """``count``: bucketed inner joins pass the TRUE pair count — the
+        index arrays are tail-padded past it, gathered device columns come
+        out tail-invalid, OBJ columns gather the exact prefix."""
         out: Dict[str, Column] = {}
         for c in other._cols:
             if c in self._cols:
                 raise TpuBackendError(f"Join column collision: {c}")
+        size = int(li.shape[0])
+        if count is not None and count == size:
+            count = None
         for cols, idx, in_bounds in (
             (self._cols, li, left_in_bounds),
             (other._cols, ri, right_in_bounds),
@@ -553,7 +678,9 @@ class TpuTable(Table):
                 for c, col in cols.items()
                 if col.kind != OBJ and (in_bounds is None or len(col) > 0)
             }
-            if dev:
+            if dev and count is not None:
+                taken = J.cols_take_counted(dev, idx, count)
+            elif dev:
                 taken = (
                     J.cols_take(dev, idx)
                     if in_bounds is None
@@ -561,15 +688,27 @@ class TpuTable(Table):
                 )
             else:
                 taken = {}
+            idx_host = None
             for c, col in cols.items():
                 if c in taken:
                     d, v, i = taken[c]
-                    out[c] = Column(col.kind, d, v, col.vocab, int_flag=i)
+                    if count is not None:
+                        out[c] = Column(
+                            col.kind, d, v, col.vocab, int_flag=i,
+                            pad=size - count,
+                            pad_synth=col.valid is None or col.pad_synth,
+                        )
+                    else:
+                        out[c] = Column(col.kind, d, v, col.vocab, int_flag=i)
+                elif count is not None:
+                    if idx_host is None:
+                        idx_host = np.asarray(idx)[:count]
+                    out[c] = col.take(idx_host)
                 elif in_bounds is None:
                     out[c] = col.take(idx)
                 else:
                     out[c] = col.take_or_null(idx, in_bounds)
-        n = int(li.shape[0])
+        n = count if count is not None else size
         return TpuTable(out, n)
 
     # -- union -------------------------------------------------------------
@@ -1016,6 +1155,38 @@ class TpuTable(Table):
                             pad_synth=True,
                         )
                 return TpuTable(out, self._nrows)
+            if bucketing.enabled() and not any(
+                c.kind == OBJ for c in self._cols.values()
+            ):
+                # pad-aware evaluation (same discipline as
+                # ``_filter_bucketed``): expressions run over the PHYSICAL
+                # bucket/shard-padded arrays — one compiled program per
+                # bucket instead of one per logical row count — and the new
+                # columns mark their pad tail invalid
+                try:
+                    ev = TpuEvaluator(self, header, parameters)
+                    ev.n = phys
+                    out = dict(self._cols)
+                    pad = phys - self._nrows
+                    new_cols = []
+                    for expr, col in items:
+                        c = ev.eval(expr)
+                        if c.kind == OBJ:
+                            raise TpuUnsupportedExpr(
+                                "host column at physical size"
+                            )
+                        new_cols.append((col, c))
+                    for col, c in new_cols:
+                        live = J.row_tail_mask(c.data, self._nrows)
+                        valid = live if c.valid is None else c.valid & live
+                        out[col] = Column(
+                            c.kind, c.data, valid, c.vocab,
+                            int_flag=c.int_flag, pad=pad,
+                            pad_synth=c.valid is None,
+                        )
+                    return TpuTable(out, self._nrows)
+                except TpuUnsupportedExpr:
+                    pass  # host fallback below needs the exact rows anyway
             t = self._depad()
             return t.with_columns(items, header, parameters)
         out = dict(self._cols)

@@ -87,10 +87,14 @@ def encode_time_of_day(t: _dt.time) -> int:
 
 
 def encode_zt(t: _dt.time) -> int:
-    """Aware time -> UTC-adjusted micros of day (comparable instants;
-    wraps modulo 24h the way zoned times order on the clock face)."""
+    """Aware time -> SIGNED unwrapped UTC-adjusted micros (local minus
+    offset, range (-14h, 38h)). The host oracle (Python aware-time
+    comparison) and Neo4j order/compare zoned times by this value WITHOUT
+    wrapping — a mod-24h lane would sort +02:00's 01:00 after 12:00 and
+    alias 23:00Z with 01:00+02:00. The wrap belongs only in duration
+    arithmetic and ``decode_zt``."""
     off = offset_seconds_of(t)
-    return (encode_time_of_day(t) - off * US_PER_SECOND) % US_PER_DAY
+    return encode_time_of_day(t) - off * US_PER_SECOND
 
 
 def decode_zt(adj_us: int, off_seconds: int) -> _dt.time:

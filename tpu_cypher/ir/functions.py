@@ -447,7 +447,10 @@ def _f_datetime(v=None):
         if "epochseconds" in v or "epochmillis" in v:
             us = int(v.get("epochseconds", 0)) * 1_000_000
             us += int(v.get("epochmillis", 0)) * 1000
-            return _dt.datetime.fromtimestamp(us / 1e6, _dt.timezone.utc).astimezone(tz)
+            # integer timedelta arithmetic: a float detour (us / 1e6)
+            # rounds at microsecond granularity for large epoch magnitudes
+            epoch = _dt.datetime(1970, 1, 1, tzinfo=_dt.timezone.utc)
+            return (epoch + _dt.timedelta(microseconds=us)).astimezone(tz)
         return _dt.datetime(
             int(v.get("year", 1)),
             int(v.get("month", 1)),
@@ -487,9 +490,11 @@ def _f_time(v=None):
     if isinstance(v, dict):
         v = {k.lower(): x for k, x in v.items()}
         tz = _tzinfo_of(str(v.get("timezone", "UTC")))
-        # named zones resolve their offset against the CURRENT date (the
-        # Neo4j rule) — a fixed reference date would freeze DST
-        off = tz.utcoffset(_dt.datetime.now())
+        # named zones resolve their offset against the CURRENT instant (the
+        # Neo4j rule) — via an AWARE UTC now: feeding a naive machine-local
+        # wall time to utcoffset() would read it as zone-local, making the
+        # result depend on the host's timezone (and wrong near DST edges)
+        off = _dt.datetime.now(_dt.timezone.utc).astimezone(tz).utcoffset()
         return _dt.time(
             int(v.get("hour", 0)),
             int(v.get("minute", 0)),

@@ -42,6 +42,7 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from ..backend.tpu.bucketing import round_up_pow2
 from .mesh import current_mesh, mesh_size, shard_map
 
 # Key namespace: real keys ship DOUBLED (even numbers — injective, equality
@@ -334,6 +335,9 @@ def broadcast_join(
     if out_cap == 0:
         z = jnp.zeros(0, jnp.int64)
         return z, z
+    # shared pow2 lattice (see hash_repartition_join): one compiled
+    # broadcast-materialize per bucket instead of one per match count
+    out_cap = round_up_pow2(out_cap, 16)
     l_out, r_out, valid = _bcast_materialize_fn(mesh, axis, out_cap)(
         lk, lrow, rk, rrow
     )
@@ -397,8 +401,13 @@ def hash_repartition_join(
 
     bl = int(lk.shape[0]) // nsh
     br = int(rk.shape[0]) // nsh
-    cap_l = max(int(bl / nsh * cap_factor) + 16, 16)
-    cap_r = max(int(br / nsh * cap_factor) + 16, 16)
+    # capacities snap to the SHARED power-of-two lattice
+    # (``bucketing.round_up_pow2`` — same helper as the shape buckets): the
+    # static cap is baked into the shard_map programs, so rounding makes
+    # nearby input sizes reuse one compiled exchange instead of compiling
+    # per size. Overflow detection keeps correctness; <=2x buffer slack.
+    cap_l = round_up_pow2(int(bl / nsh * cap_factor) + 16, 16)
+    cap_r = round_up_pow2(int(br / nsh * cap_factor) + 16, 16)
 
     counts, overflow = _count_fn(mesh, axis, nsh, cap_l, cap_r)(
         lk, lrow, rk, rrow
@@ -410,6 +419,9 @@ def hash_repartition_join(
     if out_cap == 0:
         z = jnp.zeros(0, jnp.int64)
         return z, z
+    # same lattice for the output capacity (slots past the true per-shard
+    # total come out valid=False and are compacted away below)
+    out_cap = round_up_pow2(out_cap, 16)
     l_out, r_out, valid = _materialize_fn(
         mesh, axis, nsh, cap_l, cap_r, out_cap
     )(lk, lrow, rk, rrow)

@@ -12,6 +12,7 @@ graphs and views, and supports driving tables (``readFrom``)."""
 from __future__ import annotations
 
 import itertools
+import os
 import re
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
@@ -113,11 +114,17 @@ class CypherResult:
         # materialized (populated on first .records access when the session
         # records fallbacks — VERDICT r2 weak #7)
         self.fallbacks: Optional[Dict[str, int]] = None
+        # per-query compile telemetry: {"compiles": n, "compile_seconds": s}
+        # of REAL XLA compilations observed while THIS result's plan
+        # materialized (jit/persistent-cache hits count zero — the
+        # compiled-once/run-many regression signal next to ``fallbacks``)
+        self.compile_stats: Optional[Dict[str, float]] = None
 
     @property
     def records(self) -> Optional[RelationalCypherRecords]:
         if self.relational_plan is None:
             return None
+        from ..backend.tpu import bucketing
         from ..utils.profiling import PROFILE_DIR, profile_trace
 
         track = getattr(self.session, "record_fallbacks", False)
@@ -126,11 +133,14 @@ class CypherResult:
             from ..backend.tpu.table import FALLBACK_COUNTER
 
             before = FALLBACK_COUNTER.snapshot()
+        compiles_before = bucketing.compile_snapshot()
         with profile_trace():  # no-op unless TPU_CYPHER_PROFILE_DIR is set
             table = self.relational_plan.table  # pulls the whole physical plan
             if PROFILE_DIR.get():
                 # async dispatch would escape the trace: block on device work
                 table = table.cache()
+        if self.compile_stats is None:
+            self.compile_stats = bucketing.compile_delta(compiles_before)
         if track and self.fallbacks is None:
             from ..backend.tpu.table import FALLBACK_COUNTER
 
@@ -206,13 +216,26 @@ class PropertyGraph:
 class CypherSession:
     """Reference ``CypherSession``/``RelationalCypherSession``."""
 
-    def __init__(self, table_cls):
+    def __init__(self, table_cls, persistent_cache_dir: Optional[str] = None):
+        from ..backend.tpu import bucketing
+
         self.table_cls = table_cls
         # when True, each CypherResult records the {reason: count} of
         # local-oracle fallbacks / host islands observed while it
         # materialized (``result.fallbacks``) — the per-query device-
         # coverage telemetry the acceptance-suite regression test reads
         self.record_fallbacks = False
+        # compile telemetry is always on (one string compare per
+        # jax.monitoring event): every result carries ``compile_stats``
+        bucketing.install_compile_listener()
+        # persistent compilation cache: the disk tier under the in-process
+        # jit caches, so warm programs survive process restarts. Option
+        # wins; the env var covers deployments that cannot touch code.
+        cache_dir = persistent_cache_dir or os.environ.get(
+            "TPU_CYPHER_COMPILE_CACHE_DIR"
+        )
+        if cache_dir:
+            bucketing.enable_persistent_cache(cache_dir)
         self._catalog: Dict[str, RelationalCypherGraph] = {}
         self._views: Dict[str, Tuple[Tuple[str, ...], str]] = {}
         # (view, arg qgns, referenced params) -> (argument graph objects,
@@ -259,10 +282,45 @@ class CypherSession:
         return CypherSession(LocalTable)
 
     @staticmethod
-    def tpu() -> "CypherSession":
+    def tpu(persistent_cache_dir: Optional[str] = None) -> "CypherSession":
         from ..backend.tpu.table import TpuTable
 
-        return CypherSession(TpuTable)
+        return CypherSession(TpuTable, persistent_cache_dir=persistent_cache_dir)
+
+    # -- prewarm -----------------------------------------------------------
+
+    def warmup(
+        self,
+        queries: Sequence[str],
+        graph: Optional[PropertyGraph] = None,
+        parameters: Optional[Dict[str, Any]] = None,
+    ) -> Dict[str, Any]:
+        """Compile the hot path AHEAD of traffic: run each query once to
+        completion (records fully materialized) so every jit composite on
+        its plan is compiled — onto the shape-bucket lattice when
+        ``TPU_CYPHER_BUCKET`` is on, into the persistent cache when one is
+        configured. Per-request latency then pays dispatch, not XLA.
+
+        Returns {"queries": n, "compiles": total new XLA compilations,
+        "compile_seconds": time spent in them, "per_query": [...]} — a
+        second warmup of the same corpus should report compiles == 0."""
+        from ..backend.tpu import bucketing
+
+        per_query: List[Dict[str, Any]] = []
+        before_all = bucketing.compile_snapshot()
+        for q in queries:
+            before = bucketing.compile_snapshot()
+            result = self.cypher(q, parameters, graph=graph)
+            records = result.records
+            if records is not None:
+                records.collect()  # force every device program, host syncs
+            delta = bucketing.compile_delta(before)
+            delta["query"] = q
+            per_query.append(delta)
+        out = bucketing.compile_delta(before_all)
+        out["queries"] = len(list(queries))
+        out["per_query"] = per_query
+        return out
 
     # -- catalog -----------------------------------------------------------
 
