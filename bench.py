@@ -115,6 +115,11 @@ def _run_probe_once(timeout_s: float, log: list) -> bool:
 
 
 def probe_tpu(timeouts, log: list) -> bool:
+    """Escalating-timeout probe attempts with bounded EXPONENTIAL backoff
+    between them (5s, 10s, 20s, capped at 60s — a wedged tunnel needs the
+    breathing room, a healthy one is unaffected because the first attempt
+    succeeds). The per-attempt backoff lands in the probe log so the
+    schedule is diagnosable from the JSON artifact."""
     for i, t in enumerate(timeouts):
         if _run_probe_once(float(t), log):
             return True
@@ -123,8 +128,26 @@ def probe_tpu(timeouts, log: list) -> bool:
             f"(timeout {t}s): {log[-1]['tail'][-200:]!r}\n"
         )
         if i + 1 < len(timeouts):
-            time.sleep(10)
+            backoff = min(5 * (2 ** i), 60)
+            log[-1]["backoff_s"] = backoff
+            time.sleep(backoff)
     return False
+
+
+def _classify_probe_failure(log: list) -> str:
+    """Typed error class for a failed TPU init, from the probe log tails
+    (the same marker taxonomy ``tpu_cypher.errors`` classifies raw device
+    faults with)."""
+    try:
+        from tpu_cypher import errors as ERR
+    except Exception:
+        return "DeviceLost"
+    tail = " ".join(e.get("tail", "") for e in log)
+    if ERR._OOM_PAT.search(tail):
+        return "DeviceOOM"
+    if ERR._COMPILE_PAT.search(tail):
+        return "CompileFailure"
+    return "DeviceLost"
 
 
 # ---------------------------------------------------------------------------
@@ -463,6 +486,11 @@ def main():
         "device": device,
         "tpu_init_failed": (not tpu_ok) and not force_cpu,
         "headline_config": headline_name,
+        **(
+            {"error_class": _classify_probe_failure(probe_log)}
+            if (not tpu_ok) and not force_cpu
+            else {}
+        ),
         "ladder": results["ladder"],
         "pallas_vs_xla": pallas_entry,
         "probe_log": probe_log,
@@ -485,14 +513,23 @@ def main():
 if __name__ == "__main__":
     try:
         main()
-    except Exception:
+    except Exception as exc:
         # the bench trajectory must NEVER flatline at null: whatever broke,
-        # print a valid JSON line carrying the error and exit 0 (the driver
-        # records stdout; rc=1 with no line records nothing)
+        # print a valid JSON line carrying the error (and its TYPED class,
+        # so the artifact distinguishes an OOM from a lost chip from a
+        # plain bug) and exit 0 (the driver records stdout; rc=1 with no
+        # line records nothing)
         import traceback
 
         tb = traceback.format_exc()
         sys.stderr.write(tb)
+        try:
+            from tpu_cypher import errors as ERR
+
+            typed = ERR.classify(exc)
+            error_class = type(typed).__name__ if typed else type(exc).__name__
+        except Exception:
+            error_class = type(exc).__name__
         print(
             json.dumps(
                 {
@@ -502,6 +539,7 @@ if __name__ == "__main__":
                     "vs_baseline": 0.0,
                     "validated_vs_engine": False,
                     "tpu_init_failed": True,
+                    "error_class": error_class,
                     "error": tb[-800:],
                 }
             )

@@ -15,15 +15,19 @@ Quick start::
     print(g.cypher("MATCH (a:Person)-[:KNOWS]->(b) RETURN a.name, b.name").show())
 """
 
+from . import errors
 from .api.mapping import NodeMappingBuilder, RelationshipMappingBuilder
 from .api.schema import PropertyGraphSchema, SchemaPattern
 from .api.values import CypherMap, Duration, Node, Relationship
+from .errors import TpuCypherError
 from .relational.graphs import ElementTable, ScanGraph
 from .relational.session import CypherResult, CypherSession, PropertyGraph
 
 __version__ = "0.1.0"
 
 __all__ = [
+    "errors",
+    "TpuCypherError",
     "CypherSession",
     "PropertyGraph",
     "CypherResult",

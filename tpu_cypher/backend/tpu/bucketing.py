@@ -60,6 +60,25 @@ def enabled() -> bool:
     return mode() != "off"
 
 
+class force_mode:
+    """``with force_mode("off"):`` — temporarily pin the bucket mode,
+    restoring whatever override (or lack of one) was in place before. The
+    degraded ladder rungs use this to re-execute with exact sizes (no pad
+    memory overhead) without disturbing the caller's configuration."""
+
+    def __init__(self, m: str):
+        self._m = m
+        self._prev = None
+
+    def __enter__(self) -> "force_mode":
+        self._prev = MODE._override
+        MODE.set(self._m)
+        return self
+
+    def __exit__(self, *exc) -> None:
+        MODE._override = self._prev
+
+
 def round_up_pow2(n: int, floor: int = 1) -> int:
     """Smallest power of two >= max(n, floor). THE shared rounding helper —
     also used by ``parallel.shuffle``'s bucket capacities so the shard_map
@@ -110,6 +129,57 @@ def bucket_pad_host(arr: np.ndarray, fill):
         return arr, 0
     tail = np.full((pad,) + arr.shape[1:], fill, dtype=arr.dtype)
     return np.concatenate([arr, tail]), pad
+
+
+# ---------------------------------------------------------------------------
+# pre-flight memory admission
+# ---------------------------------------------------------------------------
+
+# HBM budget for any single materialize's PADDED footprint; 0 = unlimited.
+# Set via env or CypherSession.tpu(memory_budget_bytes=..).
+MEM_BUDGET = ConfigOption("TPU_CYPHER_MEM_BUDGET", 0, int)
+
+
+def memory_budget_bytes() -> int:
+    try:
+        return max(int(MEM_BUDGET.get()), 0)
+    except (TypeError, ValueError):
+        return 0
+
+
+def estimate_materialize_bytes(rows: int, bytes_per_row: int) -> int:
+    """Padded device footprint of materializing ``rows`` output rows:
+    the row count rounds UP the active bucket lattice (padded lanes are
+    allocated like live ones), each row costing ``bytes_per_row`` (data
+    lanes + validity masks across the output columns)."""
+    return round_size(int(rows)) * max(int(bytes_per_row), 1)
+
+
+def admit(rows: int, bytes_per_row: int, site: str) -> None:
+    """Pre-flight admission for one materialize: reject BEFORE launching a
+    device program whose padded output would exceed the configured HBM
+    budget. Raises ``AdmissionRejected`` (downgradable — the session ladder
+    retries at the chunked or host-oracle rung). At the chunked rung the
+    estimate is per-slice: that is the whole point of the rung."""
+    budget = memory_budget_bytes()
+    if not budget:
+        return
+    from ...runtime import guard as G
+
+    chunk = G.chunk_rows()
+    eff_rows = min(int(rows), chunk) if chunk is not None else int(rows)
+    est = estimate_materialize_bytes(eff_rows, bytes_per_row)
+    if est > budget:
+        from ...errors import AdmissionRejected
+
+        raise AdmissionRejected(
+            f"materialize at site {site!r} needs ~{est} bytes padded "
+            f"({rows} rows x {bytes_per_row} B/row on the "
+            f"{mode()!r} lattice), over the {budget}-byte HBM budget",
+            site=site,
+            estimated_bytes=est,
+            budget_bytes=budget,
+        )
 
 
 # ---------------------------------------------------------------------------
@@ -184,7 +254,7 @@ def enable_persistent_cache(cache_dir: str) -> None:
     ):
         try:
             jax.config.update(k, v)
-        except Exception:  # older/newer JAX without the knob
+        except Exception:  # fault-ok: older/newer JAX without the knob
             pass
     _CACHE_DIR = cache_dir
 

@@ -696,9 +696,11 @@ def mask_to_idx_bucketed(mask) -> Tuple[Any, int]:
     lanes hold index 0 (duplicates of a real row) — consumers mark lanes at
     or past ``count`` invalid (``jit_ops.cols_take_counted``), keeping the
     tail-pad invariant. One scalar sync, same as the exact form."""
+    from ...runtime.faults import fault_point
     from .bucketing import round_size
     from .jit_ops import mask_nonzero, mask_sum
 
+    fault_point("compact")
     count = int(mask_sum(mask))
     return mask_nonzero(mask, size=round_size(count)), count
 

@@ -26,6 +26,7 @@ import numpy as np
 import jax.numpy as jnp
 
 from ...api import types as T
+from ...errors import reraise_if_device as _reraise_if_device
 from ...ir import expr as E
 from .column import (
     BOOL,
@@ -222,8 +223,9 @@ class TpuEvaluator:
                         c = self.header.get(e)
                         if c is not None:
                             hset.add((e, c))
-                except Exception:
-                    # an unresolvable variable must DISABLE caching, not
+                except Exception:  # fault-ok: host-side header walk, no
+                    # device work can fault here.
+                    # An unresolvable variable must DISABLE caching, not
                     # silently narrow the key (a narrower key could replay
                     # a program traced under a different header mapping)
                     return None, None, None
@@ -270,7 +272,11 @@ class TpuEvaluator:
                 _EVAL_JIT_CACHE.clear()
             try:
                 data, valid, iflag = fn(cols_in)
-            except Exception:
+            except Exception as exc:  # fault-ok: trace failures fall back
+                # to the eager path — but a genuine device fault (OOM,
+                # device lost) must surface typed, not vanish into a
+                # silently-slower evaluation
+                _reraise_if_device(exc, site="eval")
                 _EVAL_JIT_CACHE[key] = _EVAL_JIT_FAILED
                 return None
             _EVAL_JIT_CACHE[key] = (fn, meta)
@@ -278,7 +284,8 @@ class TpuEvaluator:
         fn, meta = entry
         try:
             data, valid, iflag = fn(cols_in)
-        except Exception:  # pragma: no cover - late trace failure
+        except Exception as exc:  # fault-ok: late trace failure falls back
+            _reraise_if_device(exc, site="eval")
             _EVAL_JIT_CACHE[key] = _EVAL_JIT_FAILED
             return None
         return Column(meta["kind"], data, valid, meta["vocab"], int_flag=iflag)
@@ -1064,7 +1071,8 @@ class TpuEvaluator:
                 # e.g. exists(): fn(None) is a real value, not null
                 try:
                     nv = per_entry(None)
-                except Exception:
+                except Exception:  # fault-ok: host-side fn probe (fn(None)
+                    # may legitimately raise); no device work here
                     nv = None
                 if nv is not None:
                     const = constant_column(nv, self.n)

@@ -30,6 +30,7 @@ import numpy as np
 
 from ...ir import expr as E
 from ...parallel.mesh import current_mesh, mesh_size
+from ...runtime.faults import fault_point
 from ...relational.header import RecordHeader
 from ...relational.ops import RelationalOperator
 from . import bucketing
@@ -561,6 +562,11 @@ class CsrExpandOp(_FusedExpandBase):
         rp, ci, eo = gi.csr(self.types_key, reverse, ctx)
         deg, t_dev = J.expand_degrees_total(rp, pos, present)
         total = int(t_dev)
+        # pre-flight: (row, nbr, orig) int64 lanes + every gathered output
+        # column (8B data + 1B mask), padded on the bucket lattice
+        bucketing.admit(
+            total, 24 + 9 * max(len(self.header.expressions), 1), "expand"
+        )
         if bucketing.enabled():
             size = bucketing.round_size(total)
             row, nbr, orig, live = J.expand_materialize_counted(
@@ -856,6 +862,7 @@ class CsrExpandOp(_FusedExpandBase):
         return got
 
     def _fused_table(self):
+        fault_point("expand")
         gi = GraphIndex.of(self.graph)
         ctx = self.context
         if not self.header.expressions:
@@ -1497,6 +1504,7 @@ class CsrVarExpandOp(_FusedExpandBase):
     def _fused_table(self):
         from .table import TpuTable
 
+        fault_point("var_expand")
         in_op = self.children[0]
         header = self.header
         # the rel var materializes as a host LIST column — fused assembly
@@ -1555,10 +1563,16 @@ class CsrVarExpandOp(_FusedExpandBase):
                     levels.append(J.tree_take((row00, far), idx))
         bucketed = bucketing.enabled()
         for level in range(1, self._resolved_upper(ci) + 1):
+            fault_point("var_expand")
             deg, t_dev = J.expand_degrees_total(rp, pos, present)
             total = int(t_dev)
             if total == 0:
                 break
+            # pre-flight: each hop row carries (row0, nbr, orig) plus one
+            # walked-edge lane per uniqueness mask, padded on the lattice
+            bucketing.admit(
+                total, 8 * (3 + len(prev_edges) + 1), "var_expand"
+            )
             # bucketed: every hop level whose emission count shares a
             # bucket reuses ONE compiled hop program (the frontier loop's
             # per-level sizes are the worst recompile driver otherwise)
@@ -1699,7 +1713,8 @@ def plan_optional_expand_fastpath(planner, op, lhs, rhs_planned, classic) -> Opt
         try:
             bt = lhs.header.var(frontier).cypher_type.material
             bound_labels = frozenset(getattr(bt, "labels", None) or ())
-        except Exception:
+        except Exception:  # fault-ok: plan-time header probe (no device
+            # work); None keeps the classic plan
             return None
         if not scan_labels <= bound_labels:
             return None

@@ -119,6 +119,9 @@ def mask_nonzero(mask, size: int):
 
 def mask_to_idx(mask) -> Tuple[Any, int]:
     """Boolean device mask -> (index array, count); one scalar sync."""
+    from ...runtime.faults import fault_point
+
+    fault_point("compact")
     count = int(mask_sum(mask))
     return mask_nonzero(mask, size=count), count
 

@@ -33,7 +33,7 @@ try:  # pragma: no cover - availability depends on the jax build
     from jax.experimental import pallas as pl
 
     HAVE_PALLAS = True
-except Exception:  # pragma: no cover
+except Exception:  # pragma: no cover - fault-ok: import probe only
     HAVE_PALLAS = False
 
 # one program reduces an (8, 128) int32 tile — the f32/i32 min tile shape
@@ -111,7 +111,13 @@ def csr_frontier_degree_sum(
     if pallas_ok:
         try:
             return _csr_deg_sum_pallas(rp, pos, present, interpret=force_interpret)
-        except Exception:  # lowering failure: remember and fall back
+        except Exception as exc:  # fault-ok: Mosaic lowering failure falls
+            # back to the jnp formulation — but an OOM/device-loss during
+            # the kernel run must surface typed, not masquerade as a
+            # lowering problem
+            from ...errors import reraise_if_device
+
+            reraise_if_device(exc, site="expand")
             if not force_interpret:
                 _PALLAS_BROKEN = True
             else:

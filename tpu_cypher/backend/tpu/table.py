@@ -35,6 +35,7 @@ backend per expression, keeping full Cypher semantics."""
 
 from __future__ import annotations
 
+import contextvars
 from typing import Any, Dict, Iterator, List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -42,6 +43,8 @@ import numpy as np
 import jax.numpy as jnp
 
 from ...api import types as T
+from ...runtime import guard as _guard
+from ...runtime.faults import fault_point
 from ...api.table import Table
 from ...api.types import CypherType
 from . import bucketing
@@ -68,15 +71,26 @@ from .compiler import TpuEvaluator, TpuUnsupportedExpr
 class _FallbackCounter:
     """Counts local-oracle fallbacks so host-bound regressions are visible
     (VERDICT r1 asked for a per-query fallback rate on the acceptance suite).
-    Global because tables are created freely; tests reset() around a query."""
+
+    Two tiers: a process-global AGGREGATE (``snapshot``/``reset`` — the TCK
+    corpus gate in tests/test_fallback_telemetry.py reads this) and
+    CONTEXT-LOCAL scopes (``scope``) for per-result attribution — scopes
+    ride a ``contextvars`` stack, so concurrent/interleaved queries
+    (threads, asyncio, nested view execution) can never cross-pollute each
+    other's ``result.fallbacks``."""
 
     def __init__(self):
         self.total = 0
         self.by_reason: Dict[str, int] = {}
+        self._scopes: contextvars.ContextVar[Tuple[Dict[str, int], ...]] = (
+            contextvars.ContextVar("tpu_cypher_fallback_scopes", default=())
+        )
 
     def record(self, reason: str) -> None:
         self.total += 1
         self.by_reason[reason] = self.by_reason.get(reason, 0) + 1
+        for scope in self._scopes.get():
+            scope[reason] = scope.get(reason, 0) + 1
 
     def reset(self) -> None:
         self.total = 0
@@ -84,6 +98,26 @@ class _FallbackCounter:
 
     def snapshot(self) -> Dict[str, int]:
         return dict(self.by_reason)
+
+    def scope(self) -> "_FallbackScope":
+        """``with FALLBACK_COUNTER.scope() as events:`` — ``events`` fills
+        with only the fallbacks recorded in THIS context while the scope is
+        open (nested scopes each see their own copy)."""
+        return _FallbackScope(self._scopes)
+
+
+class _FallbackScope:
+    def __init__(self, var):
+        self._var = var
+        self.events: Dict[str, int] = {}
+        self._token = None
+
+    def __enter__(self) -> Dict[str, int]:
+        self._token = self._var.set(self._var.get() + (self.events,))
+        return self.events
+
+    def __exit__(self, *exc) -> None:
+        self._var.reset(self._token)
 
 
 FALLBACK_COUNTER = _FallbackCounter()
@@ -96,6 +130,33 @@ def _fold_valids(valids):
         if v is None:
             continue
         out = v if out is None else (out & v)
+    return out
+
+
+def _cols_take_maybe_chunked(dev, idx):
+    """``jit_ops.cols_take`` — unless the CHUNKED ladder rung is active and
+    the gather is large, in which case the index splits into bounded slices
+    gathered independently and concatenated, so no single device program
+    allocates the whole output at once (the degraded-memory materialize;
+    docs/robustness.md)."""
+    chunk = _guard.chunk_rows()
+    n = int(idx.shape[0])
+    if chunk is None or n <= chunk:
+        return J.cols_take(dev, idx)
+    pieces = [
+        J.cols_take(dev, idx[start : min(start + chunk, n)])
+        for start in range(0, n, chunk)
+    ]
+    out = {}
+    for c in dev:
+        datas = [p[c][0] for p in pieces]
+        valids = [p[c][1] for p in pieces]
+        iflags = [p[c][2] for p in pieces]
+        out[c] = (
+            jnp.concatenate(datas),
+            jnp.concatenate(valids) if valids[0] is not None else None,
+            jnp.concatenate(iflags) if iflags[0] is not None else None,
+        )
     return out
 
 
@@ -257,7 +318,7 @@ class TpuTable(Table):
             for c, col in self._cols.items()
             if col.kind != OBJ
         }
-        taken = J.cols_take(dev, idx) if dev else {}
+        taken = _cols_take_maybe_chunked(dev, idx) if dev else {}
         out: Dict[str, Column] = {}
         for c, col in self._cols.items():
             if col.kind == OBJ:
@@ -328,6 +389,7 @@ class TpuTable(Table):
     # -- filter ------------------------------------------------------------
 
     def filter(self, expr, header, parameters) -> "TpuTable":
+        fault_point("filter")
         if bucketing.enabled():
             return self._filter_bucketed(expr, header, parameters)
         t = self._depad()
@@ -384,6 +446,11 @@ class TpuTable(Table):
                 return t.join(o, kind, join_cols)
         if kind == "cross":
             n, m = self._nrows, other._nrows
+            bucketing.admit(
+                n * m,
+                9 * max(len(self._cols) + len(other._cols), 1),
+                "join",
+            )
             li = jnp.repeat(jnp.arange(n), m)
             ri = jnp.tile(jnp.arange(m), n)
             return self._combine(other, li, ri)
@@ -426,6 +493,11 @@ class TpuTable(Table):
         is lexsorted valid-first-by-key once, the probe side binary-searches
         it; matches materialize via fixed-size repeat+gather. Multi-key joins
         probe on the first key and post-filter the rest on device."""
+        fault_point("join")
+        # padded per-output-row cost of the match-pair arrays + the
+        # gathered output columns (8B data + 1B mask per column, 2 int64
+        # index lanes) — the admission estimate for every join materialize
+        join_row_bytes = 16 + 9 * max(len(self._cols) + len(other._cols), 1)
         lk, rk = self._cols[join_cols[0][0]], other._cols[join_cols[0][1]]
         if lk.kind == STR or rk.kind == STR:
             if lk.kind != STR or rk.kind != STR:
@@ -514,6 +586,7 @@ class TpuTable(Table):
             if got is not None:
                 left_rows, right_rows = got
                 total = int(left_rows.shape[0])
+                bucketing.admit(total, join_row_bytes, "join")
             else:
                 packed_all_keys = False
         if left_rows is None:
@@ -535,6 +608,7 @@ class TpuTable(Table):
                     nvalid_cap=cap, is_f64=is_f64, is_bool=is_bool,
                 )
                 total = int(total_dev)
+                bucketing.admit(total, join_row_bytes, "join")
                 size = bucketing.round_size(total)
                 left_rows, right_rows, _ = J.join_materialize_counted(
                     r_idx_valid, lo, counts, total_dev, size=size
@@ -546,6 +620,7 @@ class TpuTable(Table):
                     rd_s, r_order, lk.data, lvalids, nvalid=nvalid, is_f64=is_f64, is_bool=is_bool
                 )
                 total = int(total_dev)
+                bucketing.admit(total, join_row_bytes, "join")
                 # phase 3: materialize match pairs (one dispatch, static total)
                 left_rows, right_rows = J.join_materialize(r_idx_valid, lo, counts, total=total)
         # packed-key matches verify EVERY key column (hash collisions);
@@ -682,7 +757,7 @@ class TpuTable(Table):
                 taken = J.cols_take_counted(dev, idx, count)
             elif dev:
                 taken = (
-                    J.cols_take(dev, idx)
+                    _cols_take_maybe_chunked(dev, idx)
                     if in_bounds is None
                     else J.cols_take_or_null(dev, idx, in_bounds)
                 )

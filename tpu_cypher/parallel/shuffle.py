@@ -300,6 +300,9 @@ def broadcast_join(
     n_l, n_r = int(l_key.shape[0]), int(r_key.shape[0])
     if n_l == 0 or n_r == 0 or n_r > _broadcast_limit():
         return None
+    from ..runtime.faults import fault_point
+
+    fault_point("shuffle")
     for arr in (l_key, l_valid, r_key, r_valid):
         if arr is not None and not getattr(arr, "is_fully_addressable", True):
             return None
@@ -361,6 +364,9 @@ def hash_repartition_join(
     nsh = mesh_size()
     if mesh is None or nsh <= 1:
         return None
+    from ..runtime.faults import fault_point
+
+    fault_point("shuffle")
     axis = mesh.axis_names[0]
     n_l, n_r = int(l_key.shape[0]), int(r_key.shape[0])
     if n_l == 0 or n_r == 0:

@@ -26,7 +26,15 @@ from ..ir.builder import IRBuildError, IRBuilderContext, build_ir
 from ..logical.optimizer import optimize as optimize_logical
 from ..logical.planner import LogicalPlannerContext, plan_logical
 from ..utils.measurement import time_stage
-from .graphs import ElementTable, EmptyGraph, RelationalCypherGraph, ScanGraph, UnionGraph
+from .graphs import (
+    ElementTable,
+    EmptyGraph,
+    OverlayGraph,
+    PrefixedGraph,
+    RelationalCypherGraph,
+    ScanGraph,
+    UnionGraph,
+)
 from .header import RecordHeader
 from .ops import RelationalRuntimeContext
 from .planner import plan_relational
@@ -100,8 +108,49 @@ def _substitute_graph_params(body: str, mapping: Dict[str, str]) -> str:
     return "".join(out)
 
 
+def _graph_to_local(g: RelationalCypherGraph) -> RelationalCypherGraph:
+    """Host-backend copy of a relational graph for the ladder's host-oracle
+    rung: element tables decode to the local backend, wrapper graphs
+    (union/prefix/overlay) rebuild around converted members — ids keep
+    their tags because UnionGraph re-tags leaves in the same order."""
+    from ..backend.local.table import LocalTable
+
+    def table_to_local(t):
+        if isinstance(t, LocalTable):
+            return t
+        to_local = getattr(t, "_to_local", None)
+        if to_local is None:
+            raise TypeError(
+                f"no host conversion for table type {type(t).__name__}"
+            )
+        return to_local("ladder:host-oracle")
+
+    if isinstance(g, ScanGraph):
+        return ScanGraph(
+            [ElementTable(et.mapping, table_to_local(et.table)) for et in g.scans],
+            schema=g.schema,
+        )
+    if isinstance(g, UnionGraph):
+        return UnionGraph([_graph_to_local(m.graph) for m in g.members])
+    if isinstance(g, PrefixedGraph):
+        return PrefixedGraph(_graph_to_local(g.graph), g.prefix)
+    if isinstance(g, OverlayGraph):
+        return OverlayGraph([_graph_to_local(m) for m in g.members])
+    if isinstance(g, EmptyGraph):
+        return g
+    raise TypeError(f"no host conversion for graph type {type(g).__name__}")
+
+
 class CypherResult:
-    """Lazy result (reference ``RelationalCypherResult``)."""
+    """Lazy result (reference ``RelationalCypherResult``).
+
+    Materialization runs under the degrade-and-retry ladder
+    (docs/robustness.md): a classified device fault (``tpu_cypher.errors``)
+    re-executes the SAME relational plan at the next rung — exact bucket
+    sizes, then chunked materializes, then the host oracle — and every
+    attempt lands in ``execution_log``. Either the query succeeds or it
+    raises a typed ``TpuCypherError``; raw ``XlaRuntimeError`` never
+    escapes."""
 
     def __init__(self, session, logical_plan, relational_plan, returns, graph=None):
         self.session = session
@@ -109,6 +158,11 @@ class CypherResult:
         self.relational_plan = relational_plan
         self._returns = returns
         self._graph = graph
+        # (query text, parameters, ambient PropertyGraph, driving table):
+        # what the ladder's host-oracle rung needs to re-execute from
+        # scratch; None for internal results (CREATE GRAPH inner plans)
+        self._source: Optional[Tuple] = None
+        self._records: Optional[RelationalCypherRecords] = None
         # per-query device-coverage telemetry: {reason: count} of local-
         # oracle fallbacks + host islands recorded while THIS result's plan
         # materialized (populated on first .records access when the session
@@ -119,40 +173,156 @@ class CypherResult:
         # materialized (jit/persistent-cache hits count zero — the
         # compiled-once/run-many regression signal next to ``fallbacks``)
         self.compile_stats: Optional[Dict[str, float]] = None
+        # one entry per execution attempt: {"rung", "ok", "seconds", and on
+        # failure "error" (typed class name) + "site"} — the per-result
+        # robustness telemetry next to ``fallbacks``/``compile_stats``
+        self.execution_log: List[Dict[str, Any]] = []
 
     @property
     def records(self) -> Optional[RelationalCypherRecords]:
+        if self._records is not None:
+            return self._records
         if self.relational_plan is None:
             return None
+        self._records = self._execute_ladder()
+        return self._records
+
+    # -- the degrade-and-retry ladder -----------------------------------
+
+    def _execute_ladder(self) -> RelationalCypherRecords:
+        import time as _time
+
+        from .. import errors as ERR
+        from ..runtime import guard as G
+
+        session = self.session
+        device_backend = (
+            getattr(session.table_cls, "plan_expand_fastpath", None) is not None
+        )
+        limit = session.query_deadline_s
+        if limit is None:
+            limit = G.DEADLINE_S.get()
+        deadline_at = (
+            _time.monotonic() + float(limit) if limit and limit > 0 else None
+        )
+
+        rungs = [G.RUNG_DEVICE]
+        if device_backend and G.ladder_enabled():
+            from ..backend.tpu import bucketing
+
+            if bucketing.enabled():
+                rungs.append(G.RUNG_BUCKET_EXACT)
+            rungs.append(G.RUNG_CHUNKED)
+            if self._can_host():
+                rungs.append(G.RUNG_HOST)
+
+        plan = self.relational_plan
+        last_typed: Optional[ERR.ExecutionFault] = None
+        for i, rung in enumerate(rungs):
+            t0 = _time.perf_counter()
+            entry: Dict[str, Any] = {"rung": rung}
+            try:
+                with G.activate(rung, deadline_at=deadline_at):
+                    if rung == G.RUNG_HOST:
+                        recs = self._host_records()
+                    else:
+                        if i > 0:
+                            # fresh lazy-table slots: the failed attempt
+                            # may have memoized poisoned intermediates
+                            plan = session._clone_plan(
+                                self.relational_plan,
+                                dict(self._parameters()),
+                            )
+                        recs = self._materialize_attempt(
+                            plan, exact=rung != G.RUNG_DEVICE
+                        )
+                entry["ok"] = True
+                entry["seconds"] = round(_time.perf_counter() - t0, 6)
+                self.execution_log.append(entry)
+                return recs
+            except Exception as exc:  # classified below; see errors.py
+                typed = ERR.classify(exc)
+                if typed is None:
+                    if last_typed is not None:
+                        # a degraded rung broke for a NON-fault reason
+                        # (e.g. the host rung cannot see catalog graphs):
+                        # surface the original device fault, not the
+                        # rung's own plumbing error
+                        raise last_typed from exc
+                    raise
+                entry["ok"] = False
+                entry["error"] = type(typed).__name__
+                entry["site"] = typed.site
+                entry["seconds"] = round(_time.perf_counter() - t0, 6)
+                self.execution_log.append(entry)
+                last_typed = typed
+                if not typed.retryable or rung == rungs[-1]:
+                    if typed is exc:
+                        raise
+                    raise typed from exc
+        raise last_typed  # pragma: no cover - loop always returns/raises
+
+    def _parameters(self) -> Dict[str, Any]:
+        if self._source is not None:
+            return dict(self._source[1] or {})
+        ctx = getattr(self.relational_plan, "context", None)
+        return dict(getattr(ctx, "parameters", {}) or {})
+
+    def _can_host(self) -> bool:
+        return (
+            self._source is not None
+            and self._source[0] is not None
+            and self.session._host_session() is not None
+        )
+
+    def _materialize_attempt(self, plan, exact: bool) -> RelationalCypherRecords:
+        """One execution attempt of ``plan``; ``exact`` re-runs with the
+        bucket lattice disabled (no pad memory overhead — the
+        ``bucket-exact`` and ``chunked`` rungs)."""
         from ..backend.tpu import bucketing
         from ..utils.profiling import PROFILE_DIR, profile_trace
 
         track = getattr(self.session, "record_fallbacks", False)
-        before = None
-        if track:
-            from ..backend.tpu.table import FALLBACK_COUNTER
-
-            before = FALLBACK_COUNTER.snapshot()
         compiles_before = bucketing.compile_snapshot()
-        with profile_trace():  # no-op unless TPU_CYPHER_PROFILE_DIR is set
-            table = self.relational_plan.table  # pulls the whole physical plan
+        import contextlib
+
+        scope = None
+        with contextlib.ExitStack() as stack:
+            if exact:
+                stack.enter_context(bucketing.force_mode("off"))
+            if track:
+                from ..backend.tpu.table import FALLBACK_COUNTER
+
+                scope = stack.enter_context(FALLBACK_COUNTER.scope())
+            stack.enter_context(profile_trace())  # no-op unless profiling
+            table = plan.table  # pulls the whole physical plan
             if PROFILE_DIR.get():
                 # async dispatch would escape the trace: block on device work
                 table = table.cache()
         if self.compile_stats is None:
             self.compile_stats = bucketing.compile_delta(compiles_before)
         if track and self.fallbacks is None:
-            from ..backend.tpu.table import FALLBACK_COUNTER
+            self.fallbacks = dict(scope)
+        return RelationalCypherRecords(plan.header, table, self._returns)
 
-            after = FALLBACK_COUNTER.snapshot()
-            self.fallbacks = {
-                k: v - before.get(k, 0)
-                for k, v in after.items()
-                if v - before.get(k, 0)
-            }
-        return RelationalCypherRecords(
-            self.relational_plan.header, table, self._returns
-        )
+    def _host_records(self) -> RelationalCypherRecords:
+        """The last rung: re-execute the original query on the host-oracle
+        backend against a converted copy of the ambient graph (the CAPS
+        trick — a bit-identical host execution always exists)."""
+        query, parameters, graph, driving_table = self._source
+        host = self.session._host_session()
+        hg = self.session._host_graph_for(graph)
+        res = host.cypher(query, parameters, graph=hg, driving_table=driving_table)
+        recs = res.records
+        if recs is None:
+            raise CatalogError("host-oracle rung produced no records")
+        if self.compile_stats is None:
+            self.compile_stats = {"compiles": 0, "compile_seconds": 0.0}
+        if self.fallbacks is None and getattr(
+            self.session, "record_fallbacks", False
+        ):
+            self.fallbacks = {"ladder:host-oracle": 1}
+        return recs
 
     @property
     def graph(self):
@@ -216,10 +386,24 @@ class PropertyGraph:
 class CypherSession:
     """Reference ``CypherSession``/``RelationalCypherSession``."""
 
-    def __init__(self, table_cls, persistent_cache_dir: Optional[str] = None):
+    def __init__(
+        self,
+        table_cls,
+        persistent_cache_dir: Optional[str] = None,
+        memory_budget_bytes: Optional[int] = None,
+        query_deadline_seconds: Optional[float] = None,
+    ):
         from ..backend.tpu import bucketing
 
         self.table_cls = table_cls
+        # per-query wall-clock deadline (seconds; None = env
+        # TPU_CYPHER_QUERY_DEADLINE_S, 0 = off) — expiry raises the typed,
+        # terminal QueryTimeout (docs/robustness.md)
+        self.query_deadline_s = query_deadline_seconds
+        if memory_budget_bytes is not None:
+            # pre-flight materialize admission against the HBM budget;
+            # process-global (the device is process-global too)
+            bucketing.MEM_BUDGET.set(int(memory_budget_bytes))
         # when True, each CypherResult records the {reason: count} of
         # local-oracle fallbacks / host islands observed while it
         # materialized (``result.fallbacks``) — the per-query device-
@@ -282,10 +466,54 @@ class CypherSession:
         return CypherSession(LocalTable)
 
     @staticmethod
-    def tpu(persistent_cache_dir: Optional[str] = None) -> "CypherSession":
+    def tpu(
+        persistent_cache_dir: Optional[str] = None,
+        memory_budget_bytes: Optional[int] = None,
+        query_deadline_seconds: Optional[float] = None,
+    ) -> "CypherSession":
         from ..backend.tpu.table import TpuTable
 
-        return CypherSession(TpuTable, persistent_cache_dir=persistent_cache_dir)
+        return CypherSession(
+            TpuTable,
+            persistent_cache_dir=persistent_cache_dir,
+            memory_budget_bytes=memory_budget_bytes,
+            query_deadline_seconds=query_deadline_seconds,
+        )
+
+    # -- host-oracle shadow (the ladder's last rung) ----------------------
+
+    def _host_session(self) -> Optional["CypherSession"]:
+        """A lazily-built local-backend shadow session, or None when this
+        session already IS the host oracle."""
+        from ..backend.local.table import LocalTable
+
+        if self.table_cls is LocalTable:
+            return None
+        host = getattr(self, "_host_shadow", None)
+        if host is None:
+            host = CypherSession(LocalTable)
+            self._host_shadow = host
+        return host
+
+    def _host_graph_for(
+        self, graph: Optional[PropertyGraph]
+    ) -> Optional[PropertyGraph]:
+        """Host-backend copy of an ambient graph, cached per graph object
+        (identity-checked, so replacing a graph misses)."""
+        if graph is None:
+            return None
+        host = self._host_session()
+        g = graph._graph
+        cache = getattr(self, "_host_graph_cache", None)
+        if cache is None:
+            cache = {}
+            self._host_graph_cache = cache
+        hit = cache.get(id(g))
+        if hit is not None and hit[0] is g:
+            return PropertyGraph(host, hit[1])
+        conv = _graph_to_local(g)
+        cache[id(g)] = (g, conv)
+        return PropertyGraph(host, conv)
 
     # -- prewarm -----------------------------------------------------------
 
@@ -604,6 +832,53 @@ class CypherSession:
         graph: Optional[PropertyGraph] = None,
         driving_table=None,
     ) -> CypherResult:
+        """Plan (and for catalog statements, execute) a query. Device
+        faults during PLANNING (scan staging runs device ops) degrade
+        straight to the host-oracle rung; materialize-time faults ride the
+        full ladder in ``CypherResult.records``."""
+        try:
+            return self._cypher_pipeline(query, parameters, graph, driving_table)
+        except Exception as exc:
+            from .. import errors as ERR
+            from ..runtime import guard as G
+
+            typed = ERR.classify(exc)
+            if (
+                typed is None
+                or not typed.retryable
+                or not G.ladder_enabled()
+                or self._host_session() is None
+            ):
+                raise
+            host = self._host_session()
+            try:
+                hg = self._host_graph_for(graph)
+                result = host.cypher(
+                    query, parameters, graph=hg, driving_table=driving_table
+                )
+            except Exception:
+                if typed is exc:
+                    raise
+                raise typed from exc
+            result.execution_log.append(
+                {
+                    "rung": G.RUNG_DEVICE,
+                    "ok": False,
+                    "phase": "plan",
+                    "error": type(typed).__name__,
+                    "site": typed.site,
+                }
+            )
+            result.execution_log.append({"rung": G.RUNG_HOST, "ok": True})
+            return result
+
+    def _cypher_pipeline(
+        self,
+        query: str,
+        parameters: Optional[Dict[str, Any]] = None,
+        graph: Optional[PropertyGraph] = None,
+        driving_table=None,
+    ) -> CypherResult:
         parameters = dict(parameters or {})
         cache_key = self._plan_cache_key(query, graph, parameters, driving_table)
         if cache_key is not None:
@@ -611,10 +886,12 @@ class CypherSession:
             if hit is not None and hit[0] is graph._graph:
                 self._plan_cache.move_to_end(cache_key)
                 _, logical, relational, returns = hit
-                return CypherResult(
+                result = CypherResult(
                     self, logical,
                     self._clone_plan(relational, parameters), returns,
                 )
+                result._source = (query, parameters, graph, driving_table)
+                return result
         ambient = graph._graph if graph is not None else EmptyGraph()
         ambient_qgn = f"{AMBIENT_NS}.q{next(self._counter)}"
         self._catalog[ambient_qgn] = ambient  # mountAmbientGraph (reference :117)
@@ -678,6 +955,7 @@ class CypherSession:
             ir, parameters, input_fields, driving_table, driving_header,
             ambient_qgn, schemas,
         )
+        result._source = (query, parameters, graph, driving_table)
         if cache_key is not None and result.relational_plan is not None:
             while len(self._plan_cache) >= self._PLAN_CACHE_MAX:
                 self._plan_cache.popitem(last=False)  # LRU victim

@@ -1,0 +1,6 @@
+"""Query-execution runtime services: the execution guard (deadline, ladder
+rung, chunked materialize) and deterministic fault injection. See
+docs/robustness.md."""
+
+from . import faults, guard  # noqa: F401
+from .faults import fault_point  # noqa: F401

@@ -1,0 +1,180 @@
+"""Deterministic fault injection for the execution ladder.
+
+``TPU_CYPHER_FAULTS`` names WHERE and WHEN synthetic device faults fire, so
+the whole degrade-and-retry ladder is exercised under ``JAX_PLATFORMS=cpu``
+in tier-1 — no real OOM or chip loss required. Grammar (comma-separated
+specs):
+
+    kind@site[:occurrence]
+
+* ``kind``  — ``oom`` | ``compile`` | ``lost`` | ``timeout``
+* ``site``  — a named fault site (``join``, ``expand``, ``var_expand``,
+  ``filter``, ``compact``, ``shuffle``, ...; grep ``fault_point(`` for the
+  full set)
+* ``occurrence`` — WHICH invocations of the site fire, 1-based:
+  ``:3`` (exactly the 3rd), ``:2-5`` (2nd through 5th), ``:*`` (every
+  invocation — drives the ladder all the way to the host oracle). Default
+  ``:1``.
+
+Examples::
+
+    TPU_CYPHER_FAULTS=oom@join:1                # first join OOMs once
+    TPU_CYPHER_FAULTS=oom@join:*,compile@expand:1
+    TPU_CYPHER_FAULTS=lost@compact:2-4
+
+Each spec keeps its own per-site invocation counter; counters are
+process-global and monotonically increasing across ladder retries — which
+is exactly what makes the ladder testable: ``:1`` fails the device rung
+once and the first retry rung succeeds, while ``:*`` fails every device
+rung and lands on the host oracle.
+
+Injected exceptions are RAW (``InjectedFault``, message carrying the same
+status markers jaxlib uses) so they flow through ``tpu_cypher.errors
+.classify`` exactly like real faults. ``timeout`` injects a typed
+``QueryTimeout`` directly (deadline expiry is not a raw device error).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Dict, List, Optional, Tuple
+
+from ..errors import QueryTimeout
+
+ENV = "TPU_CYPHER_FAULTS"
+
+
+class InjectedFault(RuntimeError):
+    """Synthetic RAW device fault (classified by message, like jaxlib's
+    ``XlaRuntimeError``). Carries the site + occurrence for diagnostics."""
+
+    def __init__(self, message: str, site: str, n: int):
+        super().__init__(message)
+        self.site = site
+        self.n = n
+
+
+_KIND_MESSAGES = {
+    "oom": "RESOURCE_EXHAUSTED: injected out of memory allocating "
+    "1099511627776 bytes on device",
+    "compile": "INTERNAL: injected XLA compilation failure while compiling "
+    "fused computation",
+    "lost": "UNAVAILABLE: injected device lost (TPU driver tunnel closed)",
+}
+
+_INF = 1 << 62
+
+_lock = threading.Lock()
+_counters: Dict[str, int] = {}
+# parsed spec cache, keyed by the raw env/override string
+_parse_cache: Tuple[Optional[str], Dict[str, List[Tuple[str, int, int]]]] = (
+    None,
+    {},
+)
+# in-process override (tests/fuzz set this instead of mutating os.environ)
+_override: Optional[str] = None
+
+
+class FaultSpecError(ValueError):
+    pass
+
+
+def parse_spec(text: str) -> Dict[str, List[Tuple[str, int, int]]]:
+    """``"oom@join:2,lost@expand:*"`` -> {site: [(kind, lo, hi), ...]}
+    with 1-based inclusive occurrence bounds (``*`` -> (1, inf))."""
+    out: Dict[str, List[Tuple[str, int, int]]] = {}
+    for part in text.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        if "@" not in part:
+            raise FaultSpecError(f"fault spec {part!r}: expected kind@site[:n]")
+        kind, _, rest = part.partition("@")
+        kind = kind.strip().lower()
+        if kind not in ("oom", "compile", "lost", "timeout"):
+            raise FaultSpecError(f"fault spec {part!r}: unknown kind {kind!r}")
+        site, _, occ = rest.partition(":")
+        site = site.strip()
+        if not site:
+            raise FaultSpecError(f"fault spec {part!r}: empty site")
+        occ = occ.strip() or "1"
+        if occ == "*":
+            lo, hi = 1, _INF
+        elif "-" in occ:
+            a, _, b = occ.partition("-")
+            lo, hi = int(a), int(b)
+        else:
+            lo = hi = int(occ)
+        if lo < 1 or hi < lo:
+            raise FaultSpecError(f"fault spec {part!r}: bad occurrence {occ!r}")
+        out.setdefault(site, []).append((kind, lo, hi))
+    return out
+
+
+def set_spec(text: Optional[str]) -> None:
+    """In-process override of ``TPU_CYPHER_FAULTS`` (None = back to the
+    env). Resets the invocation counters: a fresh spec means a fresh
+    deterministic schedule."""
+    global _override
+    with _lock:
+        _override = text
+        _counters.clear()
+
+
+def reset_counters() -> None:
+    with _lock:
+        _counters.clear()
+
+
+def counters() -> Dict[str, int]:
+    """Snapshot of per-site invocation counts (diagnostics/tests)."""
+    with _lock:
+        return dict(_counters)
+
+
+def _active_spec() -> Dict[str, List[Tuple[str, int, int]]]:
+    global _parse_cache
+    raw = _override if _override is not None else os.environ.get(ENV)
+    if not raw:
+        return {}
+    cached_raw, cached = _parse_cache
+    if cached_raw == raw:
+        return cached
+    parsed = parse_spec(raw)
+    _parse_cache = (raw, parsed)
+    return parsed
+
+
+def fault_point(site: str) -> None:
+    """Named fault site. No-op (one env read) unless a spec targets this
+    site; otherwise counts the invocation and raises when a spec's
+    occurrence window covers it. Also checks the active query deadline
+    (``runtime.guard``) — sites are exactly the points where a long device
+    query can be interrupted between dispatches."""
+    from . import guard as G
+
+    G.check_deadline(site)
+    spec = _active_spec()
+    if not spec:
+        return
+    rules = spec.get(site)
+    if not rules:
+        return
+    with _lock:
+        n = _counters.get(site, 0) + 1
+        _counters[site] = n
+    for kind, lo, hi in rules:
+        if lo <= n <= hi:
+            if kind == "timeout":
+                raise QueryTimeout(
+                    f"injected deadline expiry at site {site!r} "
+                    f"(invocation {n})",
+                    site=site,
+                )
+            raise InjectedFault(
+                f"{_KIND_MESSAGES[kind]} [injected: {kind}@{site} "
+                f"invocation {n}]",
+                site,
+                n,
+            )

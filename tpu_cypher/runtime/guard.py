@@ -1,0 +1,124 @@
+"""Per-query execution guard: deadline, ladder rung, chunked materialize.
+
+The guard is the context a query executes under. It is context-local
+(``contextvars``) so concurrent/interleaved queries — threads, asyncio,
+nested view execution — each see their own deadline and rung, mirroring the
+context-local fallback counter in ``backend/tpu/table.py``.
+
+* **Deadline**: ``CypherSession.tpu(query_deadline_seconds=..)`` /
+  ``TPU_CYPHER_QUERY_DEADLINE_S``. Checked at every named fault site
+  (``runtime.faults.fault_point``) — the natural interruption points
+  between device dispatches — and between ladder rungs. Expiry raises the
+  TERMINAL ``QueryTimeout``.
+
+* **Rung**: which ladder rung is executing (``relational/session.py``).
+  ``RUNG_DEVICE`` is the clean path; degraded rungs tighten the bucket
+  policy, chunk materializes, or re-execute on the host oracle.
+
+* **Chunking**: at ``RUNG_CHUNKED`` big device gathers split into bounded
+  slices (``TPU_CYPHER_CHUNK_ROWS``) so no single materialize allocates the
+  whole output at once; memory admission estimates per-chunk accordingly.
+"""
+
+from __future__ import annotations
+
+import contextvars
+import time
+from typing import Optional
+
+from ..errors import QueryTimeout
+from ..utils.config import ConfigOption
+
+# ladder rungs, in degradation order (docs/robustness.md)
+RUNG_DEVICE = "device"
+RUNG_BUCKET_EXACT = "bucket-exact"  # bucketing off: no pad memory overhead
+RUNG_CHUNKED = "chunked"  # bounded-slice materializes
+RUNG_HOST = "host-oracle"  # full local-backend re-execution
+
+LADDER = (RUNG_DEVICE, RUNG_BUCKET_EXACT, RUNG_CHUNKED, RUNG_HOST)
+
+# "on" (default): classified faults degrade-and-retry down the ladder;
+# "off": the typed error raises to the caller after the first rung
+LADDER_MODE = ConfigOption("TPU_CYPHER_LADDER", "on", str)
+
+# rows per gather slice at the chunked rung
+CHUNK_ROWS = ConfigOption("TPU_CYPHER_CHUNK_ROWS", 65536, int)
+
+# 0 = no deadline; session option overrides the env
+DEADLINE_S = ConfigOption("TPU_CYPHER_QUERY_DEADLINE_S", 0.0, float)
+
+
+class ExecutionGuard:
+    """State for ONE query execution attempt (one ladder rung)."""
+
+    __slots__ = ("deadline_at", "rung", "site_log")
+
+    def __init__(self, deadline_at: Optional[float], rung: str):
+        self.deadline_at = deadline_at
+        self.rung = rung
+        self.site_log = None  # reserved for per-site tracing
+
+    def check(self, site: str) -> None:
+        if self.deadline_at is not None and time.monotonic() > self.deadline_at:
+            raise QueryTimeout(
+                f"query deadline exceeded at site {site!r}", site=site
+            )
+
+
+_CURRENT: contextvars.ContextVar[Optional[ExecutionGuard]] = (
+    contextvars.ContextVar("tpu_cypher_guard", default=None)
+)
+
+
+def ladder_enabled() -> bool:
+    return LADDER_MODE.get().strip().lower() != "off"
+
+
+def current() -> Optional[ExecutionGuard]:
+    return _CURRENT.get()
+
+
+def current_rung() -> str:
+    g = _CURRENT.get()
+    return g.rung if g is not None else RUNG_DEVICE
+
+
+def chunk_rows() -> Optional[int]:
+    """Gather slice size when the chunked rung is active, else None."""
+    g = _CURRENT.get()
+    if g is None or g.rung != RUNG_CHUNKED:
+        return None
+    return max(int(CHUNK_ROWS.get()), 1024)
+
+
+def check_deadline(site: str) -> None:
+    g = _CURRENT.get()
+    if g is not None:
+        g.check(site)
+
+
+class activate:
+    """``with guard.activate(rung, deadline_seconds):`` — install a guard
+    for one execution attempt. ``deadline_at`` is an ABSOLUTE monotonic
+    stamp (the ladder passes the query-level deadline through every rung,
+    so retries never extend it); resolving the session/env deadline config
+    is the caller's job — ``relational/session.py`` is the single
+    resolution point."""
+
+    def __init__(
+        self,
+        rung: str = RUNG_DEVICE,
+        deadline_seconds: Optional[float] = None,
+        deadline_at: Optional[float] = None,
+    ):
+        if deadline_at is None and deadline_seconds and deadline_seconds > 0:
+            deadline_at = time.monotonic() + float(deadline_seconds)
+        self._guard = ExecutionGuard(deadline_at, rung)
+        self._token = None
+
+    def __enter__(self) -> ExecutionGuard:
+        self._token = _CURRENT.set(self._guard)
+        return self._guard
+
+    def __exit__(self, *exc) -> None:
+        _CURRENT.reset(self._token)
