@@ -214,10 +214,14 @@ def _host_graph_stats(graph):
 
 def _tier_snapshot():
     from tpu_cypher.backend.tpu import expand_op as X
+    from tpu_cypher.backend.tpu.pallas import dispatch as PD
 
     return {
         **{f"mxu_{k}": v for k, v in X.MXU_TIER_COUNTS.items()},
         **{f"native_{k}": v for k, v in X.NATIVE_TIER_COUNTS.items()},
+        # which Pallas kernels actually launched (vs fell back) — the
+        # per-rung tier strings record e.g. "pallas_join_probe"
+        **{f"pallas_{k}": v["pallas"] for k, v in PD.use_counts().items()},
     }
 
 
@@ -354,6 +358,7 @@ def pallas_vs_xla_probe() -> dict:
     import jax.numpy as jnp
 
     from tpu_cypher.backend.tpu import pallas_kernels as PK
+    from tpu_cypher.backend.tpu.pallas import dispatch as PD
 
     on_tpu = jax.default_backend() == "tpu"
     n, e = 200_000, 4_000_000
@@ -392,11 +397,12 @@ def pallas_vs_xla_probe() -> dict:
         pal_s, pal_v = timed(
             lambda: PK.csr_frontier_degree_sum(rp_dev, pos, present, max_deg)
         )
-        if getattr(PK, "_PALLAS_BROKEN", False):
+        if PD.is_broken("frontier_deg_sum"):
             # the Mosaic lowering failed and the jnp fallback answered —
             # recording its time as "pallas" would be a lie
             entry["pallas_seconds"] = None
             entry["note"] = "Pallas lowering failed on this TPU (fallback ran)"
+            entry["broken"] = PD.broken()
         else:
             entry["pallas_seconds"] = round(pal_s, 6)
             entry["pallas_matches"] = pal_v == xla_v
@@ -510,15 +516,41 @@ def main():
             sys.stderr.write(f"bench: BENCH_last_tpu.json write failed: {exc}\n")
 
 
-if __name__ == "__main__":
+def _error_line(error_class: str, detail: str) -> dict:
+    return {
+        "metric": "edge_expansions_per_sec_2hop_engine",
+        "value": 0.0,
+        "unit": "expansions/s",
+        "vs_baseline": 0.0,
+        "validated_vs_engine": False,
+        "tpu_init_failed": True,
+        "error_class": error_class,
+        "error": detail[-800:],
+    }
+
+
+def _classify_crash_tail(tail: str) -> str:
+    """Typed error class from a crashed child's stderr (same marker
+    taxonomy as ``tpu_cypher.errors``, but WITHOUT importing tpu_cypher —
+    the parent must classify even when the import itself is what died)."""
+    import re
+
+    if re.search(r"RESOURCE_EXHAUSTED|out of memory|OOM|Failed to allocate",
+                 tail, re.IGNORECASE):
+        return "DeviceOOM"
+    if re.search(r"compil|Mosaic|XlaCompile|HloModule", tail, re.IGNORECASE):
+        return "CompileFailure"
+    return "DeviceLost"
+
+
+def _child_main():
+    """The real bench, in a CHILD process. Its own Exception handler emits
+    the structured error line for any Python failure; the parent covers
+    what no in-process handler can — a native libtpu abort/segfault, a
+    SystemExit from plugin init, stdout polluted by init-time logging."""
     try:
         main()
-    except Exception as exc:
-        # the bench trajectory must NEVER flatline at null: whatever broke,
-        # print a valid JSON line carrying the error (and its TYPED class,
-        # so the artifact distinguishes an OOM from a lost chip from a
-        # plain bug) and exit 0 (the driver records stdout; rc=1 with no
-        # line records nothing)
+    except BaseException as exc:  # incl. SystemExit from libtpu init paths
         import traceback
 
         tb = traceback.format_exc()
@@ -530,17 +562,68 @@ if __name__ == "__main__":
             error_class = type(typed).__name__ if typed else type(exc).__name__
         except Exception:
             error_class = type(exc).__name__
-        print(
-            json.dumps(
-                {
-                    "metric": "edge_expansions_per_sec_2hop_engine",
-                    "value": 0.0,
-                    "unit": "expansions/s",
-                    "vs_baseline": 0.0,
-                    "validated_vs_engine": False,
-                    "tpu_init_failed": True,
-                    "error_class": error_class,
-                    "error": tb[-800:],
-                }
-            )
+        print(json.dumps(_error_line(error_class, tb)))
+    sys.stdout.flush()
+    sys.stderr.flush()
+    os._exit(0)
+
+
+def _parent_main():
+    """Run the bench in a child and GUARANTEE the contract the driver
+    parses: exactly one structured JSON line on stdout, rc 0 — even when
+    libtpu init kills the child with a native abort before any Python
+    handler runs, or spews init-time logging onto stdout (BENCH_r05:
+    rc=1, ``parsed: null``). Child stderr (where init-time diagnostics
+    land) is captured, replayed to our stderr, and its tail rides the
+    synthesized error line so the failure is diagnosable from the JSON
+    artifact alone."""
+    env = dict(os.environ, _TPU_CYPHER_BENCH_CHILD="1")
+    with tempfile.TemporaryFile(mode="w+") as out, tempfile.TemporaryFile(
+        mode="w+"
+    ) as err:
+        rc = subprocess.call(
+            [sys.executable, os.path.abspath(__file__)],
+            stdout=out, stderr=err, env=env,
         )
+        out.seek(0)
+        stdout_text = out.read()
+        err.seek(0)
+        stderr_text = err.read()
+    sys.stderr.write(stderr_text)
+    print(_final_line(rc, stdout_text, stderr_text))
+
+
+def _final_line(rc: int, stdout_text: str, stderr_text: str) -> str:
+    """The one line the driver parses: the child's last parseable JSON
+    object line (init-time noise above it is harmless; noise AFTER it is
+    exactly what this wrapper defuses), or a synthesized error line when
+    the child died before printing one."""
+    for line in reversed(stdout_text.splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                json.loads(line)
+            except ValueError:
+                continue
+            return line
+    tail = (stderr_text + "\n" + stdout_text)[-1200:]
+    return json.dumps(
+        dict(
+            _error_line(
+                _classify_crash_tail(tail),
+                f"bench child exited rc={rc} with no JSON line; tail: {tail}",
+            ),
+            child_rc=rc,
+        )
+    )
+
+
+if __name__ == "__main__":
+    if os.environ.get("_TPU_CYPHER_BENCH_CHILD") == "1":
+        _child_main()
+    else:
+        try:
+            _parent_main()
+        finally:
+            sys.stdout.flush()
+        sys.exit(0)

@@ -87,6 +87,15 @@ def round_up_pow2(n: int, floor: int = 1) -> int:
     return 1 << (n - 1).bit_length() if n > 1 else 1
 
 
+def round_up_multiple(n: int, m: int) -> int:
+    """Smallest multiple of ``m`` >= ``n`` — the kernel-tile pad helper
+    (Pallas grids need the streamed axis padded to a whole number of
+    (8, 128) blocks; pad lanes must be mask-dead INSIDE the kernel, see
+    docs/pad-invariants.md)."""
+    n, m = int(n), int(m)
+    return ((n + m - 1) // m) * m
+
+
 # 1.25-lattice, grown lazily; starts at the floor
 _LATTICE_125 = [_BUCKET_FLOOR]
 _LATTICE_LOCK = threading.Lock()

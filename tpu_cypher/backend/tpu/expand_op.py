@@ -569,7 +569,12 @@ class CsrExpandOp(_FusedExpandBase):
         )
         if bucketing.enabled():
             size = bucketing.round_size(total)
-            row, nbr, orig, live = J.expand_materialize_counted(
+            # kernel tier: the Pallas row-search materialize when eligible
+            # (dispatch falls back to the jnp repeat cascade; see
+            # backend/tpu/pallas/expand.py)
+            from .pallas import expand_materialize_counted
+
+            row, nbr, orig, live = expand_materialize_counted(
                 rp, ci, eo, pos, deg, t_dev, size=size
             )
             if drop_loops and total:
@@ -656,12 +661,8 @@ class CsrExpandOp(_FusedExpandBase):
 
             pos, present = gi.compact_of(id_col, ctx)
             rp, _, _ = gi.csr(self.types_key, self.backwards, ctx)
-            return int(
-                csr_frontier_degree_sum(
-                    rp, pos, present,
-                    max_deg=gi.csr_max_degree(self.types_key, self.backwards, ctx),
-                )
-            )
+            max_deg, _ = gi.csr_degree_stats(self.types_key, self.backwards, ctx)
+            return int(csr_frontier_degree_sum(rp, pos, present, max_deg=max_deg))
         hop_data = []
         for hop in reversed(hops):  # deepest (first executed) hop first
             mask = gi.label_mask(hop.far_labels, ctx)

@@ -491,6 +491,15 @@ class GraphIndex:
             self.csr(types_key, reverse, ctx)
         return self._csr_max_deg[(types_key, reverse)]
 
+    def csr_degree_stats(
+        self, types_key: Tuple[str, ...], reverse: bool, ctx
+    ) -> Tuple[int, int]:
+        """(max_degree, num_nodes) for one CSR orientation, host-cached —
+        the Pallas frontier kernel's eligibility inputs (int32 block-sum
+        bound and the VMEM-resident degree-vector budget) at zero device
+        syncs (``pallas/frontier.py``)."""
+        return self.csr_max_degree(types_key, reverse, ctx), self.num_nodes
+
     # -- id -> compact mapping --------------------------------------------
 
     def compact_of(self, id_col: Column, ctx) -> Tuple[Any, Any]:

@@ -278,14 +278,11 @@ def expand_materialize(rp, ci, eo, pos, deg, total: int):
     return row, nbr, orig
 
 
-@partial(jax.jit, static_argnames=("size",))
-def expand_materialize_counted(rp, ci, eo, pos, deg, nvalid, size: int):
-    """``expand_materialize`` at a BUCKETED static ``size`` >= the true
-    total (``nvalid``, traced): pad lanes are sanitized to row/edge 0 (the
-    raw repeat pads run off the edge array — an out-of-bounds gather under
-    jit FILLS with int64 min, which must never escape as an index) and
-    reported dead via the returned ``live`` mask."""
-    row, edge = _expand_rows(jnp.take(rp, pos), deg, size)
+def finish_expand_counted(ci, eo, row, edge, nvalid, size: int):
+    """Traced tail shared by every counted expand-materialize formulation
+    (jnp repeat cascade AND the Pallas row-search kernel): sanitize pad
+    lanes to row/edge 0, gather neighbor/edge-orig, mask the gathers dead.
+    ONE definition so the two formulations cannot drift."""
     live = _live_lanes(size, nvalid)
     row = jnp.where(live, row, 0)
     edge = jnp.where(live, edge, 0)
@@ -294,6 +291,17 @@ def expand_materialize_counted(rp, ci, eo, pos, deg, nvalid, size: int):
     nbr = jnp.where(live, nbr, 0)
     orig = jnp.where(live, orig, 0)
     return row, nbr, orig, live
+
+
+@partial(jax.jit, static_argnames=("size",))
+def expand_materialize_counted(rp, ci, eo, pos, deg, nvalid, size: int):
+    """``expand_materialize`` at a BUCKETED static ``size`` >= the true
+    total (``nvalid``, traced): pad lanes are sanitized to row/edge 0 (the
+    raw repeat pads run off the edge array — an out-of-bounds gather under
+    jit FILLS with int64 min, which must never escape as an index) and
+    reported dead via the returned ``live`` mask."""
+    row, edge = _expand_rows(jnp.take(rp, pos), deg, size)
+    return finish_expand_counted(ci, eo, row, edge, nvalid, size)
 
 
 @jax.jit

@@ -1,0 +1,192 @@
+"""Kernel dispatch: ONE gate between the engine and every Pallas program.
+
+The first hand-scheduled kernel (the frontier degree-sum) carried its own
+ad-hoc policy: a module-global ``_PALLAS_BROKEN`` flag, an inline backend
+check, an inline eligibility test. With a kernel SUITE that policy must be
+shared and per-kernel, or one bad Mosaic lowering poisons every kernel and
+no two kernels agree on when they may run. This module is that policy:
+
+* **mode** — ``TPU_CYPHER_PALLAS=auto|interpret|off``:
+  ``auto`` (default) compiles kernels on a TPU backend and falls back to
+  the jnp formulation elsewhere; ``interpret`` runs the IDENTICAL Pallas
+  programs through the interpreter on any backend (tier-1/CPU parity —
+  the differential tests pin them bit-identical to the jnp oracle);
+  ``off`` restores the pre-kernel execution path exactly.
+* **registry** — every kernel registers (name, fault site, the names of
+  the functions that contain its raw ``pl.pallas_call``). The AST guard
+  test walks ``backend/tpu`` and fails on any ``pallas_call`` outside a
+  registered impl — no kernel can bypass eligibility/fallback.
+* **broken-once memoization** — a Mosaic lowering failure on a real TPU is
+  remembered PER (kernel, variant) so it is paid once, not per query.
+  ``interpret``-mode failures are never memoized (a forced-interpret
+  lowering failure in one test must not poison the next) and re-raise.
+* **fault sites** — each launch passes through ``fault_point(site)``, so
+  ``TPU_CYPHER_FAULTS=oom@kernel_join:1`` etc. drive the PR-2 ladder
+  through the kernel tier with no TPU attached.
+* **use counters** — per-kernel pallas/fallback counts; bench.py records
+  which tier each rung actually used.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, Optional, Tuple
+
+from ....utils.config import ConfigOption
+
+try:  # pragma: no cover - availability depends on the jax build
+    from jax.experimental import pallas as pl  # noqa: F401
+
+    HAVE_PALLAS = True
+except Exception:  # pragma: no cover - fault-ok: import probe only
+    HAVE_PALLAS = False
+
+# auto      — compiled kernels on a TPU backend, jnp fallback elsewhere
+# interpret — interpreted kernels on ANY backend (tests/CPU parity)
+# off       — kernels disabled entirely (today's exact execution path)
+MODE = ConfigOption("TPU_CYPHER_PALLAS", "auto", str)
+
+_VALID_MODES = ("auto", "interpret", "off")
+
+
+def mode() -> str:
+    m = MODE.get().strip().lower()
+    return m if m in _VALID_MODES else "auto"
+
+
+@dataclass(frozen=True)
+class KernelSpec:
+    """One registered kernel: its fault site and the functions holding its
+    raw ``pl.pallas_call`` (the AST guard's allowlist)."""
+
+    name: str
+    site: str
+    impls: Tuple[str, ...]
+
+
+_REGISTRY: Dict[str, KernelSpec] = {}
+_BROKEN: Dict[str, str] = {}  # "name" or "name/variant" -> repr(exc)
+_COUNTS: Dict[str, Dict[str, int]] = {}
+_LOCK = threading.Lock()
+
+
+def register(name: str, site: str, impls: Tuple[str, ...]) -> None:
+    _REGISTRY[name] = KernelSpec(name, site, tuple(impls))
+    _COUNTS.setdefault(name, {"pallas": 0, "fallback": 0})
+
+
+def registry() -> Dict[str, KernelSpec]:
+    return dict(_REGISTRY)
+
+
+def broken() -> Dict[str, str]:
+    """Snapshot of memoized lowering failures (diagnostics/bench)."""
+    with _LOCK:
+        return dict(_BROKEN)
+
+
+def is_broken(name: str, variant: str = "") -> bool:
+    key = f"{name}/{variant}" if variant else name
+    with _LOCK:
+        return key in _BROKEN
+
+
+def reset(name: Optional[str] = None) -> None:
+    """Clear broken memoization (and counters) — for tests and for an
+    operator who swapped in a fixed jax/libtpu build mid-process. ``name``
+    limits the reset to one kernel's entries."""
+    with _LOCK:
+        if name is None:
+            _BROKEN.clear()
+            for c in _COUNTS.values():
+                c["pallas"] = 0
+                c["fallback"] = 0
+            return
+        for key in [k for k in _BROKEN if k == name or k.startswith(name + "/")]:
+            del _BROKEN[key]
+        if name in _COUNTS:
+            _COUNTS[name] = {"pallas": 0, "fallback": 0}
+
+
+def use_counts() -> Dict[str, Dict[str, int]]:
+    with _LOCK:
+        return {k: dict(v) for k, v in _COUNTS.items()}
+
+
+def _count(name: str, which: str) -> None:
+    with _LOCK:
+        _COUNTS.setdefault(name, {"pallas": 0, "fallback": 0})[which] += 1
+
+
+def launch(
+    name: str,
+    pallas_fn: Callable[..., Any],
+    fallback_fn: Callable[[], Any],
+    *,
+    eligible: bool = True,
+    variant: str = "",
+    force_interpret: bool = False,
+) -> Any:
+    """Run ``pallas_fn(interpret=...)`` when the kernel tier is active for
+    ``name``, else ``fallback_fn()``.
+
+    ``eligible``: the caller's per-call shape/dtype/VMEM verdict.
+    ``variant``: sub-key for broken-once memoization (e.g. a dtype — an
+    f64 lowering failure must not disable the int64 variant).
+    ``force_interpret``: per-call interpreter override (tests exercising
+    kernel semantics off-TPU regardless of mode).
+
+    A ``pallas_fn`` may return ``None`` to DECLINE after a data-dependent
+    check (e.g. the hash build didn't converge) — the fallback runs and
+    nothing is memoized. Exceptions from an interpreted program re-raise
+    (real bugs, never memoized); a compiled-path failure is classified
+    first (``reraise_if_device`` — an OOM mid-kernel must surface typed to
+    the ladder, not masquerade as a lowering problem), then memoized
+    broken-once and the jnp formulation takes over.
+    """
+    spec = _REGISTRY[name]
+    m = mode()
+    key = f"{name}/{variant}" if variant else name
+    active = (
+        HAVE_PALLAS
+        and eligible
+        and not is_broken(name, variant)
+        and (
+            force_interpret
+            or (
+                m != "off"
+                and (m == "interpret" or _backend_is_tpu())
+            )
+        )
+    )
+    if not active:
+        _count(name, "fallback")
+        return fallback_fn()
+    interp = force_interpret or m == "interpret" or not _backend_is_tpu()
+    from ....runtime.faults import fault_point
+
+    fault_point(spec.site)
+    try:
+        out = pallas_fn(interpret=interp)
+    except Exception as exc:
+        from ....errors import reraise_if_device
+
+        reraise_if_device(exc, site=spec.site)
+        if interp:
+            raise
+        with _LOCK:
+            _BROKEN[key] = repr(exc)
+        _count(name, "fallback")
+        return fallback_fn()
+    if out is None:  # kernel declined post-eligibility (build didn't fit)
+        _count(name, "fallback")
+        return fallback_fn()
+    _count(name, "pallas")
+    return out
+
+
+def _backend_is_tpu() -> bool:
+    import jax
+
+    return jax.default_backend() == "tpu"

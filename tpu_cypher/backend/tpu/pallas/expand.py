@@ -1,0 +1,134 @@
+"""Pallas CSR expand-materialize kernel: the row-search formulation.
+
+``jit_ops.expand_materialize_counted`` builds the (row, edge) lanes of an
+expand with a repeat cascade: exclusive-cumsum the degrees, ``jnp.repeat``
+the row ids and flat bases, add an iota. XLA lowers the variable repeat as
+scatter/gather traffic through HBM sized by the OUTPUT, with the frontier
+state re-gathered per output lane.
+
+The hand-scheduled version inverts the data movement: the per-frontier-row
+state (``starts`` = rp[pos], and the inclusive degree cumsum ``cum``) stays
+VMEM-RESIDENT for the whole launch, and each (8, 128) OUTPUT tile finds its
+source row with a branchless binary search over ``cum`` — ceil(log2(F+1))
+VMEM gathers per tile, zero HBM traffic beyond streaming the output. The
+``ci``/``eo`` neighbor gathers and the pad-lane masking stay in the shared
+``jit_ops.finish_expand_counted`` tail, so the kernel and the jnp
+formulation CANNOT drift past the (row, edge) lanes.
+
+Exactness: all-integer arithmetic; for every live lane ``l`` the search
+returns ``row = searchsorted(cum, l, 'right') - 1`` and
+``edge = starts[row] + (l - cum[row])`` — algebraically identical to the
+repeat cascade. Pad lanes (``l >= nvalid``) fall past ``cum[F]`` and are
+sanitized by the shared tail exactly like the jnp path's.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from . import dispatch
+from .. import jit_ops as J
+
+if dispatch.HAVE_PALLAS:
+    from jax.experimental import pallas as pl
+
+_ROWS = 8
+_LANES = 128
+_BLOCK = _ROWS * _LANES
+
+# VMEM cap for the resident frontier state: (F+1) cum + F starts, int32 —
+# ~2 MiB at the cap, leaving room for tiles and double buffers
+MAX_FRONTIER = 1 << 18
+
+
+def _expand_rows_kernel(cum_ref, starts_ref, row_ref, edge_ref):
+    i = pl.program_id(0)
+    nstops = cum_ref.shape[0]  # F + 1, static at trace time
+    # flat output lane id per (8, 128) element
+    r = jax.lax.broadcasted_iota(jnp.int32, (_ROWS, _LANES), 0)
+    c = jax.lax.broadcasted_iota(jnp.int32, (_ROWS, _LANES), 1)
+    lane = i * _BLOCK + r * _LANES + c
+    # branchless binary search: first index with cum[idx] > lane, minus 1.
+    # Updates are gated on ``lo < hi`` so the statically-unrolled iteration
+    # count is an upper bound, not an exact schedule (a converged lane must
+    # not overshoot when mid == nstops gathers the clipped last stop).
+    lo = jnp.zeros((_ROWS, _LANES), jnp.int32)
+    hi = jnp.full((_ROWS, _LANES), nstops, jnp.int32)
+    for _ in range(nstops.bit_length()):
+        active = lo < hi
+        mid = (lo + hi) // 2
+        go = (cum_ref[jnp.clip(mid, 0, nstops - 1)] <= lane) & active
+        lo = jnp.where(go, mid + 1, lo)
+        hi = jnp.where((~go) & active, mid, hi)
+    row = jnp.clip(lo - 1, 0, max(nstops - 2, 0))
+    edge = starts_ref[row] + (lane - cum_ref[row])
+    row_ref[...] = row
+    edge_ref[...] = edge
+
+
+@partial(jax.jit, static_argnames=("size", "interpret"))
+def _expand_rows_pallas(rp, ci, eo, pos, deg, nvalid, size: int, interpret: bool):
+    """One jitted program: frontier state build + the Pallas grid + the
+    shared counted-materialize tail. ``size`` is the bucketed static lane
+    count, so warm-path dispatches reuse one compiled program per bucket."""
+    starts = jnp.take(rp, pos).astype(jnp.int32)
+    cum = jnp.concatenate(
+        [jnp.zeros(1, jnp.int32), jnp.cumsum(deg).astype(jnp.int32)]
+    )
+    size_pad = ((size + _BLOCK - 1) // _BLOCK) * _BLOCK
+    grid = (size_pad // _BLOCK,)
+    row2d, edge2d = pl.pallas_call(
+        _expand_rows_kernel,
+        out_shape=(
+            jax.ShapeDtypeStruct((grid[0] * _ROWS, _LANES), jnp.int32),
+            jax.ShapeDtypeStruct((grid[0] * _ROWS, _LANES), jnp.int32),
+        ),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((cum.shape[0],), lambda i: (0,)),
+            pl.BlockSpec((starts.shape[0],), lambda i: (0,)),
+        ],
+        out_specs=(
+            pl.BlockSpec((_ROWS, _LANES), lambda i: (i, 0)),
+            pl.BlockSpec((_ROWS, _LANES), lambda i: (i, 0)),
+        ),
+        interpret=interpret,
+    )(cum, starts)
+    row = row2d.reshape(-1)[:size].astype(jnp.int64)
+    edge = edge2d.reshape(-1)[:size].astype(jnp.int64)
+    return J.finish_expand_counted(ci, eo, row, edge, nvalid, size)
+
+
+dispatch.register(
+    "expand_rows", "kernel_expand", impls=("_expand_rows_pallas",)
+)
+
+
+def expand_materialize_counted(rp, ci, eo, pos, deg, nvalid, *, size: int):
+    """Dispatching drop-in for ``jit_ops.expand_materialize_counted``.
+
+    Eligibility (all host-known, zero extra syncs): a non-empty frontier
+    that fits the VMEM-resident state cap, a nonzero bucketed ``size``,
+    and int32-safe lanes — ``rp``/``ci`` are int32 by construction
+    (``GraphIndex.csr``), so edges and cumsum totals fit whenever the
+    graph itself does (``GraphIndex.csr_int32_safe``)."""
+    frontier = int(pos.shape[0])
+    eligible = (
+        0 < size < 2**30
+        and 0 < frontier <= MAX_FRONTIER
+        and rp.dtype == jnp.int32
+        and ci.dtype == jnp.int32
+    )
+    return dispatch.launch(
+        "expand_rows",
+        lambda interpret: _expand_rows_pallas(
+            rp, ci, eo, pos, deg, nvalid, size=size, interpret=interpret
+        ),
+        lambda: J.expand_materialize_counted(
+            rp, ci, eo, pos, deg, nvalid, size=size
+        ),
+        eligible=eligible,
+    )

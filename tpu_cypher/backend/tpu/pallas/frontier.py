@@ -1,0 +1,118 @@
+"""Pallas TPU kernel for the hot frontier degree-sum reduction.
+
+Single-hop count-only plans reduce to a frontier degree sum
+(``expand_op._count_via_chain``): ``total = sum_i deg[frontier[i]]``. XLA
+lowers that as gather + reduce through HBM; this Pallas kernel tiles the
+frontier through VMEM in (8, 128) int32 blocks with the degree vector
+VMEM-resident, accumulating one partial per program — the hand-scheduled
+version of the engine's hottest reduction (pallas guide: VPU elementwise +
+grid partials).
+
+The single entry point is ``csr_frontier_degree_sum``; everything —
+degree-vector construction, frontier masking, padding, the grid call — is
+ONE cached jitted program (eager dispatch is ~1s/op on a tunneled TPU).
+CPU/tests run the identical program under ``interpret=True``; the real
+Mosaic lowering engages only on a TPU backend, and a lowering failure is
+remembered per-kernel by the dispatch layer so the jnp formulation takes
+over permanently.
+
+Degrees are int32 and a (8x128)-element block sum must fit int32 — true
+for any graph with < 2**21 max degree; callers pass the host-cached max
+degree (``GraphIndex.csr_max_degree``) so the eligibility check costs no
+device sync. The cross-block total accumulates in int64.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from . import dispatch
+
+if dispatch.HAVE_PALLAS:
+    from jax.experimental import pallas as pl
+
+# one program reduces an (8, 128) int32 tile — the f32/i32 min tile shape
+_ROWS = 8
+_LANES = 128
+_BLOCK = _ROWS * _LANES
+
+
+def _deg_sum_kernel(deg_ref, idx_ref, out_ref):
+    idx = idx_ref[...]
+    valid = idx >= 0  # padding / not-present slots are -1
+    vals = deg_ref[jnp.clip(idx, 0, deg_ref.shape[0] - 1)]
+    # dtype pinned: under JAX_ENABLE_X64 jnp.sum accumulates int32 into
+    # int64 (numpy semantics), which the int32 out_ref rejects
+    out_ref[0, 0] = jnp.sum(jnp.where(valid, vals, 0), dtype=jnp.int32)
+
+
+@jax.jit
+def _csr_deg_sum_jnp(rp, pos, present):
+    deg = (jnp.take(rp, pos + 1) - jnp.take(rp, pos)).astype(jnp.int64)
+    return jnp.sum(jnp.where(present, deg, 0))
+
+
+@partial(jax.jit, static_argnames=("interpret",))
+def _csr_deg_sum_pallas(rp, pos, present, interpret: bool = False):
+    """One jitted program: degree vector + frontier mask + pad/reshape +
+    the Pallas grid call (shapes are static under trace, so the padding
+    arithmetic costs nothing at dispatch time)."""
+    node_deg = (rp[1:] - rp[:-1]).astype(jnp.int32)
+    idx = jnp.where(present, pos, -1).astype(jnp.int32)
+    pad = (-idx.shape[0]) % _BLOCK
+    if pad:
+        idx = jnp.concatenate([idx, jnp.full(pad, -1, jnp.int32)])
+    idx2d = idx.reshape(-1, _LANES)
+    grid = (idx2d.shape[0] // _ROWS,)
+    partials = pl.pallas_call(
+        _deg_sum_kernel,
+        out_shape=jax.ShapeDtypeStruct((grid[0], 1), jnp.int32),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((node_deg.shape[0],), lambda i: (0,)),
+            pl.BlockSpec((_ROWS, _LANES), lambda i: (i, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, 1), lambda i: (i, 0)),
+        interpret=interpret,
+    )(node_deg, idx2d)
+    return jnp.sum(partials.astype(jnp.int64))
+
+
+dispatch.register(
+    "frontier_deg_sum", "kernel_frontier", impls=("_csr_deg_sum_pallas",)
+)
+
+
+# VMEM budget for the resident degree vector (int32): 4 MiB at the cap —
+# larger graphs keep the two-gather jnp formulation
+MAX_NODES = 1 << 20
+
+
+def csr_frontier_degree_sum(
+    rp, pos, present, max_deg: int | None = None, *, interpret: bool | None = None
+) -> Any:
+    """``sum over frontier rows of (rp[pos+1] - rp[pos])`` with ``present``
+    masking. The Pallas path materializes the O(V) per-node degree vector it
+    tiles through VMEM; the jnp path keeps the O(frontier) two-gather
+    formulation (no full-vector diff on CPU/GPU). ``max_deg``: host-cached
+    max degree — the int32 block-sum eligibility check without a per-call
+    device sync (``GraphIndex.csr_degree_stats``). ``interpret=True``
+    forces the interpreted Pallas program (tests exercise the kernel
+    semantics off-TPU)."""
+    eligible = (
+        max_deg is not None
+        and max_deg < 2**21
+        and int(pos.shape[0]) > 0
+        and int(rp.shape[0]) - 1 <= MAX_NODES
+    )
+    return dispatch.launch(
+        "frontier_deg_sum",
+        lambda interpret: _csr_deg_sum_pallas(rp, pos, present, interpret=interpret),
+        lambda: _csr_deg_sum_jnp(rp, pos, present),
+        eligible=eligible,
+        force_interpret=interpret is True,
+    )

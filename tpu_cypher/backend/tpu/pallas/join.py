@@ -1,0 +1,267 @@
+"""Pallas hash-join probe kernel: VMEM-resident open addressing.
+
+``jit_ops.join_probe_bucketed`` finds each probe row's build matches with
+TWO binary searches over the sorted build keys — 2·log2(cap) dependent HBM
+gathers per probe row. The hand-scheduled replacement builds (once per
+build side) an open-addressing table over the UNIQUE sorted build keys,
+each slot carrying the key's first sorted position and run length, then
+streams the probe side through VMEM in (8, 128) tiles probing the
+VMEM-RESIDENT table: expected O(1) gathers per row, worst case the static
+probe bound ``_PROBE_LIMIT``.
+
+Exactness is by construction, not by hashing luck:
+
+* the build phase (plain jnp, one jitted program per bucketed capacity)
+  inserts all unique keys IN PARALLEL — per round every unplaced key
+  claims ``(h + offset) & (S-1)``, ties resolved by smallest lane id, and
+  losers advance their offset. Every slot a key stepped over is occupied
+  in the final table, so the linear-probe lookup invariant holds.
+* the build returns an ``ok`` verdict: every key placed within the round
+  budget. A placed key's offset equals the round it won, so the kernel's
+  equal probe budget ALWAYS reaches it; an absent key can never match any
+  slot (exact key compare, occupancy by count, no key sentinel), so its
+  count is 0 no matter where probing stops. One extra scalar sync per
+  build side decides the verdict; ``not ok`` declines to the searchsorted
+  formulation — never a wrong answer, only a slower exact one.
+* keys are compared as exact (lo32, hi32) int64 halves (tagged element
+  ids live at bits 54+; the kernel itself stays int32 — Mosaic's native
+  lane width). Occupancy is carried by ``count > 0``, so no key value is
+  reserved as a sentinel.
+
+Output contract is bit-identical to ``join_probe_bucketed``: per probe
+row the FIRST sorted build position and the match count, so the shared
+``join_materialize_counted`` emits the same pairs in the same order.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from . import dispatch
+from .. import bucketing
+from .. import jit_ops as J
+
+if dispatch.HAVE_PALLAS:
+    from jax.experimental import pallas as pl
+
+_ROWS = 8
+_LANES = 128
+_BLOCK = _ROWS * _LANES
+
+# static probe bound: the kernel unrolls this many table gathers per tile.
+# A key placed in build round r sits at offset r, so probe t = r finds it;
+# equal budgets make "all placed" the complete correctness verdict.
+_PROBE_LIMIT = 16
+_BUILD_ROUNDS = _PROBE_LIMIT
+# build capacity cap: 4 int32 table vectors at load factor <= 1/2 stay
+# well under the VMEM budget (S = 2*cap -> 16 B/slot -> 4 MiB at the cap)
+MAX_BUILD = 1 << 17
+
+
+def _split64(x):
+    """int64 -> exact (lo32, hi32) int32 halves via bitcast."""
+    both = jax.lax.bitcast_convert_type(x, jnp.int32)
+    return both[..., 0], both[..., 1]
+
+
+def _slot_hash(lo32, hi32, size: int):
+    """Multiplicative mix of the two halves -> [0, size) (size = 2**m).
+    uint32 arithmetic wraps identically under XLA CPU/TPU/interpret."""
+    m = (size - 1).bit_length()
+    u = lo32.astype(jnp.uint32) * jnp.uint32(2654435761) ^ (
+        hi32.astype(jnp.uint32) * jnp.uint32(2246822519)
+    )
+    return (u >> jnp.uint32(32 - m)).astype(jnp.int32)
+
+
+@partial(jax.jit, static_argnames=("cap", "size"))
+def _hash_build(rd, r_order, nvalid, cap: int, size: int):
+    """Build the open-addressing table from the valid-first sorted build
+    side. Returns (key_lo, key_hi, slot_pos, slot_cnt, ok) with the table
+    vectors sized ``size`` (+1 dump slot internally) and ``ok`` the
+    all-placed & run-bound verdict (traced bool; the dispatcher syncs it).
+    """
+    lane = jnp.arange(cap, dtype=jnp.int64)
+    live = lane < nvalid
+    r_sorted = jnp.take(rd, r_order[:cap]).astype(jnp.int64)
+    key = jnp.where(live, r_sorted, 0)
+    prev = jnp.concatenate([jnp.zeros(1, key.dtype) - 1, key[:-1]])
+    is_first = live & ((lane == 0) | (key != prev))
+    # run length per first lane: distance to the next first-occurrence
+    # (or the valid end), via a reversed cummin of (first ? lane : cap)
+    first_pos = jnp.where(is_first, lane, cap)
+    next_first = jnp.flip(
+        jax.lax.associative_scan(jnp.minimum, jnp.flip(first_pos))
+    )
+    next_first = jnp.concatenate([next_first[1:], jnp.asarray([cap], jnp.int64)])
+    end = jnp.minimum(next_first, nvalid)
+    cnt = jnp.where(is_first, end - lane, 0).astype(jnp.int32)
+
+    klo, khi = _split64(key)
+    h = _slot_hash(klo, khi, size)
+
+    s1 = size + 1  # slot ``size`` is the dump target for masked writes
+    slot_lo = jnp.zeros(s1, jnp.int32)
+    slot_hi = jnp.zeros(s1, jnp.int32)
+    slot_pos = jnp.zeros(s1, jnp.int32)
+    slot_cnt = jnp.zeros(s1, jnp.int32)
+    off = jnp.zeros(cap, jnp.int32)
+    placed = ~is_first  # only first-occurrence lanes insert
+    lane32 = jnp.arange(cap, dtype=jnp.int32)
+    for _ in range(_BUILD_ROUNDS):
+        trial = (h + off) & (size - 1)
+        occupied = jnp.take(slot_cnt, trial) > 0
+        want = ~placed & ~occupied
+        tslot = jnp.where(want, trial, size)
+        claim = jnp.full(s1, cap, jnp.int32).at[tslot].min(lane32)
+        win = want & (jnp.take(claim, trial) == lane32)
+        wslot = jnp.where(win, trial, size)
+        slot_lo = slot_lo.at[wslot].set(klo, mode="drop")
+        slot_hi = slot_hi.at[wslot].set(khi, mode="drop")
+        slot_pos = slot_pos.at[wslot].set(lane32, mode="drop")
+        slot_cnt = slot_cnt.at[wslot].set(cnt, mode="drop")
+        placed = placed | win
+        off = off + jnp.where(placed, 0, 1).astype(jnp.int32)
+    return (
+        slot_lo[:size],
+        slot_hi[:size],
+        slot_pos[:size],
+        slot_cnt[:size],
+        jnp.all(placed),
+    )
+
+
+def _probe_kernel(tab_lo_ref, tab_hi_ref, tab_pos_ref, tab_cnt_ref,
+                  plo_ref, phi_ref, h_ref, lo_ref, cnt_ref):
+    plo = plo_ref[...]
+    phi = phi_ref[...]
+    h = h_ref[...]
+    size = tab_cnt_ref.shape[0]
+    out_lo = jnp.zeros((_ROWS, _LANES), jnp.int32)
+    out_cnt = jnp.zeros((_ROWS, _LANES), jnp.int32)
+    done = jnp.zeros((_ROWS, _LANES), bool)
+    for t in range(_PROBE_LIMIT):
+        s = (h + t) & (size - 1)
+        c = tab_cnt_ref[s]
+        hit = (~done) & (c > 0) & (tab_lo_ref[s] == plo) & (tab_hi_ref[s] == phi)
+        out_lo = jnp.where(hit, tab_pos_ref[s], out_lo)
+        out_cnt = jnp.where(hit, c, out_cnt)
+        done = done | hit | (c == 0)
+    lo_ref[...] = out_lo
+    cnt_ref[...] = out_cnt
+
+
+@partial(jax.jit, static_argnames=("interpret",))
+def _hash_probe_pallas(tab_lo, tab_hi, tab_pos, tab_cnt, ld, lvalid,
+                       interpret: bool):
+    """Stream the probe side through the VMEM-resident table. Returns
+    (lo, counts, total) matching ``join_probe_bucketed``'s probe outputs:
+    invalid probe lanes count zero, and lo clamps inside the valid build
+    range by construction (slot positions come from live build lanes)."""
+    size = tab_cnt.shape[0]
+    n = ld.shape[0]
+    npad = ((n + _BLOCK - 1) // _BLOCK) * _BLOCK
+    ld64 = ld.astype(jnp.int64)
+    plo, phi = _split64(ld64)
+    h = _slot_hash(plo, phi, size)
+    pad = npad - n
+    if pad:
+        plo = jnp.concatenate([plo, jnp.zeros(pad, jnp.int32)])
+        phi = jnp.concatenate([phi, jnp.zeros(pad, jnp.int32)])
+        h = jnp.concatenate([h, jnp.zeros(pad, jnp.int32)])
+    shape2d = (npad // _LANES, _LANES)
+    grid = (npad // _BLOCK,)
+    lo2d, cnt2d = pl.pallas_call(
+        _probe_kernel,
+        out_shape=(
+            jax.ShapeDtypeStruct(shape2d, jnp.int32),
+            jax.ShapeDtypeStruct(shape2d, jnp.int32),
+        ),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((size,), lambda i: (0,)),
+            pl.BlockSpec((size,), lambda i: (0,)),
+            pl.BlockSpec((size,), lambda i: (0,)),
+            pl.BlockSpec((size,), lambda i: (0,)),
+            pl.BlockSpec((_ROWS, _LANES), lambda i: (i, 0)),
+            pl.BlockSpec((_ROWS, _LANES), lambda i: (i, 0)),
+            pl.BlockSpec((_ROWS, _LANES), lambda i: (i, 0)),
+        ],
+        out_specs=(
+            pl.BlockSpec((_ROWS, _LANES), lambda i: (i, 0)),
+            pl.BlockSpec((_ROWS, _LANES), lambda i: (i, 0)),
+        ),
+        interpret=interpret,
+    )(
+        tab_lo, tab_hi, tab_pos, tab_cnt,
+        plo.reshape(shape2d), phi.reshape(shape2d), h.reshape(shape2d),
+    )
+    lo = lo2d.reshape(-1)[:n].astype(jnp.int64)
+    counts = jnp.where(lvalid, cnt2d.reshape(-1)[:n], 0).astype(jnp.int64)
+    return lo, counts, jnp.sum(counts)
+
+
+dispatch.register(
+    "join_probe", "kernel_join", impls=("_hash_probe_pallas",)
+)
+
+
+@jax.jit
+def _fold_probe_valid(ld, lvalids):
+    lvalid = jnp.ones(ld.shape[0], bool)
+    for m in lvalids:
+        lvalid = lvalid & m
+    return lvalid
+
+
+@partial(jax.jit, static_argnames=("cap",))
+def _build_r_idx(r_order, cap: int):
+    return r_order[:cap]
+
+
+def join_probe_bucketed(
+    rd, r_order, ld, lvalids, nvalid, *, nvalid_cap: int, is_f64: bool,
+    is_bool: bool,
+):
+    """Dispatching drop-in for ``jit_ops.join_probe_bucketed``: identical
+    (r_idx_valid, lo, counts, total) contract. Float keys stay on the
+    searchsorted path (bitwise key compare would split -0.0 from 0.0);
+    integer/bool/dict-coded keys probe the hash table when the build fits
+    VMEM and the build verdict holds."""
+    kernel_ok = (
+        not is_f64
+        and ld.ndim == 1
+        and rd.ndim == 1
+        and (
+            jnp.issubdtype(ld.dtype, jnp.integer) or ld.dtype == jnp.bool_
+        )
+        and 0 < nvalid_cap <= MAX_BUILD
+        and int(ld.shape[0]) > 0
+    )
+
+    def pallas_fn(interpret: bool):
+        size = bucketing.round_up_pow2(2 * nvalid_cap)
+        build = _hash_build(
+            rd.astype(jnp.int64), r_order, nvalid, cap=nvalid_cap, size=size
+        )
+        if not bool(build[4]):  # one scalar sync: the build verdict
+            return None
+        lo, counts, total = _hash_probe_pallas(
+            build[0], build[1], build[2], build[3],
+            ld.astype(jnp.int64), _fold_probe_valid(ld, lvalids),
+            interpret=interpret,
+        )
+        return _build_r_idx(r_order, cap=nvalid_cap), lo, counts, total
+
+    return dispatch.launch(
+        "join_probe",
+        pallas_fn,
+        lambda: J.join_probe_bucketed(
+            rd, r_order, ld, lvalids, nvalid,
+            nvalid_cap=nvalid_cap, is_f64=is_f64, is_bool=is_bool,
+        ),
+        eligible=kernel_ok,
+    )

@@ -1,125 +1,16 @@
-"""Pallas TPU kernel for the hot frontier degree-sum reduction.
+"""Compatibility shim: the Pallas kernels grew into a package.
 
-Single-hop count-only plans reduce to a frontier degree sum
-(``expand_op._count_via_chain``): ``total = sum_i deg[frontier[i]]``. XLA
-lowers that as gather + reduce through HBM; this Pallas kernel tiles the
-frontier through VMEM in (8, 128) int32 blocks with the degree vector
-VMEM-resident, accumulating one partial per program — the hand-scheduled
-version of the engine's hottest reduction (pallas guide: VPU elementwise +
-grid partials).
-
-The single entry point is ``csr_frontier_degree_sum``; everything —
-degree-vector construction, frontier masking, padding, the grid call — is
-ONE cached jitted program (eager dispatch is ~1s/op on a tunneled TPU).
-CPU/tests run the identical program under ``interpret=True``; the real
-Mosaic lowering engages only on a TPU backend, and a lowering failure is
-remembered so the jnp formulation takes over permanently.
-
-Degrees are int32 and a (8x128)-element block sum must fit int32 — true
-for any graph with < 2**21 max degree; callers pass the host-cached max
-degree (``GraphIndex.csr_max_degree``) so the eligibility check costs no
-device sync. The cross-block total accumulates in int64.
+The original single-kernel module became ``backend/tpu/pallas/`` — a
+kernel SUITE (frontier degree-sum, hash-join probe, expand materialize,
+segment aggregate) behind one dispatch layer (``pallas/dispatch.py``:
+``TPU_CYPHER_PALLAS`` mode, per-kernel eligibility, broken-once fallback,
+fault sites). This module keeps the historical import path alive for
+callers and tests that patch ``pallas_kernels.csr_frontier_degree_sum``.
 """
 
-from __future__ import annotations
-
-from functools import partial
-from typing import Any
-
-import jax
-import jax.numpy as jnp
-
-try:  # pragma: no cover - availability depends on the jax build
-    from jax.experimental import pallas as pl
-
-    HAVE_PALLAS = True
-except Exception:  # pragma: no cover - fault-ok: import probe only
-    HAVE_PALLAS = False
-
-# one program reduces an (8, 128) int32 tile — the f32/i32 min tile shape
-_ROWS = 8
-_LANES = 128
-_BLOCK = _ROWS * _LANES
-
-
-def _deg_sum_kernel(deg_ref, idx_ref, out_ref):
-    idx = idx_ref[...]
-    valid = idx >= 0  # padding / not-present slots are -1
-    vals = deg_ref[jnp.clip(idx, 0, deg_ref.shape[0] - 1)]
-    # dtype pinned: under JAX_ENABLE_X64 jnp.sum accumulates int32 into
-    # int64 (numpy semantics), which the int32 out_ref rejects
-    out_ref[0, 0] = jnp.sum(jnp.where(valid, vals, 0), dtype=jnp.int32)
-
-
-@jax.jit
-def _csr_deg_sum_jnp(rp, pos, present):
-    deg = (jnp.take(rp, pos + 1) - jnp.take(rp, pos)).astype(jnp.int64)
-    return jnp.sum(jnp.where(present, deg, 0))
-
-
-@partial(jax.jit, static_argnames=("interpret",))
-def _csr_deg_sum_pallas(rp, pos, present, interpret: bool = False):
-    """One jitted program: degree vector + frontier mask + pad/reshape +
-    the Pallas grid call (shapes are static under trace, so the padding
-    arithmetic costs nothing at dispatch time)."""
-    node_deg = (rp[1:] - rp[:-1]).astype(jnp.int32)
-    idx = jnp.where(present, pos, -1).astype(jnp.int32)
-    pad = (-idx.shape[0]) % _BLOCK
-    if pad:
-        idx = jnp.concatenate([idx, jnp.full(pad, -1, jnp.int32)])
-    idx2d = idx.reshape(-1, _LANES)
-    grid = (idx2d.shape[0] // _ROWS,)
-    partials = pl.pallas_call(
-        _deg_sum_kernel,
-        out_shape=jax.ShapeDtypeStruct((grid[0], 1), jnp.int32),
-        grid=grid,
-        in_specs=[
-            pl.BlockSpec((node_deg.shape[0],), lambda i: (0,)),
-            pl.BlockSpec((_ROWS, _LANES), lambda i: (i, 0)),
-        ],
-        out_specs=pl.BlockSpec((1, 1), lambda i: (i, 0)),
-        interpret=interpret,
-    )(node_deg, idx2d)
-    return jnp.sum(partials.astype(jnp.int64))
-
-
-# set after the first lowering failure so a broken Mosaic build is paid for
-# ONCE, not per query (jax.jit does not cache failed compiles)
-_PALLAS_BROKEN = False
-
-
-def csr_frontier_degree_sum(
-    rp, pos, present, max_deg: int | None = None, *, interpret: bool | None = None
-) -> Any:
-    """``sum over frontier rows of (rp[pos+1] - rp[pos])`` with ``present``
-    masking. The Pallas path materializes the O(V) per-node degree vector it
-    tiles through VMEM; the jnp path keeps the O(frontier) two-gather
-    formulation (no full-vector diff on CPU/GPU). ``max_deg``: host-cached
-    max degree — the int32 block-sum eligibility check without a per-call
-    device sync. ``interpret=True`` forces the interpreted Pallas program
-    (tests exercise the kernel semantics off-TPU)."""
-    global _PALLAS_BROKEN
-    force_interpret = interpret is True
-    pallas_ok = (
-        HAVE_PALLAS
-        and not _PALLAS_BROKEN
-        and (force_interpret or jax.default_backend() == "tpu")
-        and max_deg is not None
-        and max_deg < 2**21
-        and int(pos.shape[0]) > 0
-    )
-    if pallas_ok:
-        try:
-            return _csr_deg_sum_pallas(rp, pos, present, interpret=force_interpret)
-        except Exception as exc:  # fault-ok: Mosaic lowering failure falls
-            # back to the jnp formulation — but an OOM/device-loss during
-            # the kernel run must surface typed, not masquerade as a
-            # lowering problem
-            from ...errors import reraise_if_device
-
-            reraise_if_device(exc, site="expand")
-            if not force_interpret:
-                _PALLAS_BROKEN = True
-            else:
-                raise
-    return _csr_deg_sum_jnp(rp, pos, present)
+from .pallas import HAVE_PALLAS  # noqa: F401
+from .pallas.frontier import (  # noqa: F401
+    _csr_deg_sum_jnp,
+    _csr_deg_sum_pallas,
+    csr_frontier_degree_sum,
+)

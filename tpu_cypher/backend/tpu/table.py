@@ -603,7 +603,12 @@ class TpuTable(Table):
                 cap = min(
                     bucketing.round_size(nvalid), int(r_order.shape[0])
                 )
-                r_idx_valid, lo, counts, total_dev = J.join_probe_bucketed(
+                # kernel tier: the Pallas hash-probe when eligible
+                # (dispatch falls back to the searchsorted formulation;
+                # see backend/tpu/pallas/join.py)
+                from .pallas import join_probe_bucketed
+
+                r_idx_valid, lo, counts, total_dev = join_probe_bucketed(
                     rd_s, r_order, lk.data, lvalids, nvalid_dev,
                     nvalid_cap=cap, is_f64=is_f64, is_bool=is_bool,
                 )
@@ -1140,7 +1145,12 @@ class TpuTable(Table):
             raise TpuUnsupportedExpr(f"{name} over {kind}")
         if name in ("percentilecont", "percentiledisc"):
             return self._segment_percentile(name, agg, seg_j, col, n, k, parameters)
-        out_data, out_valid, out_iflag, iflag_any = J.segment_aggregate(
+        # kernel tier: the Pallas masked segment reduce when eligible
+        # (dispatch falls back to the jax.ops scatter formulation; see
+        # backend/tpu/pallas/aggregate.py)
+        from .pallas import segment_aggregate
+
+        out_data, out_valid, out_iflag, iflag_any = segment_aggregate(
             data, col.valid, col.int_flag, seg_j, name=name, kind=kind, k=k
         )
         if name == "count":

@@ -9,8 +9,11 @@ specs):
 
 * ``kind``  — ``oom`` | ``compile`` | ``lost`` | ``timeout``
 * ``site``  — a named fault site (``join``, ``expand``, ``var_expand``,
-  ``filter``, ``compact``, ``shuffle``, ...; grep ``fault_point(`` for the
-  full set)
+  ``filter``, ``compact``, ``shuffle``, plus the Pallas kernel-tier sites
+  ``kernel_join``/``kernel_expand``/``kernel_agg``/``kernel_frontier``
+  fired by ``backend.tpu.pallas.dispatch.launch`` just before a kernel
+  launch; grep ``fault_point(`` and ``dispatch.register(`` for the full
+  set)
 * ``occurrence`` — WHICH invocations of the site fire, 1-based:
   ``:3`` (exactly the 3rd), ``:2-5`` (2nd through 5th), ``:*`` (every
   invocation — drives the ladder all the way to the host oracle). Default
