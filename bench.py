@@ -213,6 +213,10 @@ def _host_graph_stats(graph):
 
 
 def _tier_snapshot():
+    # tier counters ride the unified obs registry since PR 4
+    # (tpu_cypher_mxu_tier_total / _native_tier_total /
+    # _pallas_launch_total); these dict views keep the per-rung tier
+    # strings stable
     from tpu_cypher.backend.tpu import expand_op as X
     from tpu_cypher.backend.tpu.pallas import dispatch as PD
 
@@ -223,6 +227,18 @@ def _tier_snapshot():
         # per-rung tier strings record e.g. "pallas_join_probe"
         **{f"pallas_{k}": v["pallas"] for k, v in PD.use_counts().items()},
     }
+
+
+def _metrics_snapshot():
+    """The schema-versioned ``metrics`` object on the bench JSON line: a
+    flat dump of the whole obs registry at end of run (compiles, tiers,
+    fault sites, stage timings). Must never kill the line."""
+    try:
+        from tpu_cypher.obs.metrics import EVENT_SCHEMA_VERSION, REGISTRY
+
+        return {"schema_version": EVENT_SCHEMA_VERSION, **REGISTRY.flat()}
+    except Exception as exc:  # fault-ok: telemetry only
+        return {"error": str(exc)[:200]}
 
 
 def _time_query(g, query, params=None, repeats=3):
@@ -499,6 +515,7 @@ def main():
         ),
         "ladder": results["ladder"],
         "pallas_vs_xla": pallas_entry,
+        "metrics": _metrics_snapshot(),
         "probe_log": probe_log,
     }
     print(json.dumps(result))

@@ -155,11 +155,18 @@ def main():
     def run_once():
         t0 = time.perf_counter()
         before = bucketing.compile_snapshot()
-        session.cypher(two_hop, graph=g).records.collect()
+        result = session.cypher(two_hop, graph=g)
+        result.records.collect()
         compiles = bucketing.compile_delta(before)["compiles"]
-        return (time.perf_counter() - t0) * 1000.0, int(compiles)
+        # per-phase span summary from the obs trace (rounded ms; phases
+        # absent on a plan-cache hit stay absent — that IS the signal)
+        phases = {
+            k: round(v * 1000.0, 3)
+            for k, v in result.profile(execute=False).phase_seconds().items()
+        }
+        return (time.perf_counter() - t0) * 1000.0, int(compiles), phases
 
-    cold_ms, cold_compiles = run_once()
+    cold_ms, cold_compiles, cold_phases = run_once()
     warm = [run_once() for _ in range(reps)]
     warm_ms = float(np.median([w[0] for w in warm]))
     print(json.dumps({
@@ -171,6 +178,18 @@ def main():
         "compiles_cold": cold_compiles,
         "compiles_warm": int(sum(w[1] for w in warm)),
         "bucket_mode": bucketing.mode(),
+    }))
+    # cold-vs-warm per-phase breakdown: where the cold-path milliseconds
+    # go (parse/plan/execute/collect) vs the warmed re-run — the span-tree
+    # view of the same cold/warm story as compiles_cold/compiles_warm
+    print(json.dumps({
+        "metric": "phase_spans_2hop",
+        "value": round(sum(warm[-1][2].values()), 3),
+        "unit": "ms",
+        "cold_ms": round(sum(cold_phases.values()), 3),
+        "warm_ms": round(sum(warm[-1][2].values()), 3),
+        "cold": cold_phases,
+        "warm": warm[-1][2],
     }))
     # -- bucket-reuse proof: a DIFFERENT row count, zero new compiles ----
     # With TPU_CYPHER_BUCKET set, re-running the warmed join at another

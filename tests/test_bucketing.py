@@ -214,7 +214,12 @@ def test_compile_stats_always_populated():
     result = g.cypher("MATCH (a:P)-[:R]->(b:P) RETURN a.x, b.x")
     result.records.collect()
     assert result.compile_stats is not None
-    assert set(result.compile_stats) == {"compiles", "compile_seconds"}
+    assert set(result.compile_stats) == {
+        "compiles",
+        "compile_seconds",
+        "persistent_cache_hits",
+        "persistent_cache_misses",
+    }
     assert result.compile_stats["compiles"] >= 0
 
 

@@ -15,7 +15,7 @@ Quick start::
     print(g.cypher("MATCH (a:Person)-[:KNOWS]->(b) RETURN a.name, b.name").show())
 """
 
-from . import errors
+from . import errors, obs
 from .api.mapping import NodeMappingBuilder, RelationshipMappingBuilder
 from .api.schema import PropertyGraphSchema, SchemaPattern
 from .api.values import CypherMap, Duration, Node, Relationship
@@ -27,6 +27,7 @@ __version__ = "0.1.0"
 
 __all__ = [
     "errors",
+    "obs",
     "TpuCypherError",
     "CypherSession",
     "PropertyGraph",

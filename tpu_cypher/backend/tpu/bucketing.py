@@ -17,8 +17,10 @@ This module is the shared policy for the fix:
   lanes masked invalid — the same pad-masking discipline already proven for
   mesh-sharding pads (``Column.pad`` / ``compact_lookup`` validity gating).
   Two row counts in the same bucket now hit the same compiled program.
-* a process-wide XLA compile counter fed by ``jax.monitoring`` (one
-  ``backend_compile`` event per real compilation) — surfaced as
+* process-wide compile telemetry fed by ``jax.monitoring`` (one
+  ``backend_compile`` event per real compilation, persistent-cache
+  hit/miss events per disk-tier lookup), served by the unified obs
+  registry (``tpu_cypher_xla_compiles_total`` etc.) — surfaced as
   ``result.compile_stats``, ``session.warmup(..)`` deltas, and the
   ``compile_count`` metrics in ``benchmarks/micro.py``.
 * the persistent compilation cache wiring (``enable_persistent_cache``), so
@@ -36,6 +38,8 @@ from typing import Dict, Optional
 
 import numpy as np
 
+from ...obs import trace as _obs_trace
+from ...obs.metrics import REGISTRY as _REGISTRY
 from ...utils.config import ConfigOption
 
 # off  — no bucketing (every size compiles its own program; seed behavior)
@@ -111,19 +115,38 @@ def _round_125(n: int) -> int:
         return _LATTICE_125[bisect.bisect_left(_LATTICE_125, n)]
 
 
+# padded-vs-true row telemetry: every bucketed materialize passes through
+# ``round_size`` right after its count sync, making it THE chokepoint where
+# the lattice's memory overhead is observable (docs/observability.md)
+_ROWS_TRUE = _REGISTRY.counter(
+    "tpu_cypher_bucket_rows_true_total",
+    "true (pre-pad) rows across bucketed materializes",
+)
+_ROWS_PADDED = _REGISTRY.counter(
+    "tpu_cypher_bucket_rows_padded_total",
+    "padded (post-lattice) rows across bucketed materializes",
+)
+
+
 def round_size(n: int) -> int:
     """Bucketed size for a data-dependent count ``n`` (0 stays 0 — the
     empty case keeps its own trivially-cheap program). Identity when
-    bucketing is off."""
+    bucketing is off. Each call records the padded-vs-true pair on the
+    enclosing trace span and the registry counters."""
     n = int(n)
     if n <= 0:
         return 0
     m = mode()
     if m == "off":
-        return n
-    if m == "1.25":
-        return _round_125(n)
-    return round_up_pow2(n, _BUCKET_FLOOR)
+        out = n
+    elif m == "1.25":
+        out = _round_125(n)
+    else:
+        out = round_up_pow2(n, _BUCKET_FLOOR)
+    _ROWS_TRUE.inc(n)
+    _ROWS_PADDED.inc(out)
+    _obs_trace.note_rows(n, out)
+    return out
 
 
 def bucket_pad_host(arr: np.ndarray, fill):
@@ -192,41 +215,72 @@ def admit(rows: int, bytes_per_row: int, site: str) -> None:
 
 
 # ---------------------------------------------------------------------------
-# compile telemetry: count real XLA compilations via jax.monitoring
+# compile telemetry: real XLA compilations + persistent-cache hit/miss,
+# via jax.monitoring, served by the unified obs registry
 # ---------------------------------------------------------------------------
 
-_COMPILES = 0
-_COMPILE_SECONDS = 0.0
+_COMPILES_TOTAL = _REGISTRY.counter(
+    "tpu_cypher_xla_compiles_total",
+    "real XLA compilations (jit/persistent-cache hits emit none)",
+)
+_COMPILE_SECONDS_TOTAL = _REGISTRY.counter(
+    "tpu_cypher_xla_compile_seconds_total",
+    "seconds spent in real XLA compilations",
+)
+_PCACHE_HITS = _REGISTRY.counter(
+    "tpu_cypher_persistent_cache_hits_total",
+    "persistent compilation cache hits (a compile avoided by the disk tier)",
+)
+_PCACHE_MISSES = _REGISTRY.counter(
+    "tpu_cypher_persistent_cache_misses_total",
+    "persistent compilation cache misses (compile went to XLA)",
+)
+
 _LISTENER_INSTALLED = False
 
 
 def _on_event_duration(name: str, secs: float, **_kw) -> None:
-    global _COMPILES, _COMPILE_SECONDS
     # '/jax/core/compile/backend_compile_duration' fires once per actual
     # XLA compilation (cache hits emit no event)
     if name.endswith("backend_compile_duration"):
-        _COMPILES += 1
-        _COMPILE_SECONDS += float(secs)
+        _COMPILES_TOTAL.inc()
+        _COMPILE_SECONDS_TOTAL.inc(float(secs))
+
+
+def _on_event(name: str, **_kw) -> None:
+    # '/jax/compilation_cache/cache_hits|cache_misses' fire per lookup of
+    # the persistent (disk) cache when one is enabled
+    if name.endswith("compilation_cache/cache_hits"):
+        _PCACHE_HITS.inc()
+    elif name.endswith("compilation_cache/cache_misses"):
+        _PCACHE_MISSES.inc()
 
 
 def install_compile_listener() -> None:
-    """Idempotently hook the process-wide compile counter into
-    ``jax.monitoring``. Cheap: one string check per monitoring event."""
+    """Idempotently hook the process-wide compile + persistent-cache
+    counters into ``jax.monitoring``. Cheap: one string check per
+    monitoring event."""
     global _LISTENER_INSTALLED
     if _LISTENER_INSTALLED:
         return
     import jax.monitoring
 
     jax.monitoring.register_event_duration_secs_listener(_on_event_duration)
+    jax.monitoring.register_event_listener(_on_event)
     _LISTENER_INSTALLED = True
 
 
 def compile_count() -> int:
-    return _COMPILES
+    return int(_COMPILES_TOTAL.value())
 
 
 def compile_snapshot() -> Dict[str, float]:
-    return {"compiles": _COMPILES, "compile_seconds": round(_COMPILE_SECONDS, 6)}
+    return {
+        "compiles": int(_COMPILES_TOTAL.value()),
+        "compile_seconds": round(_COMPILE_SECONDS_TOTAL.value(), 6),
+        "persistent_cache_hits": int(_PCACHE_HITS.value()),
+        "persistent_cache_misses": int(_PCACHE_MISSES.value()),
+    }
 
 
 def compile_delta(before: Dict[str, float]) -> Dict[str, float]:
@@ -236,6 +290,10 @@ def compile_delta(before: Dict[str, float]) -> Dict[str, float]:
         "compile_seconds": round(
             now["compile_seconds"] - before.get("compile_seconds", 0.0), 6
         ),
+        "persistent_cache_hits": now["persistent_cache_hits"]
+        - before.get("persistent_cache_hits", 0),
+        "persistent_cache_misses": now["persistent_cache_misses"]
+        - before.get("persistent_cache_misses", 0),
     }
 
 

@@ -85,11 +85,31 @@ def _mxu_tiled_max() -> int:
 
 
 # which MXU tier answered each dense-eligible count — bench.py reports the
-# per-rung tier so a perf run shows WHERE the FLOPs went
-MXU_TIER_COUNTS = {"dense": 0, "tiled": 0}
+# per-rung tier so a perf run shows WHERE the FLOPs went. Served by the
+# unified obs registry; these views keep the dict-shaped read path.
+from ...obs.metrics import REGISTRY as _OBS_REGISTRY  # noqa: E402
+from ...obs.metrics import CounterView  # noqa: E402
+
+MXU_TIER_COUNTS = CounterView(
+    _OBS_REGISTRY.counter(
+        "tpu_cypher_mxu_tier_total",
+        "dense-eligible counts answered per MXU tier",
+        labels=("tier",),
+    ),
+    "tier",
+    ("dense", "tiled"),
+)
 
 # which NATIVE (C++ stamping/DFS) kernels answered — same purpose
-NATIVE_TIER_COUNTS = {"two_hop": 0, "close": 0, "varlen": 0}
+NATIVE_TIER_COUNTS = CounterView(
+    _OBS_REGISTRY.counter(
+        "tpu_cypher_native_tier_total",
+        "counts answered per native C++ stamping/DFS kernel",
+        labels=("tier",),
+    ),
+    "tier",
+    ("two_hop", "close", "varlen"),
+)
 
 
 def _mxu_tiled_common(gi, ctx, hops):
@@ -815,7 +835,7 @@ class CsrExpandOp(_FusedExpandBase):
         pres = J.frontier_multiplicity(pos, present, n=npad) > 0
         m_b = _pad_mask(gi.label_mask(base.far_labels, ctx), npad)
         m_c = _pad_mask(gi.label_mask(final_hop.far_labels, ctx), npad)
-        MXU_TIER_COUNTS["dense"] += 1
+        MXU_TIER_COUNTS.inc("dense")
         return int(
             J.mxu_distinct_pairs(
                 a1, a2, pres, m_b, m_c, block=GraphIndex.DENSE_BLOCK
@@ -834,7 +854,7 @@ class CsrExpandOp(_FusedExpandBase):
             return None
         pos, present = gi.compact_of(id_col, ctx)
         pres = J.frontier_multiplicity(pos, present, n=t1.npad) > 0
-        MXU_TIER_COUNTS["tiled"] += 1
+        MXU_TIER_COUNTS.inc("tiled")
         return int(J.mxu_distinct_pairs_tiled(t1, t2, pres, m_b, m_c))
 
     def _native_two_hop(self, gi, ctx, hops, id_col, *, use_a, use_c):
@@ -859,7 +879,7 @@ class CsrExpandOp(_FusedExpandBase):
             None if m2 is None else np.asarray(m2),
         )
         if got is not None:
-            NATIVE_TIER_COUNTS["two_hop"] += 1
+            NATIVE_TIER_COUNTS.inc("two_hop")
         return got
 
     def _fused_table(self):
@@ -1168,7 +1188,7 @@ class CsrExpandIntoOp(_FusedExpandBase):
         mult = J.frontier_multiplicity(pos, present, n=npad)
         m_b = _pad_mask(gi.label_mask(base.far_labels, ctx), npad)
         m_c = _pad_mask(gi.label_mask(final_hop.far_labels, ctx), npad)
-        MXU_TIER_COUNTS["dense"] += 1
+        MXU_TIER_COUNTS.inc("dense")
         return int(
             J.mxu_close_count(
                 a1, a2, cm, mult, m_b, m_c, block=GraphIndex.DENSE_BLOCK
@@ -1187,7 +1207,7 @@ class CsrExpandIntoOp(_FusedExpandBase):
             return None
         pos, present = gi.compact_of(id_col, ctx)
         mult = J.frontier_multiplicity(pos, present, n=t1.npad)
-        MXU_TIER_COUNTS["tiled"] += 1
+        MXU_TIER_COUNTS.inc("tiled")
         return int(J.mxu_close_count_tiled(t1, t2, tc, mult, m_b, m_c))
 
     def _native_close_count(self, gi, ctx, hops, id_col, src_is_base):
@@ -1216,7 +1236,7 @@ class CsrExpandIntoOp(_FusedExpandBase):
             None if m2 is None else np.asarray(m2),
         )
         if got is not None:
-            NATIVE_TIER_COUNTS["close"] += 1
+            NATIVE_TIER_COUNTS.inc("close")
         return got
 
     def _fused_table(self):
@@ -1499,7 +1519,7 @@ class CsrVarExpandOp(_FusedExpandBase):
         )
         if got is None:
             return None
-        NATIVE_TIER_COUNTS["varlen"] += 1
+        NATIVE_TIER_COUNTS.inc("varlen")
         return total + got
 
     def _fused_table(self):

@@ -23,8 +23,10 @@ no two kernels agree on when they may run. This module is that policy:
 * **fault sites** — each launch passes through ``fault_point(site)``, so
   ``TPU_CYPHER_FAULTS=oom@kernel_join:1`` etc. drive the PR-2 ladder
   through the kernel tier with no TPU attached.
-* **use counters** — per-kernel pallas/fallback counts; bench.py records
-  which tier each rung actually used.
+* **use counters** — per-kernel pallas/fallback counts served by the
+  unified obs registry (``tpu_cypher_pallas_launch_total``); bench.py
+  records which tier each rung actually used, and each launch opens a
+  ``kernel:<name>`` trace span carrying the tier it resolved to.
 """
 
 from __future__ import annotations
@@ -33,6 +35,8 @@ import threading
 from dataclasses import dataclass
 from typing import Any, Callable, Dict, Optional, Tuple
 
+from ....obs import trace as _obs_trace
+from ....obs.metrics import REGISTRY as _REGISTRY
 from ....utils.config import ConfigOption
 
 try:  # pragma: no cover - availability depends on the jax build
@@ -65,19 +69,30 @@ class KernelSpec:
     impls: Tuple[str, ...]
 
 
-_REGISTRY: Dict[str, KernelSpec] = {}
+_KERNELS: Dict[str, KernelSpec] = {}
 _BROKEN: Dict[str, str] = {}  # "name" or "name/variant" -> repr(exc)
-_COUNTS: Dict[str, Dict[str, int]] = {}
 _LOCK = threading.Lock()
+
+# per-kernel launch counts, served by the unified obs registry
+# (docs/observability.md): tier="pallas" is a real kernel launch,
+# tier="fallback" is the jnp formulation answering instead
+PALLAS_LAUNCH = _REGISTRY.counter(
+    "tpu_cypher_pallas_launch_total",
+    "kernel dispatch outcomes per (kernel, tier=pallas|fallback)",
+    labels=("kernel", "tier"),
+)
 
 
 def register(name: str, site: str, impls: Tuple[str, ...]) -> None:
-    _REGISTRY[name] = KernelSpec(name, site, tuple(impls))
-    _COUNTS.setdefault(name, {"pallas": 0, "fallback": 0})
+    _KERNELS[name] = KernelSpec(name, site, tuple(impls))
+    # pre-seed both tiers at zero so use_counts()/Prometheus export show
+    # every registered kernel explicitly
+    PALLAS_LAUNCH.inc(0, kernel=name, tier="pallas")
+    PALLAS_LAUNCH.inc(0, kernel=name, tier="fallback")
 
 
 def registry() -> Dict[str, KernelSpec]:
-    return dict(_REGISTRY)
+    return dict(_KERNELS)
 
 
 def broken() -> Dict[str, str]:
@@ -99,24 +114,32 @@ def reset(name: Optional[str] = None) -> None:
     with _LOCK:
         if name is None:
             _BROKEN.clear()
-            for c in _COUNTS.values():
-                c["pallas"] = 0
-                c["fallback"] = 0
-            return
-        for key in [k for k in _BROKEN if k == name or k.startswith(name + "/")]:
-            del _BROKEN[key]
-        if name in _COUNTS:
-            _COUNTS[name] = {"pallas": 0, "fallback": 0}
+        else:
+            for key in [
+                k for k in _BROKEN if k == name or k.startswith(name + "/")
+            ]:
+                del _BROKEN[key]
+    if name is None:
+        PALLAS_LAUNCH.reset()
+    else:
+        PALLAS_LAUNCH.reset(kernel=name)
 
 
 def use_counts() -> Dict[str, Dict[str, int]]:
-    with _LOCK:
-        return {k: dict(v) for k, v in _COUNTS.items()}
+    """{kernel: {"pallas": n, "fallback": n}} — a view over the registry
+    series (every registered kernel present, zeros explicit)."""
+    out: Dict[str, Dict[str, int]] = {
+        name: {"pallas": 0, "fallback": 0} for name in _KERNELS
+    }
+    for lbl, v in PALLAS_LAUNCH.items():
+        out.setdefault(lbl["kernel"], {"pallas": 0, "fallback": 0})[
+            lbl["tier"]
+        ] = int(v)
+    return out
 
 
 def _count(name: str, which: str) -> None:
-    with _LOCK:
-        _COUNTS.setdefault(name, {"pallas": 0, "fallback": 0})[which] += 1
+    PALLAS_LAUNCH.inc(kernel=name, tier=which)
 
 
 def launch(
@@ -145,7 +168,7 @@ def launch(
     the ladder, not masquerade as a lowering problem), then memoized
     broken-once and the jnp formulation takes over.
     """
-    spec = _REGISTRY[name]
+    spec = _KERNELS[name]
     m = mode()
     key = f"{name}/{variant}" if variant else name
     active = (
@@ -160,30 +183,37 @@ def launch(
             )
         )
     )
-    if not active:
-        _count(name, "fallback")
-        return fallback_fn()
-    interp = force_interpret or m == "interpret" or not _backend_is_tpu()
-    from ....runtime.faults import fault_point
+    with _obs_trace.span(f"kernel:{name}", kind="kernel") as sp:
+        if not active:
+            sp.note("tier", "fallback")
+            _count(name, "fallback")
+            return fallback_fn()
+        interp = force_interpret or m == "interpret" or not _backend_is_tpu()
+        from ....runtime.faults import fault_point
 
-    fault_point(spec.site)
-    try:
-        out = pallas_fn(interpret=interp)
-    except Exception as exc:
-        from ....errors import reraise_if_device
+        fault_point(spec.site)
+        try:
+            out = pallas_fn(interpret=interp)
+        except Exception as exc:
+            from ....errors import reraise_if_device
 
-        reraise_if_device(exc, site=spec.site)
-        if interp:
-            raise
-        with _LOCK:
-            _BROKEN[key] = repr(exc)
-        _count(name, "fallback")
-        return fallback_fn()
-    if out is None:  # kernel declined post-eligibility (build didn't fit)
-        _count(name, "fallback")
-        return fallback_fn()
-    _count(name, "pallas")
-    return out
+            reraise_if_device(exc, site=spec.site)
+            if interp:
+                raise
+            with _LOCK:
+                _BROKEN[key] = repr(exc)
+            sp.note("tier", "fallback")
+            sp.note("broken", True)
+            _count(name, "fallback")
+            return fallback_fn()
+        if out is None:  # kernel declined post-eligibility (build didn't fit)
+            sp.note("tier", "fallback")
+            sp.note("declined", True)
+            _count(name, "fallback")
+            return fallback_fn()
+        sp.note("tier", "pallas" if not interp else "pallas-interpret")
+        _count(name, "pallas")
+        return out
 
 
 def _backend_is_tpu() -> bool:

@@ -35,8 +35,7 @@ backend per expression, keeping full Cypher semantics."""
 
 from __future__ import annotations
 
-import contextvars
-from typing import Any, Dict, Iterator, List, Optional, Sequence, Tuple
+from typing import Any, Dict, Iterator, List, Mapping, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -68,56 +67,86 @@ from .column import (
 from .compiler import TpuEvaluator, TpuUnsupportedExpr
 
 
+from ...obs.metrics import REGISTRY as _OBS_REGISTRY
+
+_FALLBACKS = _OBS_REGISTRY.counter(
+    "tpu_cypher_fallbacks_total",
+    "local-oracle fallbacks / host islands by reason",
+    labels=("reason",),
+)
+
+
 class _FallbackCounter:
     """Counts local-oracle fallbacks so host-bound regressions are visible
     (VERDICT r1 asked for a per-query fallback rate on the acceptance suite).
 
-    Two tiers: a process-global AGGREGATE (``snapshot``/``reset`` — the TCK
-    corpus gate in tests/test_fallback_telemetry.py reads this) and
-    CONTEXT-LOCAL scopes (``scope``) for per-result attribution — scopes
-    ride a ``contextvars`` stack, so concurrent/interleaved queries
-    (threads, asyncio, nested view execution) can never cross-pollute each
-    other's ``result.fallbacks``."""
-
-    def __init__(self):
-        self.total = 0
-        self.by_reason: Dict[str, int] = {}
-        self._scopes: contextvars.ContextVar[Tuple[Dict[str, int], ...]] = (
-            contextvars.ContextVar("tpu_cypher_fallback_scopes", default=())
-        )
+    Served by the unified obs registry (``tpu_cypher_fallbacks_total``),
+    keeping both legacy tiers of the read path: the process-global
+    AGGREGATE (``snapshot``/``reset`` — the TCK corpus gate in
+    tests/test_fallback_telemetry.py reads this) and CONTEXT-LOCAL scopes
+    (``scope``) for per-result attribution — the registry's scopes ride a
+    ``contextvars`` stack, so concurrent/interleaved queries (threads,
+    asyncio, nested view execution) can never cross-pollute each other's
+    ``result.fallbacks``."""
 
     def record(self, reason: str) -> None:
-        self.total += 1
-        self.by_reason[reason] = self.by_reason.get(reason, 0) + 1
-        for scope in self._scopes.get():
-            scope[reason] = scope.get(reason, 0) + 1
+        _FALLBACKS.inc(reason=reason)
+
+    @property
+    def total(self) -> int:
+        return sum(self.snapshot().values())
 
     def reset(self) -> None:
-        self.total = 0
-        self.by_reason = {}
+        _FALLBACKS.reset()
 
     def snapshot(self) -> Dict[str, int]:
-        return dict(self.by_reason)
+        return {
+            lbl["reason"]: int(v)
+            for lbl, v in _FALLBACKS.items()
+            if int(v) > 0
+        }
 
     def scope(self) -> "_FallbackScope":
-        """``with FALLBACK_COUNTER.scope() as events:`` — ``events`` fills
-        with only the fallbacks recorded in THIS context while the scope is
-        open (nested scopes each see their own copy)."""
-        return _FallbackScope(self._scopes)
+        """``with FALLBACK_COUNTER.scope() as events:`` — ``events`` is a
+        mapping that fills with only the fallbacks recorded in THIS context
+        while the scope is open (nested scopes each see their own copy),
+        readable during and after the block."""
+        return _FallbackScope()
 
 
-class _FallbackScope:
-    def __init__(self, var):
-        self._var = var
-        self.events: Dict[str, int] = {}
-        self._token = None
+class _FallbackScope(Mapping):
+    """Mapping view (reason -> count) over a registry scope, restricted to
+    the fallback counter."""
 
-    def __enter__(self) -> Dict[str, int]:
-        self._token = self._var.set(self._var.get() + (self.events,))
-        return self.events
+    def __init__(self):
+        self._scope = _OBS_REGISTRY.scope()
+
+    def __enter__(self) -> "_FallbackScope":
+        self._scope.__enter__()
+        return self
 
     def __exit__(self, *exc) -> None:
-        self._var.reset(self._token)
+        self._scope.__exit__(*exc)
+
+    def _events(self) -> Dict[str, int]:
+        return {
+            k: int(v)
+            for k, v in self._scope.label_counts(
+                "tpu_cypher_fallbacks_total", "reason"
+            ).items()
+        }
+
+    def __getitem__(self, key: str) -> int:
+        return self._events()[key]
+
+    def __iter__(self):
+        return iter(self._events())
+
+    def __len__(self) -> int:
+        return len(self._events())
+
+    def __repr__(self) -> str:
+        return f"_FallbackScope({self._events()!r})"
 
 
 FALLBACK_COUNTER = _FallbackCounter()

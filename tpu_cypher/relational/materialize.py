@@ -11,12 +11,23 @@ from typing import Any, Callable, Dict
 from ..api import types as T
 from ..api.values import Node, Path, Relationship
 from ..ir import expr as E
+from ..obs.metrics import REGISTRY
 from .header import RecordHeader
 
 RowFn = Callable[[Dict[str, Any]], Any]
 
+# element-materializer builds by kind — counted at FACTORY time (once per
+# result column), never per row: the row closures stay uninstrumented so
+# collect() hot loops pay nothing
+MATERIALIZERS_BUILT = REGISTRY.counter(
+    "tpu_cypher_materializers_built_total",
+    "element materializers built per kind (node/relationship/path)",
+    labels=("kind",),
+)
+
 
 def node_materializer(header: RecordHeader, var: E.Var) -> RowFn:
+    MATERIALIZERS_BUILT.inc(kind="node")
     id_col = header.column(header.id_expr(var))
     label_cols = [(e.label, header.column(e)) for e in header.labels_for(var)]
     prop_cols = [(e.key, header.column(e)) for e in header.properties_for(var)]
@@ -35,6 +46,7 @@ def node_materializer(header: RecordHeader, var: E.Var) -> RowFn:
 
 
 def relationship_materializer(header: RecordHeader, var: E.Var) -> RowFn:
+    MATERIALIZERS_BUILT.inc(kind="relationship")
     id_col = header.column(header.id_expr(var))
     start_col = header.column(
         next(e for e in header.expressions_for(var) if isinstance(e, E.StartNode))
@@ -70,6 +82,7 @@ def path_materializer(header: RecordHeader, var: E.Var) -> RowFn:
     values, spliced inline. A zero-length segment contributes no relationship,
     so the adjacent node appears twice — collapsed below. A null first node
     (e.g. unmatched OPTIONAL MATCH) makes the whole path null."""
+    MATERIALIZERS_BUILT.inc(kind="path")
     from .header import path_nodes_companion
 
     makers = []

@@ -19,6 +19,7 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 from ..api import types as T
 from ..api.table import Table
 from ..ir import expr as E
+from ..obs import trace as _obs_trace
 from .header import RecordHeader
 
 
@@ -53,7 +54,13 @@ class RelationalOperator:
     @property
     def table(self) -> Table:
         if self._table is None:
-            t = self._compute_table()
+            # every first pull is an operator span in the query's trace
+            # tree (obs.trace); children pulled inside _compute_table nest
+            # naturally. Memoized re-reads stay span-free — they do no
+            # work. HOST wall time only: under JAX async dispatch this is
+            # dispatch cost, never an added device sync.
+            with _obs_trace.span(type(self).__name__, kind="operator"):
+                t = self._compute_table()
             cols = set(t.physical_columns)
             need = set(self.header.columns)
             if need - cols:

@@ -13,6 +13,7 @@ from typing import Any, Dict, List, Optional, Sequence
 from ..api import types as T
 from ..api.values import CypherMap, Node, Relationship
 from ..ir import expr as E
+from ..obs import trace as OT
 from .header import RecordHeader
 
 
@@ -23,6 +24,10 @@ class RelationalCypherRecords:
         if columns is None:
             columns = [v.name for v in header.vars if not v.name.startswith("__")]
         self.columns = list(columns)
+        # the owning query's span tree (set by CypherResult.records):
+        # collect() re-enters it so device->host materialization — where
+        # async dispatch drains — is attributed to the query
+        self._trace: Optional[OT.QueryTrace] = None
 
     @property
     def size(self) -> int:
@@ -52,8 +57,18 @@ class RelationalCypherRecords:
         return out
 
     def collect(self) -> List[CypherMap]:
-        mats = self._materializers()
-        return [CypherMap((n, f(r)) for n, f in mats) for r in self.table.rows()]
+        if self._trace is None:
+            mats = self._materializers()
+            return [CypherMap((n, f(r)) for n, f in mats) for r in self.table.rows()]
+        with OT.activate(self._trace):
+            with OT.span("collect", kind="phase") as sp:
+                mats = self._materializers()
+                out = [
+                    CypherMap((n, f(r)) for n, f in mats)
+                    for r in self.table.rows()
+                ]
+                sp.note("rows", len(out))
+        return out
 
     def to_bag(self):
         from ..testing.bag import Bag

@@ -25,7 +25,8 @@ from ..ir import blocks as B
 from ..ir.builder import IRBuildError, IRBuilderContext, build_ir
 from ..logical.optimizer import optimize as optimize_logical
 from ..logical.planner import LogicalPlannerContext, plan_logical
-from ..utils.measurement import time_stage
+from ..obs import metrics as OM
+from ..obs import trace as OT
 from .graphs import (
     ElementTable,
     EmptyGraph,
@@ -173,10 +174,15 @@ class CypherResult:
         # materialized (jit/persistent-cache hits count zero — the
         # compiled-once/run-many regression signal next to ``fallbacks``)
         self.compile_stats: Optional[Dict[str, float]] = None
-        # one entry per execution attempt: {"rung", "ok", "seconds", and on
-        # failure "error" (typed class name) + "site"} — the per-result
-        # robustness telemetry next to ``fallbacks``/``compile_stats``
+        # one entry per execution attempt: {"rung", "ok", "seconds",
+        # "duration_ms", and on failure "error" (typed class name) +
+        # "site" + "span_id" (the failing operator's span in the trace
+        # tree)} — the per-result robustness telemetry next to
+        # ``fallbacks``/``compile_stats``
         self.execution_log: List[Dict[str, Any]] = []
+        # the per-query span tree (obs.trace), grown across the pipeline
+        # phases and the execution ladder; surfaced via ``profile()``
+        self._trace: Optional[OT.QueryTrace] = None
 
     @property
     def records(self) -> Optional[RelationalCypherRecords]:
@@ -185,7 +191,25 @@ class CypherResult:
         if self.relational_plan is None:
             return None
         self._records = self._execute_ladder()
+        # collect() re-enters the trace so row materialization shows up
+        # as a span of THIS query
+        self._records._trace = self._trace
         return self._records
+
+    def profile(self, execute: bool = True) -> OT.QueryProfile:
+        """The ``PROFILE``-style sibling of the ``EXPLAIN``-style
+        ``plans``: the query's span tree (phases, relational operators,
+        kernel launches, pad ratios, ladder rungs) as a rendered tree +
+        JSON (``docs/observability.md``). Executes the query first unless
+        ``execute=False`` (an unexecuted result profiles only its
+        planning phases)."""
+        if execute and self.relational_plan is not None:
+            _ = self.records
+        trace = self._trace
+        if trace is None:
+            # catalog statements / internal results carry no trace
+            trace = OT.QueryTrace("query")
+        return OT.QueryProfile(trace)
 
     # -- the degrade-and-retry ladder -----------------------------------
 
@@ -217,50 +241,97 @@ class CypherResult:
                 rungs.append(G.RUNG_HOST)
 
         plan = self.relational_plan
+        if self._trace is None:
+            self._trace = OT.QueryTrace("query")
+        trace = self._trace
         last_typed: Optional[ERR.ExecutionFault] = None
-        for i, rung in enumerate(rungs):
-            t0 = _time.perf_counter()
-            entry: Dict[str, Any] = {"rung": rung}
-            try:
-                with G.activate(rung, deadline_at=deadline_at):
-                    if rung == G.RUNG_HOST:
-                        recs = self._host_records()
-                    else:
-                        if i > 0:
-                            # fresh lazy-table slots: the failed attempt
-                            # may have memoized poisoned intermediates
-                            plan = session._clone_plan(
-                                self.relational_plan,
-                                dict(self._parameters()),
-                            )
-                        recs = self._materialize_attempt(
-                            plan, exact=rung != G.RUNG_DEVICE
-                        )
-                entry["ok"] = True
-                entry["seconds"] = round(_time.perf_counter() - t0, 6)
-                self.execution_log.append(entry)
-                return recs
-            except Exception as exc:  # classified below; see errors.py
-                typed = ERR.classify(exc)
-                if typed is None:
-                    if last_typed is not None:
-                        # a degraded rung broke for a NON-fault reason
-                        # (e.g. the host rung cannot see catalog graphs):
-                        # surface the original device fault, not the
-                        # rung's own plumbing error
-                        raise last_typed from exc
-                    raise
-                entry["ok"] = False
-                entry["error"] = type(typed).__name__
-                entry["site"] = typed.site
-                entry["seconds"] = round(_time.perf_counter() - t0, 6)
-                self.execution_log.append(entry)
-                last_typed = typed
-                if not typed.retryable or rung == rungs[-1]:
-                    if typed is exc:
+        # per-query metric deltas ride the JSON-lines event when the sink
+        # is configured; otherwise skip the scope entirely
+        import contextlib as _ctl
+
+        scope = OM.REGISTRY.scope() if OM.sink_configured() else None
+        with _ctl.ExitStack() as outer:
+            outer.enter_context(OT.activate(trace))
+            if scope is not None:
+                outer.enter_context(scope)
+            for i, rung in enumerate(rungs):
+                t0 = _time.perf_counter()
+                entry: Dict[str, Any] = {"rung": rung}
+                trace.failed_span_id = None
+                try:
+                    with OT.span("execute", kind="phase", rung=rung):
+                        with G.activate(rung, deadline_at=deadline_at):
+                            if rung == G.RUNG_HOST:
+                                recs = self._host_records()
+                            else:
+                                if i > 0:
+                                    # fresh lazy-table slots: the failed
+                                    # attempt may have memoized poisoned
+                                    # intermediates
+                                    plan = session._clone_plan(
+                                        self.relational_plan,
+                                        dict(self._parameters()),
+                                    )
+                                recs = self._materialize_attempt(
+                                    plan, exact=rung != G.RUNG_DEVICE
+                                )
+                    dt = _time.perf_counter() - t0
+                    entry["ok"] = True
+                    entry["seconds"] = round(dt, 6)
+                    entry["duration_ms"] = round(dt * 1000, 3)
+                    self.execution_log.append(entry)
+                    self._emit_query_event(True, scope)
+                    return recs
+                except Exception as exc:  # classified below; see errors.py
+                    typed = ERR.classify(exc)
+                    if typed is None:
+                        if last_typed is not None:
+                            # a degraded rung broke for a NON-fault reason
+                            # (e.g. the host rung cannot see catalog
+                            # graphs): surface the original device fault,
+                            # not the rung's own plumbing error
+                            raise last_typed from exc
                         raise
-                    raise typed from exc
+                    dt = _time.perf_counter() - t0
+                    entry["ok"] = False
+                    entry["error"] = type(typed).__name__
+                    entry["site"] = typed.site
+                    entry["seconds"] = round(dt, 6)
+                    entry["duration_ms"] = round(dt * 1000, 3)
+                    if trace.failed_span_id is not None:
+                        # the deepest span open when the fault surfaced —
+                        # the failing operator, attributable in the trace
+                        entry["span_id"] = trace.failed_span_id
+                    self.execution_log.append(entry)
+                    last_typed = typed
+                    if not typed.retryable or rung == rungs[-1]:
+                        self._emit_query_event(False, scope)
+                        if typed is exc:
+                            raise
+                        raise typed from exc
         raise last_typed  # pragma: no cover - loop always returns/raises
+
+    def _emit_query_event(self, ok: bool, scope) -> None:
+        """One schema-versioned JSON line per finished query to the
+        ``TPU_CYPHER_METRICS_FILE`` sink: phase timings, the execution
+        log, compile stats, and the metric deltas scoped to this query."""
+        if not OM.sink_configured():
+            return
+        trace = self._trace
+        OM.write_event(
+            {
+                "event": "query",
+                "ok": ok,
+                "total_seconds": round(trace.total_seconds, 6),
+                "phases": {
+                    k: round(v, 6) for k, v in trace.phase_seconds().items()
+                },
+                "execution_log": self.execution_log,
+                "compile_stats": self.compile_stats,
+                "fallbacks": self.fallbacks,
+                "metrics": scope.snapshot() if scope is not None else {},
+            }
+        )
 
     def _parameters(self) -> Dict[str, Any]:
         if self._source is not None:
@@ -317,7 +388,12 @@ class CypherResult:
         if recs is None:
             raise CatalogError("host-oracle rung produced no records")
         if self.compile_stats is None:
-            self.compile_stats = {"compiles": 0, "compile_seconds": 0.0}
+            self.compile_stats = {
+                "compiles": 0,
+                "compile_seconds": 0.0,
+                "persistent_cache_hits": 0,
+                "persistent_cache_misses": 0,
+            }
         if self.fallbacks is None and getattr(
             self.session, "record_fallbacks", False
         ):
@@ -514,6 +590,16 @@ class CypherSession:
         conv = _graph_to_local(g)
         cache[id(g)] = (g, conv)
         return PropertyGraph(host, conv)
+
+    # -- observability -----------------------------------------------------
+
+    def metrics_text(self) -> str:
+        """Prometheus text exposition of the unified metrics registry
+        (compiles, fallbacks, kernel tiers, fault sites, ladder rungs,
+        stage timings — the metric names table is in
+        ``docs/observability.md``). Scrape-ready: serve it from any HTTP
+        handler."""
+        return OM.REGISTRY.prometheus_text()
 
     # -- prewarm -----------------------------------------------------------
 
@@ -890,71 +976,86 @@ class CypherSession:
                     self, logical,
                     self._clone_plan(relational, parameters), returns,
                 )
+                # a plan-cache hit skips every planning phase: its trace
+                # starts empty and says so
+                result._trace = OT.QueryTrace("query", plan_cache="hit")
                 result._source = (query, parameters, graph, driving_table)
                 return result
+        trace = OT.QueryTrace(
+            "query", plan_cache="miss" if cache_key is not None else "bypass"
+        )
         ambient = graph._graph if graph is not None else EmptyGraph()
         ambient_qgn = f"{AMBIENT_NS}.q{next(self._counter)}"
         self._catalog[ambient_qgn] = ambient  # mountAmbientGraph (reference :117)
 
-        stmt = time_stage("parse", parse_cypher, query)
-        stmt = self._expand_views(stmt, parameters)
+        with OT.activate(trace):
+            with OT.span("parse", kind="phase"):
+                stmt = parse_cypher(query)
+            stmt = self._expand_views(stmt, parameters)
 
-        input_fields: Dict[str, T.CypherType] = {}
-        driving_header = None
-        if driving_table is not None:
-            if not isinstance(driving_table, self.table_cls):
-                # coerce a foreign-backend driving table into this session's
-                # table type (columnwise; the reference instead requires the
-                # backend's own table type at the API boundary)
-                driving_table = self.table_cls.from_columns(
-                    {
-                        c: driving_table.column_values(c)
-                        for c in driving_table.physical_columns
-                    }
-                )
-            driving_header = RecordHeader()
-            from ..ir import expr as E
+            input_fields: Dict[str, T.CypherType] = {}
+            driving_header = None
+            if driving_table is not None:
+                if not isinstance(driving_table, self.table_cls):
+                    # coerce a foreign-backend driving table into this
+                    # session's table type (columnwise; the reference
+                    # instead requires the backend's own table type at the
+                    # API boundary)
+                    driving_table = self.table_cls.from_columns(
+                        {
+                            c: driving_table.column_values(c)
+                            for c in driving_table.physical_columns
+                        }
+                    )
+                driving_header = RecordHeader()
+                from ..ir import expr as E
 
-            for col in driving_table.physical_columns:
-                t = driving_table.column_type(col)
-                input_fields[col] = t
-                driving_header = driving_header.with_expr(E.Var(col).with_type(t), col)
+                for col in driving_table.physical_columns:
+                    t = driving_table.column_type(col)
+                    input_fields[col] = t
+                    driving_header = driving_header.with_expr(
+                        E.Var(col).with_type(t), col
+                    )
 
-        schemas = self._catalog_schemas()
-        ir_ctx = IRBuilderContext(
-            schema=ambient.schema,
-            parameters=parameters,
-            catalog_schemas=schemas,
-            working_graph=ambient_qgn,
-            input_fields=input_fields,
-        )
-        ir = time_stage("ir", build_ir, stmt, ir_ctx)
+            schemas = self._catalog_schemas()
+            ir_ctx = IRBuilderContext(
+                schema=ambient.schema,
+                parameters=parameters,
+                catalog_schemas=schemas,
+                working_graph=ambient_qgn,
+                input_fields=input_fields,
+            )
+            with OT.span("ir", kind="phase"):
+                ir = build_ir(stmt, ir_ctx)
 
-        # catalog statements
-        if isinstance(ir, B.CreateGraphIR):
-            inner = self._plan_and_run(ir.inner, parameters, input_fields, driving_table, driving_header, ambient_qgn, schemas)
-            result_graph = inner.graph
-            if result_graph is None:
-                raise CatalogError("CREATE GRAPH inner query must return a graph")
-            self.store_graph(ir.qgn, result_graph)
-            return CypherResult(self, None, None, None, graph=result_graph)
-        if isinstance(ir, B.CreateViewIR):
-            self._views[ir.name] = (ir.params, ir.inner_text)
-            return CypherResult(self, None, None, None)
-        if isinstance(ir, B.DropGraphIR):
-            if ir.view:
-                self._views.pop(ir.qgn, None)
-                for key in [k for k in self._view_cache if k[0] == ir.qgn]:
-                    _, vq = self._view_cache.pop(key)
-                    self._catalog.pop(vq, None)
-            else:
-                self.drop_graph(ir.qgn)
-            return CypherResult(self, None, None, None)
+            # catalog statements
+            if isinstance(ir, B.CreateGraphIR):
+                inner = self._plan_and_run(ir.inner, parameters, input_fields, driving_table, driving_header, ambient_qgn, schemas)
+                result_graph = inner.graph
+                if result_graph is None:
+                    raise CatalogError("CREATE GRAPH inner query must return a graph")
+                self.store_graph(ir.qgn, result_graph)
+                result = CypherResult(self, None, None, None, graph=result_graph)
+                result._trace = trace
+                return result
+            if isinstance(ir, B.CreateViewIR):
+                self._views[ir.name] = (ir.params, ir.inner_text)
+                return CypherResult(self, None, None, None)
+            if isinstance(ir, B.DropGraphIR):
+                if ir.view:
+                    self._views.pop(ir.qgn, None)
+                    for key in [k for k in self._view_cache if k[0] == ir.qgn]:
+                        _, vq = self._view_cache.pop(key)
+                        self._catalog.pop(vq, None)
+                else:
+                    self.drop_graph(ir.qgn)
+                return CypherResult(self, None, None, None)
 
-        result = self._plan_and_run(
-            ir, parameters, input_fields, driving_table, driving_header,
-            ambient_qgn, schemas,
-        )
+            result = self._plan_and_run(
+                ir, parameters, input_fields, driving_table, driving_header,
+                ambient_qgn, schemas,
+            )
+        result._trace = trace
         result._source = (query, parameters, graph, driving_table)
         if cache_key is not None and result.relational_plan is not None:
             while len(self._plan_cache) >= self._PLAN_CACHE_MAX:
@@ -974,26 +1075,29 @@ class CypherSession:
         schemas=None,
     ) -> CypherResult:
         lctx = LogicalPlannerContext(ambient_qgn, tuple(input_fields.items()))
-        logical = time_stage("logical", plan_logical, ir, lctx)
-        logical = time_stage(
-            "logical_opt",
-            optimize_logical,
-            logical,
-            self._catalog[ambient_qgn].schema,
-            schemas if schemas is not None else self._catalog_schemas(),
-            ambient_qgn,
-            self._graph_patterns(),
-        )
+        with OT.span("logical", kind="phase"):
+            logical = plan_logical(ir, lctx)
+        with OT.span("logical_opt", kind="phase"):
+            logical = optimize_logical(
+                logical,
+                self._catalog[ambient_qgn].schema,
+                schemas if schemas is not None else self._catalog_schemas(),
+                ambient_qgn,
+                self._graph_patterns(),
+            )
         rctx = self._runtime_context(parameters)
-        relational = time_stage(
-            "relational", plan_relational, logical, rctx, driving_table, driving_header
-        )
+        with OT.span("relational", kind="phase"):
+            relational = plan_relational(
+                logical, rctx, driving_table, driving_header
+            )
         if getattr(self.table_cls, "plan_expand_fastpath", None) is not None:
             from .prune import prune_fused_columns
 
-            relational = time_stage("prune", prune_fused_columns, relational)
+            with OT.span("prune", kind="phase"):
+                relational = prune_fused_columns(relational)
         from .cse import share_common_subplans
 
-        relational = time_stage("cse", share_common_subplans, relational)
+        with OT.span("cse", kind="phase"):
+            relational = share_common_subplans(relational)
         returns = getattr(ir, "returns", None)
         return CypherResult(self, logical, relational, returns)

@@ -44,8 +44,22 @@ import threading
 from typing import Dict, List, Optional, Tuple
 
 from ..errors import QueryTimeout
+from ..obs import trace as _obs_trace
+from ..obs.metrics import REGISTRY as _REGISTRY
 
 ENV = "TPU_CYPHER_FAULTS"
+
+# per-site invocation counts, served by the unified obs registry — sites
+# are exactly the engine's device sync points, so this series doubles as
+# dispatch-boundary telemetry (docs/observability.md). The occurrence-
+# window logic below keys off the same counter (inc-and-get is atomic),
+# which is why ``set_spec``/``reset_counters`` reset it: a fresh spec
+# means a fresh deterministic schedule.
+FAULT_SITE_HITS = _REGISTRY.counter(
+    "tpu_cypher_fault_site_hits_total",
+    "invocations of each named fault site (join/expand/kernel_*/...)",
+    labels=("site",),
+)
 
 
 class InjectedFault(RuntimeError):
@@ -69,7 +83,6 @@ _KIND_MESSAGES = {
 _INF = 1 << 62
 
 _lock = threading.Lock()
-_counters: Dict[str, int] = {}
 # parsed spec cache, keyed by the raw env/override string
 _parse_cache: Tuple[Optional[str], Dict[str, List[Tuple[str, int, int]]]] = (
     None,
@@ -122,18 +135,21 @@ def set_spec(text: Optional[str]) -> None:
     global _override
     with _lock:
         _override = text
-        _counters.clear()
+    FAULT_SITE_HITS.reset()
 
 
 def reset_counters() -> None:
-    with _lock:
-        _counters.clear()
+    FAULT_SITE_HITS.reset()
 
 
 def counters() -> Dict[str, int]:
-    """Snapshot of per-site invocation counts (diagnostics/tests)."""
-    with _lock:
-        return dict(_counters)
+    """Snapshot of per-site invocation counts (diagnostics/tests) — a view
+    over the registry series; zero-hit sites are omitted."""
+    return {
+        lbl["site"]: int(v)
+        for lbl, v in FAULT_SITE_HITS.items()
+        if int(v) > 0
+    }
 
 
 def _active_spec() -> Dict[str, List[Tuple[str, int, int]]]:
@@ -150,23 +166,22 @@ def _active_spec() -> Dict[str, List[Tuple[str, int, int]]]:
 
 
 def fault_point(site: str) -> None:
-    """Named fault site. No-op (one env read) unless a spec targets this
-    site; otherwise counts the invocation and raises when a spec's
-    occurrence window covers it. Also checks the active query deadline
-    (``runtime.guard``) — sites are exactly the points where a long device
-    query can be interrupted between dispatches."""
+    """Named fault site. Counts the invocation in the unified registry,
+    stamps the site on the enclosing trace span (sites are exactly the
+    device sync points between dispatches), checks the active query
+    deadline (``runtime.guard``), and raises when an active spec's
+    occurrence window covers this invocation."""
     from . import guard as G
 
     G.check_deadline(site)
+    n = int(FAULT_SITE_HITS.inc(site=site))
+    _obs_trace.note_site(site)
     spec = _active_spec()
     if not spec:
         return
     rules = spec.get(site)
     if not rules:
         return
-    with _lock:
-        n = _counters.get(site, 0) + 1
-        _counters[site] = n
     for kind, lo, hi in rules:
         if lo <= n <= hi:
             if kind == "timeout":

@@ -27,6 +27,7 @@ import time
 from typing import Optional
 
 from ..errors import QueryTimeout
+from ..obs.metrics import REGISTRY as _REGISTRY
 from ..utils.config import ConfigOption
 
 # ladder rungs, in degradation order (docs/robustness.md)
@@ -47,16 +48,25 @@ CHUNK_ROWS = ConfigOption("TPU_CYPHER_CHUNK_ROWS", 65536, int)
 # 0 = no deadline; session option overrides the env
 DEADLINE_S = ConfigOption("TPU_CYPHER_QUERY_DEADLINE_S", 0.0, float)
 
+# which ladder rungs actually executed, fleet-wide (the per-query view is
+# the ``execute`` trace span's ``rung`` attr and ``result.execution_log``)
+LADDER_ACTIVATIONS = _REGISTRY.counter(
+    "tpu_cypher_ladder_activations_total",
+    "execution-guard activations per ladder rung",
+    labels=("rung",),
+)
+
 
 class ExecutionGuard:
-    """State for ONE query execution attempt (one ladder rung)."""
+    """State for ONE query execution attempt (one ladder rung). Per-site
+    tracing rides the obs span tree (``obs.trace.note_site``), not the
+    guard."""
 
-    __slots__ = ("deadline_at", "rung", "site_log")
+    __slots__ = ("deadline_at", "rung")
 
     def __init__(self, deadline_at: Optional[float], rung: str):
         self.deadline_at = deadline_at
         self.rung = rung
-        self.site_log = None  # reserved for per-site tracing
 
     def check(self, site: str) -> None:
         if self.deadline_at is not None and time.monotonic() > self.deadline_at:
@@ -117,6 +127,7 @@ class activate:
         self._token = None
 
     def __enter__(self) -> ExecutionGuard:
+        LADDER_ACTIVATIONS.inc(rung=self._guard.rung)
         self._token = _CURRENT.set(self._guard)
         return self._guard
 

@@ -1,28 +1,19 @@
-"""Stage timing (reference ``Measurement.scala:36-56`` + ``PrintTimings`` flag)."""
+"""Deprecated shim: stage timing moved into ``tpu_cypher.obs.metrics``.
+
+The ``time_stage``/``last_timings``/``clear_timings`` trio (reference
+``Measurement.scala:36-56`` + ``PrintTimings``) now lives in the unified
+metrics registry, where each stage observation also lands in the
+``tpu_cypher_stage_seconds`` histogram (p50/p95/max per stage). Import from
+``tpu_cypher.obs.metrics`` instead."""
 
 from __future__ import annotations
 
-import time
-from typing import Callable, Dict, List, Tuple
+import warnings
 
-from .config import PRINT_TIMINGS
+from ..obs.metrics import clear_timings, last_timings, time_stage  # noqa: F401
 
-_TIMINGS: List[Tuple[str, float]] = []
-
-
-def time_stage(name: str, fn: Callable, *args, **kw):
-    t0 = time.perf_counter()
-    out = fn(*args, **kw)
-    dt = time.perf_counter() - t0
-    _TIMINGS.append((name, dt))
-    if PRINT_TIMINGS.get():
-        print(f"[timing] {name}: {dt * 1000:.2f} ms")
-    return out
-
-
-def last_timings() -> Dict[str, float]:
-    return dict(_TIMINGS[-16:])
-
-
-def clear_timings():
-    _TIMINGS.clear()
+warnings.warn(
+    "tpu_cypher.utils.measurement is deprecated; use tpu_cypher.obs.metrics",
+    DeprecationWarning,
+    stacklevel=2,
+)

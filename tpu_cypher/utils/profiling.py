@@ -5,7 +5,11 @@ The reference delegates engine-level profiling to Spark UI /
 equivalents are the XLA profiler (TensorBoard-compatible traces) and the
 compiled HLO of the jitted kernels. Gated by ``TPU_CYPHER_PROFILE_DIR``:
 when set, ``CypherSession.cypher`` executions are wrapped in a profiler
-trace automatically.
+trace automatically, AND the ``obs.trace`` span tree uses this module as
+its device-trace backend — every engine span opens a matching
+``jax.profiler.TraceAnnotation``, so the phase/operator/kernel tree shows
+up region-named inside the TensorBoard/Perfetto timeline
+(``docs/observability.md``).
 """
 
 from __future__ import annotations
