@@ -241,6 +241,17 @@ def _metrics_snapshot():
         return {"error": str(exc)[:200]}
 
 
+def _lint_clean() -> bool:
+    """Static-analyzer verdict for the engine tree this rung ran
+    (``python -m tpu_cypher.analysis tpu_cypher/``): the trajectory records
+    analyzer health next to the perf numbers, so an invariant regression
+    (host-sync, recompile hazard, pad discipline...) shows up in the same
+    JSON line as the BENCH delta it will eventually cause. Never raises."""
+    from tpu_cypher.analysis import engine_is_clean
+
+    return engine_is_clean()
+
+
 def _time_query(g, query, params=None, repeats=3):
     """Median wall time of a warmed query (warmup compiles + builds CSR)
     plus WHICH tier answered (MXU dense/tiled, native C++, or the device
@@ -516,6 +527,9 @@ def main():
         "ladder": results["ladder"],
         "pallas_vs_xla": pallas_entry,
         "metrics": _metrics_snapshot(),
+        # analyzer health rides the trajectory: False here means a rung ran
+        # with unsuppressed invariant violations (tpu_cypher.analysis)
+        "lint_clean": _lint_clean(),
         "probe_log": probe_log,
     }
     print(json.dumps(result))

@@ -1,0 +1,21 @@
+"""known-bad: raw TPU_CYPHER_* reads and out-of-registry declarations."""
+import os
+
+from utils.config import ConfigFlag, ConfigOption
+
+
+def raw_get():
+    return os.environ.get("TPU_CYPHER_SHADOW_KNOB", "off")
+
+
+def raw_getenv():
+    return os.getenv("TPU_CYPHER_OTHER_KNOB")
+
+
+def raw_subscript():
+    return os.environ["TPU_CYPHER_THIRD_KNOB"]
+
+
+# declarations outside utils/config.py are invisible to the registry
+STRAY_OPTION = ConfigOption("TPU_CYPHER_STRAY", "x", str)
+STRAY_FLAG = ConfigFlag("TPU_CYPHER_STRAY_FLAG")

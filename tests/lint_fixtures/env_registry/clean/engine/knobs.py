@@ -1,0 +1,10 @@
+"""known-clean consumer: reads go through the typed registry."""
+from utils.config import GOOD_KNOB, GOOD_LIMIT
+
+
+def typed_reads():
+    return GOOD_KNOB.get(), int(GOOD_LIMIT.get())
+
+
+# mentioning a DECLARED var name in a literal is fine (docs, error text)
+KNOB_NAME = "TPU_CYPHER_GOOD_KNOB"

@@ -1,0 +1,27 @@
+"""known-clean registry module: declarations live here."""
+import os
+from typing import Callable, Dict
+
+
+class ConfigOption:
+    def __init__(self, name, default, parse):
+        self.name = name
+        self.default = default
+        self.parse = parse
+
+    def get(self):
+        raw = os.environ.get(self.name)
+        return self.default if raw is None else self.parse(raw)
+
+
+REGISTRY: Dict[str, ConfigOption] = {}
+
+
+def declare(name: str, default, parse: Callable):
+    opt = ConfigOption(name, default, parse)
+    REGISTRY[name] = opt
+    return opt
+
+
+GOOD_KNOB = declare("TPU_CYPHER_GOOD_KNOB", "auto", str)
+GOOD_LIMIT = declare("TPU_CYPHER_GOOD_LIMIT", 4096, int)

@@ -1,0 +1,15 @@
+"""known-bad: broad handlers that can swallow device faults silently."""
+
+
+def swallows_everything(fn):
+    try:
+        return fn()
+    except Exception:
+        return None
+
+
+def bare_swallow(fn):
+    try:
+        return fn()
+    except:  # noqa: E722
+        return None

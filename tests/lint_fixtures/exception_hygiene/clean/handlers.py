@@ -1,0 +1,31 @@
+"""known-clean: broad handlers that re-raise, reroute, or explain."""
+from errors import EngineError, reraise_if_device
+
+
+def reraises_typed(fn):
+    try:
+        return fn()
+    except Exception as exc:
+        raise EngineError(str(exc)) from exc
+
+
+def routes_device_faults(fn):
+    try:
+        return fn()
+    except Exception as exc:
+        reraise_if_device(exc, site="join")
+        return None
+
+
+def annotated_host_only(fn):
+    try:
+        return fn()
+    except Exception:  # fault-ok: host-side config probe, no device state
+        return None
+
+
+def narrow_is_fine(fn):
+    try:
+        return fn()
+    except (OSError, ValueError):
+        return None

@@ -1,0 +1,23 @@
+"""known-bad: device->host syncs in functions with no fault_point."""
+import jax
+import jax.numpy as jnp
+
+
+def unguarded_count(mask):
+    # int() of a device reduction with no fault_point in scope
+    return int(jnp.sum(mask))
+
+
+def unguarded_chained(mask):
+    total_dev = jnp.sum(mask)
+    total = int(total_dev)  # chased through the local assignment
+    return total
+
+
+def unguarded_item(x):
+    got = jnp.max(x)
+    return got.item()
+
+
+def unguarded_device_get(x):
+    return jax.device_get(x)

@@ -1,0 +1,11 @@
+"""known-bad: a raw pallas_call outside any registered impl, plus a
+module-global counter dict."""
+from jax.experimental import pallas as pl
+
+# the pre-obs counter shape: invisible to scopes, export, and reset
+ROGUE_COUNTS = {"hits": 0, "misses": 0}
+
+
+def _rogue_kernel_impl(x):
+    # not in any dispatch.register(..) impls tuple
+    return pl.pallas_call(lambda ref, o: None, out_shape=x)(x)

@@ -1,0 +1,16 @@
+"""known-clean: the pallas_call lives in a dispatch-registered impl and
+counters come from the obs registry."""
+from jax.experimental import pallas as pl
+
+import dispatch
+from obs.metrics import REGISTRY
+
+LAUNCHES = REGISTRY.counter("fixture_launch_total", "launches", labels=("k",))
+
+
+def _good_kernel_impl(x):
+    LAUNCHES.inc(k="good")
+    return pl.pallas_call(lambda ref, o: None, out_shape=x)(x)
+
+
+dispatch.register("good_kernel", "kernel_good", impls=("_good_kernel_impl",))

@@ -1,0 +1,27 @@
+"""known-bad: size-static materializes that skip the bucket lattice."""
+import jax.numpy as jnp
+
+from backend.tpu import jit_ops as J
+
+
+def unsized_nonzero(mask):
+    # value-dependent output shape: can't live under jit, syncs outside
+    return jnp.nonzero(mask)[0]
+
+
+def unrounded_size(mask):
+    n = int(jnp.sum(mask))
+    # a locally synced count passed straight down: one compiled
+    # program per distinct n
+    return jnp.nonzero(mask, size=n)[0]
+
+
+def unrounded_wrapper_size(mask):
+    count_dev = jnp.sum(mask)
+    n = int(count_dev)
+    return J.mask_nonzero(mask, size=n)
+
+
+def unrounded_repeat(vals, counts):
+    total = int(jnp.sum(counts))
+    return jnp.repeat(vals, counts, total_repeat_length=total)

@@ -1,0 +1,29 @@
+"""known-clean: sizes round the lattice or are static primitive params."""
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from backend.tpu import bucketing
+from backend.tpu import jit_ops as J
+
+
+@partial(jax.jit, static_argnames=("size",))
+def counted_primitive(mask, size: int):
+    # the *_counted shape: size is a static parameter, callers round it
+    return jnp.nonzero(mask, size=size)[0]
+
+
+def rounded_call_site(mask, count_dev):
+    n = bucketing.round_size(int(count_dev))
+    return J.mask_nonzero(mask, size=n)
+
+
+def rounded_through_assignment(vals, counts, count_dev):
+    total = bucketing.round_up_pow2(int(count_dev), 32)
+    return jnp.repeat(vals, counts, total_repeat_length=total)
+
+
+def shape_derived_size(mask, other):
+    # shape-derived sizes are already padded/static
+    return J.mask_nonzero(mask, size=other.shape[0])

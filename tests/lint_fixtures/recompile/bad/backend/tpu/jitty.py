@@ -1,0 +1,32 @@
+"""known-bad: per-call jit wrappers, traced env reads, unhashable statics."""
+import os
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+
+def fresh_jit_per_call(x):
+    # a new jitted callable (and compile cache) every invocation
+    return jax.jit(lambda v: v + 1)(x)
+
+
+@jax.jit
+def env_read_inside_trace(x):
+    # the env value is baked into the trace at first call
+    scale = float(os.environ.get("SOME_SCALE", "1.0"))
+    return x * scale
+
+
+@jax.jit
+def config_read_inside_trace(x):
+    from utils.config import BUCKET_MODE
+
+    if BUCKET_MODE.get() == "pow2":  # traced in, silently stale after
+        return x * 2
+    return x
+
+
+@partial(jax.jit, static_argnames=("sizes",))
+def unhashable_static_default(x, sizes=[8, 16]):
+    return jnp.sum(x) * len(sizes)

@@ -1,0 +1,32 @@
+"""known-clean: module-level jits, memoized factories, host-side reads."""
+import os
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+_STEP_CACHE = {}
+
+
+@jax.jit
+def module_level(x):
+    return x + 1
+
+
+@partial(jax.jit, static_argnames=("size",))
+def hashable_static_default(x, size=128):
+    return jnp.sum(x) * size
+
+
+def memoized_factory(mesh, n):
+    # the sanctioned idiom: jit once per key, stored in a module cache
+    f = _STEP_CACHE.get((mesh, n))
+    if f is None:
+        f = jax.jit(lambda v: v * n)
+        _STEP_CACHE[(mesh, n)] = f
+    return f
+
+
+def host_side_env_read():
+    # env reads OUTSIDE jitted bodies are fine (registry rules aside)
+    return os.environ.get("SOME_SCALE", "1.0")

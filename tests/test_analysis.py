@@ -1,0 +1,397 @@
+"""The engine-aware lint framework (ISSUE 5): rule registry, fixture
+corpus, suppression and baseline semantics, CLI, and the tier-1 gate that
+keeps the engine lint-clean.
+
+Every rule must (a) fire on its known-bad fixture and (b) stay silent on
+its known-clean fixture — the corpus under ``tests/lint_fixtures/``
+mirrors the path scoping the rules use (``backend/tpu/``, ``pallas/``,
+``utils/config.py``), so the fixtures exercise the same code paths the
+engine run does.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from tpu_cypher import analysis
+from tpu_cypher.analysis import baseline as baseline_mod
+from tpu_cypher.analysis.core import FileContext
+from tpu_cypher.analysis.rules import ALL_RULES, RULES_BY_ID
+from tpu_cypher.utils import config
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+FIXTURES = os.path.join(HERE, "lint_fixtures")
+REPO = os.path.dirname(HERE)
+
+# rule id -> fixture directory name
+RULE_FIXTURES = {
+    "host-sync": "host_sync",
+    "recompile-hazard": "recompile",
+    "pad-invariant": "pad_invariant",
+    "env-var-registry": "env_registry",
+    "exception-hygiene": "exception_hygiene",
+    "obs-emission": "obs_emission",
+}
+
+
+def _run_fixture(rule_id: str, which: str):
+    path = os.path.join(FIXTURES, RULE_FIXTURES[rule_id], which)
+    assert os.path.isdir(path), f"missing fixture corpus: {path}"
+    return analysis.run_paths([path], rules=[rule_id])
+
+
+# ---------------------------------------------------------------------------
+# registry shape
+# ---------------------------------------------------------------------------
+
+
+def test_rule_registry_shape():
+    ids = [r.id for r in ALL_RULES]
+    assert len(ids) == len(set(ids)), "duplicate rule ids"
+    assert set(ids) == set(RULE_FIXTURES), (
+        "every rule needs a fixture dir (and vice versa)"
+    )
+    for r in ALL_RULES:
+        assert r.id and r.title and r.rationale, r
+
+
+# ---------------------------------------------------------------------------
+# fixture corpus: every rule fires on bad, stays silent on clean
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("rule_id", sorted(RULE_FIXTURES))
+def test_rule_fires_on_known_bad(rule_id):
+    report = _run_fixture(rule_id, "bad")
+    hits = [f for f in report.blocking if f.rule == rule_id]
+    assert hits, f"{rule_id} produced no findings on its bad fixture"
+
+
+@pytest.mark.parametrize("rule_id", sorted(RULE_FIXTURES))
+def test_rule_silent_on_known_clean(rule_id):
+    report = _run_fixture(rule_id, "clean")
+    hits = [f for f in report.blocking if f.rule == rule_id]
+    assert not hits, (
+        f"{rule_id} false-positives on its clean fixture:\n"
+        + "\n".join(f"{f.location()}: {f.message}" for f in hits)
+    )
+
+
+def test_bad_fixture_findings_carry_locations():
+    report = _run_fixture("host-sync", "bad")
+    for f in report.blocking:
+        assert f.path.endswith(".py") and f.line >= 1
+
+
+# ---------------------------------------------------------------------------
+# suppression semantics
+# ---------------------------------------------------------------------------
+
+_VIOLATION = (
+    "import jax.numpy as jnp\n"
+    "\n"
+    "\n"
+    "def unguarded(mask):\n"
+    "    return int(jnp.sum(mask))\n"
+)
+
+
+def _write_tpu_file(tmp_path, body, name="sync.py"):
+    d = tmp_path / "backend" / "tpu"
+    d.mkdir(parents=True, exist_ok=True)
+    p = d / name
+    p.write_text(body)
+    return str(tmp_path)
+
+
+def test_suppression_with_reason_silences(tmp_path):
+    body = _VIOLATION.replace(
+        "    return int(jnp.sum(mask))",
+        "    # tpulint: allow[host-sync] reason=fixture proves suppression\n"
+        "    return int(jnp.sum(mask))",
+    )
+    root = _write_tpu_file(tmp_path, body)
+    report = analysis.run_paths([root], rules=["host-sync"])
+    assert report.clean
+    assert len(report.suppressed) == 1
+    reason = report.suppress_reasons[report.suppressed[0]]
+    assert reason == "fixture proves suppression"
+
+
+def test_suppression_same_line_form(tmp_path):
+    body = _VIOLATION.replace(
+        "    return int(jnp.sum(mask))",
+        "    return int(jnp.sum(mask))  "
+        "# tpulint: allow[host-sync] reason=same-line form",
+    )
+    root = _write_tpu_file(tmp_path, body)
+    report = analysis.run_paths([root], rules=["host-sync"])
+    assert report.clean and len(report.suppressed) == 1
+
+
+def test_suppression_without_reason_is_a_finding(tmp_path):
+    body = _VIOLATION.replace(
+        "    return int(jnp.sum(mask))",
+        "    # tpulint: allow[host-sync]\n"
+        "    return int(jnp.sum(mask))",
+    )
+    root = _write_tpu_file(tmp_path, body)
+    report = analysis.run_paths([root], rules=["host-sync"])
+    assert not report.clean
+    rules = {f.rule for f in report.blocking}
+    # the reason-less allow is itself a finding AND does not suppress
+    assert rules == {"suppression", "host-sync"}
+
+
+def test_suppression_wrong_rule_does_not_silence(tmp_path):
+    body = _VIOLATION.replace(
+        "    return int(jnp.sum(mask))",
+        "    # tpulint: allow[pad-invariant] reason=names the wrong rule\n"
+        "    return int(jnp.sum(mask))",
+    )
+    root = _write_tpu_file(tmp_path, body)
+    report = analysis.run_paths([root], rules=["host-sync"])
+    assert [f.rule for f in report.blocking] == ["host-sync"]
+
+
+def test_malformed_tpulint_comment_is_a_finding(tmp_path):
+    body = _VIOLATION + "# tpulint: alow[host-sync] reason=typo\n"
+    root = _write_tpu_file(tmp_path, body)
+    report = analysis.run_paths([root], rules=["host-sync"])
+    assert "suppression" in {f.rule for f in report.blocking}
+
+
+# ---------------------------------------------------------------------------
+# baseline semantics
+# ---------------------------------------------------------------------------
+
+
+def test_baseline_grandfathers_exact_findings(tmp_path):
+    root = _write_tpu_file(tmp_path, _VIOLATION)
+    report = analysis.run_paths([root], rules=["host-sync"])
+    assert len(report.blocking) == 1
+    base_file = str(tmp_path / "baseline.json")
+    baseline_mod.save(base_file, report.blocking)
+
+    again = analysis.run_paths(
+        [root], rules=["host-sync"], baseline_path=base_file
+    )
+    assert again.clean
+    assert len(again.baselined) == 1
+
+
+def test_baseline_does_not_cover_new_identical_finding(tmp_path):
+    root = _write_tpu_file(tmp_path, _VIOLATION)
+    report = analysis.run_paths([root], rules=["host-sync"])
+    base_file = str(tmp_path / "baseline.json")
+    baseline_mod.save(base_file, report.blocking)
+
+    # a SECOND identical violation in the same file: multiplicity matters
+    doubled = _VIOLATION + (
+        "\n\ndef unguarded2(mask):\n    return int(jnp.sum(mask))\n"
+    )
+    root = _write_tpu_file(tmp_path, doubled)
+    again = analysis.run_paths(
+        [root], rules=["host-sync"], baseline_path=base_file
+    )
+    assert len(again.baselined) == 1
+    assert len(again.blocking) == 1
+
+
+def test_missing_baseline_file_is_empty(tmp_path):
+    root = _write_tpu_file(tmp_path, _VIOLATION)
+    report = analysis.run_paths(
+        [root],
+        rules=["host-sync"],
+        baseline_path=str(tmp_path / "nope.json"),
+    )
+    assert len(report.blocking) == 1
+
+
+def test_malformed_baseline_raises(tmp_path):
+    bad = tmp_path / "bad.json"
+    bad.write_text("[]")
+    with pytest.raises(ValueError):
+        baseline_mod.load(str(bad))
+
+
+# ---------------------------------------------------------------------------
+# CLI
+# ---------------------------------------------------------------------------
+
+
+def _cli(*args):
+    return subprocess.run(
+        [sys.executable, "-m", "tpu_cypher.analysis", *args],
+        capture_output=True,
+        text=True,
+        cwd=REPO,
+    )
+
+
+def test_cli_bad_fixture_exits_1_json():
+    proc = _cli(
+        os.path.join(FIXTURES, "host_sync", "bad"),
+        "--format",
+        "json",
+        "--baseline",
+        "",
+        "--rules",
+        "host-sync",
+    )
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    payload = json.loads(proc.stdout)
+    assert payload["clean"] is False
+    assert all(f["rule"] == "host-sync" for f in payload["findings"])
+
+
+def test_cli_clean_fixture_exits_0():
+    proc = _cli(
+        os.path.join(FIXTURES, "host_sync", "clean"),
+        "--baseline",
+        "",
+        "--rules",
+        "host-sync",
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+def test_cli_list_rules():
+    proc = _cli("--list-rules")
+    assert proc.returncode == 0
+    for rid in RULE_FIXTURES:
+        assert rid in proc.stdout
+
+
+def test_cli_unknown_rule_exits_2():
+    proc = _cli("--rules", "not-a-rule")
+    assert proc.returncode == 2
+
+
+def test_cli_write_baseline_ratchet(tmp_path):
+    base = str(tmp_path / "base.json")
+    bad = os.path.join(FIXTURES, "host_sync", "bad")
+    proc = _cli(bad, "--rules", "host-sync", "--baseline", base, "--write-baseline")
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    # with the written baseline the same tree is green
+    proc = _cli(bad, "--rules", "host-sync", "--baseline", base)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+# ---------------------------------------------------------------------------
+# shared-pass internals
+# ---------------------------------------------------------------------------
+
+
+def test_file_context_scope_resolution():
+    src = (
+        "import jax.numpy as jnp\n"
+        "def f(x):\n"
+        "    a = jnp.sum(x)\n"
+        "    return a\n"
+    )
+    ctx = FileContext("mem.py", "mem.py", src)
+    fn = ctx.functions[0]
+    assert ctx.enclosing_function(ctx.calls[0]) is fn
+    assert len(ctx.assignments(fn, "a")) == 1
+    assert ctx.param_names(fn) == ["x"]
+
+
+def test_unparsable_file_is_a_parse_finding(tmp_path):
+    p = tmp_path / "broken.py"
+    p.write_text("def broken(:\n")
+    report = analysis.run_paths([str(tmp_path)])
+    assert [f.rule for f in report.blocking] == ["parse"]
+
+
+# ---------------------------------------------------------------------------
+# the typed config registry (env-var-registry's other half)
+# ---------------------------------------------------------------------------
+
+
+def test_config_registry_enumerates_engine_surface():
+    opts = config.options()
+    assert set(opts) >= {
+        "TPU_CYPHER_PRINT_TIMINGS",
+        "TPU_CYPHER_BUCKET",
+        "TPU_CYPHER_MEM_BUDGET",
+        "TPU_CYPHER_LADDER",
+        "TPU_CYPHER_CHUNK_ROWS",
+        "TPU_CYPHER_QUERY_DEADLINE_S",
+        "TPU_CYPHER_FAULTS",
+        "TPU_CYPHER_PALLAS",
+        "TPU_CYPHER_MXU_DENSE",
+        "TPU_CYPHER_MXU_TILED_MAX",
+        "TPU_CYPHER_BROADCAST_LIMIT",
+        "TPU_CYPHER_ISLAND_WARN_ROWS",
+        "TPU_CYPHER_COMPILE_CACHE_DIR",
+        "TPU_CYPHER_METRICS_FILE",
+        "TPU_CYPHER_PROFILE_DIR",
+    }
+    for name, opt in opts.items():
+        assert opt.name == name
+
+
+def test_print_timings_is_one_shared_declaration():
+    """The PR-5 satellite: the TPU_CYPHER_PRINT_TIMINGS read in
+    obs.metrics and the one in utils.config are the SAME object, so an
+    override through either path is seen by both."""
+    from tpu_cypher.obs import metrics as OM
+
+    assert OM.PRINT_TIMINGS is config.PRINT_TIMINGS
+    config.PRINT_TIMINGS.set(True)
+    try:
+        assert OM.PRINT_TIMINGS.get() is True
+    finally:
+        config.PRINT_TIMINGS.reset()
+
+
+def test_scattered_module_options_alias_the_registry():
+    from tpu_cypher.backend.tpu import bucketing
+    from tpu_cypher.backend.tpu.pallas import dispatch
+    from tpu_cypher.runtime import guard
+
+    assert bucketing.MODE is config.BUCKET_MODE
+    assert bucketing.MEM_BUDGET is config.MEM_BUDGET
+    assert dispatch.MODE is config.PALLAS_MODE
+    assert guard.CHUNK_ROWS is config.CHUNK_ROWS
+    assert guard.DEADLINE_S is config.DEADLINE_S
+    assert guard.LADDER_MODE is config.LADDER_MODE
+
+
+def test_declare_is_idempotent():
+    a = config.declare("TPU_CYPHER_BUCKET", "off", str)
+    assert a is config.BUCKET_MODE
+
+
+# ---------------------------------------------------------------------------
+# the tier-1 gate: the WHOLE engine lints clean with the committed
+# (empty) baseline — new findings need a fix or an inline reason
+# ---------------------------------------------------------------------------
+
+
+def test_committed_baseline_is_empty():
+    with open(os.path.join(REPO, "tpu_cypher", "analysis", "baseline.json")) as f:
+        data = json.load(f)
+    assert data["findings"] == [], (
+        "the committed baseline must stay empty: fix findings or suppress "
+        "them inline with a reason"
+    )
+
+
+def test_engine_lints_clean():
+    report = analysis.check_engine()
+    assert report.files_checked > 80, "engine sweep looks truncated"
+    assert report.clean, (
+        "tpu_cypher/ has unsuppressed lint findings — fix them or add "
+        "'# tpulint: allow[rule] reason=...' where the site is deliberate:\n"
+        + report.render_text()
+    )
+    # every suppression in the engine carries a non-trivial reason
+    for f in report.suppressed:
+        assert len(report.suppress_reasons[f]) >= 10, (
+            f"suppression at {f.location()} has a throwaway reason"
+        )

@@ -17,12 +17,13 @@ Five guarantees under test:
   with their legacy read paths green.
 * EXPORT — deterministic Prometheus text (golden), schema-versioned
   JSON-lines events on ``TPU_CYPHER_METRICS_FILE``.
-* AST GUARD — the fault-site and kernel-dispatch chokepoints emit through
+* LINT GUARD — the fault-site and kernel-dispatch chokepoints emit through
   ``obs``, and no module-global stray counter dicts exist anywhere in the
-  engine.
+  engine. Checked by the ``obs-emission`` rule of ``tpu_cypher.analysis``
+  (ISSUE 5) — this file just invokes the framework; the old ad-hoc AST
+  walkers live on as the rule implementation.
 """
 
-import ast
 import json
 import os
 import threading
@@ -416,94 +417,44 @@ def test_jsonl_sink_writes_schema_versioned_events(tmp_path):
 
 
 # ---------------------------------------------------------------------------
-# AST guards: everything emits through obs
+# lint guards: everything emits through obs — the ``obs-emission`` rule of
+# tpu_cypher.analysis (the ad-hoc AST walkers that used to live here are
+# now the rule's implementation; lint time and test time enforce the SAME
+# predicate)
 # ---------------------------------------------------------------------------
-
-
-def _module_paths():
-    for root, _dirs, files in os.walk(PKG):
-        if os.path.sep + "obs" in root:
-            continue  # the registry itself
-        for f in files:
-            if f.endswith(".py"):
-                yield os.path.join(root, f)
 
 
 def test_ast_guard_no_stray_module_global_counters():
     """No module-global ``NAME = {"k": 0, ...}`` counter dicts anywhere in
     the engine — the pattern the four pre-obs counters used. Counters
     belong to the registry."""
-    offenders = []
-    for path in _module_paths():
-        with open(path) as f:
-            tree = ast.parse(f.read())
-        for node in tree.body:  # module level only
-            if not isinstance(node, ast.Assign):
-                continue
-            if not isinstance(node.value, ast.Dict):
-                continue
-            vals = node.value.values
-            if vals and all(
-                isinstance(v, ast.Constant) and v.value == 0 for v in vals
-            ):
-                names = [
-                    t.id for t in node.targets if isinstance(t, ast.Name)
-                ]
-                offenders.append((os.path.relpath(path, PKG), names))
-    assert not offenders, f"stray module-global counter dicts: {offenders}"
+    from tpu_cypher import analysis
 
-
-def _assigned_from_registry_counter(tree, var: str) -> bool:
-    for node in ast.walk(tree):
-        if isinstance(node, ast.Assign) and any(
-            isinstance(t, ast.Name) and t.id == var for t in node.targets
-        ):
-            v = node.value
-            if (
-                isinstance(v, ast.Call)
-                and isinstance(v.func, ast.Attribute)
-                and v.func.attr == "counter"
-            ):
-                return True
-    return False
-
-
-def _func_calls_inc_on(tree, func_name: str, var: str) -> bool:
-    for node in ast.walk(tree):
-        if isinstance(node, ast.FunctionDef) and node.name == func_name:
-            for n in ast.walk(node):
-                if (
-                    isinstance(n, ast.Call)
-                    and isinstance(n.func, ast.Attribute)
-                    and n.func.attr == "inc"
-                    and isinstance(n.func.value, ast.Name)
-                    and n.func.value.id == var
-                ):
-                    return True
-    return False
+    report = analysis.check_engine(rules=["obs-emission"])
+    assert report.clean, report.render_text()
 
 
 def test_ast_guard_fault_sites_emit_through_obs():
-    path = os.path.join(PKG, "runtime", "faults.py")
-    with open(path) as f:
-        tree = ast.parse(f.read())
-    assert _assigned_from_registry_counter(tree, "FAULT_SITE_HITS")
-    assert _func_calls_inc_on(tree, "fault_point", "FAULT_SITE_HITS"), (
-        "fault_point must count every site invocation through the obs "
-        "registry"
+    """``fault_point`` must count every site invocation through a registry
+    counter (FAULT_SITE_HITS) — the obs-emission chokepoint check over
+    runtime/faults.py."""
+    from tpu_cypher import analysis
+
+    report = analysis.run_paths(
+        [os.path.join(PKG, "runtime", "faults.py")], rules=["obs-emission"]
     )
+    assert report.clean, report.render_text()
 
 
 def test_ast_guard_kernel_dispatch_emits_through_obs():
     """Every ``pl.pallas_call`` reaches the engine through a registered
     dispatch impl (guarded in test_pallas_dispatch) and dispatch's use
-    counter is the obs registry — together: no kernel launch escapes
-    obs."""
-    path = os.path.join(PKG, "backend", "tpu", "pallas", "dispatch.py")
-    with open(path) as f:
-        tree = ast.parse(f.read())
-    assert _assigned_from_registry_counter(tree, "PALLAS_LAUNCH")
-    assert _func_calls_inc_on(tree, "_count", "PALLAS_LAUNCH")
-    # and launch() itself opens a kernel span
-    src = open(path).read()
-    assert "_obs_trace.span" in src
+    counter is the obs registry, with ``launch`` opening a kernel span —
+    together: no kernel launch escapes obs."""
+    from tpu_cypher import analysis
+
+    report = analysis.run_paths(
+        [os.path.join(PKG, "backend", "tpu", "pallas", "dispatch.py")],
+        rules=["obs-emission"],
+    )
+    assert report.clean, report.render_text()

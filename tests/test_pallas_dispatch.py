@@ -19,7 +19,6 @@ Four guarantees under test:
   broken-once per (kernel, variant) and ``reset()`` clears it.
 """
 
-import ast
 import os
 
 import numpy as np
@@ -468,51 +467,44 @@ def test_device_fault_inside_kernel_surfaces_typed(monkeypatch):
 
 
 def test_every_pallas_call_goes_through_dispatch():
+    """Raw ``pl.pallas_call`` only inside dispatch-registered impls under
+    ``backend/tpu/pallas/`` — enforced by the ``obs-emission`` rule of
+    ``tpu_cypher.analysis`` (ISSUE 5), which statically collects the
+    ``dispatch.register(.., impls=(..))`` allowlist. The runtime registry
+    must agree with the static one (same impls), so registration cannot
+    drift from what the rule checks."""
+    from tpu_cypher import analysis
+    from tpu_cypher.analysis.project import ProjectContext
+
     root = os.path.join(
         os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
         "tpu_cypher",
         "backend",
         "tpu",
     )
-    allowed = set()
-    for spec in dispatch.registry().values():
-        allowed.update(spec.impls)
-    pallas_dir = os.path.join(root, "pallas")
-    offenders = []
-    for dirpath, _dirs, files in os.walk(root):
-        for fname in sorted(files):
-            if not fname.endswith(".py"):
-                continue
-            path = os.path.join(dirpath, fname)
-            with open(path) as f:
-                tree = ast.parse(f.read())
-            # map every pallas_call occurrence to its enclosing function
-            stack = []
-
-            def walk(node):
-                is_fn = isinstance(
-                    node, (ast.FunctionDef, ast.AsyncFunctionDef)
-                )
-                if is_fn:
-                    stack.append(node.name)
-                for child in ast.iter_child_nodes(node):
-                    walk(child)
-                if (
-                    isinstance(node, ast.Attribute)
-                    and node.attr == "pallas_call"
-                ):
-                    fn = stack[-1] if stack else "<module>"
-                    rel = os.path.relpath(path, root)
-                    if not path.startswith(pallas_dir) or fn not in allowed:
-                        offenders.append(f"{rel}:{node.lineno} in {fn}()")
-                if is_fn:
-                    stack.pop()
-
-            walk(tree)
-    assert not offenders, (
+    report = analysis.run_paths([root], rules=["obs-emission"])
+    assert report.clean, (
         "raw pl.pallas_call outside a dispatch-registered impl — every "
         "kernel must launch through backend.tpu.pallas.dispatch.launch "
-        f"(eligibility/fallback/fault sites): {offenders}"
+        f"(eligibility/fallback/fault sites):\n{report.render_text()}"
+    )
+    # static allowlist == runtime registry: the rule checks what actually
+    # registers
+    runtime_impls = set()
+    for spec in dispatch.registry().values():
+        runtime_impls.update(spec.impls)
+    ctxs = []
+    for dirpath, _dirs, files in os.walk(os.path.join(root, "pallas")):
+        for fname in sorted(files):
+            if fname.endswith(".py"):
+                p = os.path.join(dirpath, fname)
+                ctxs.append(
+                    analysis.FileContext(p, os.path.relpath(p), open(p).read())
+                )
+    static_impls = ProjectContext(ctxs).dispatch_impls
+    assert runtime_impls == static_impls, (
+        f"runtime registry {sorted(runtime_impls)} != statically "
+        f"registered impls {sorted(static_impls)}"
     )
 
 

@@ -1,0 +1,106 @@
+"""CLI: ``python -m tpu_cypher.analysis [options] [paths...]``.
+
+Exit codes: 0 clean, 1 findings, 2 usage/internal error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from typing import List, Optional
+
+from .baseline import save as save_baseline
+from .runner import (
+    DEFAULT_BASELINE,
+    ENGINE_ROOT,
+    format_report,
+    run_paths,
+)
+from .rules import ALL_RULES, RULES_BY_ID
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="python -m tpu_cypher.analysis",
+        description=(
+            "Engine-aware static analysis: tracer-safety, pad, sync, and "
+            "config invariants (docs/static-analysis.md)."
+        ),
+    )
+    p.add_argument(
+        "paths",
+        nargs="*",
+        help="files or directories to lint (default: the tpu_cypher package)",
+    )
+    p.add_argument(
+        "--format",
+        choices=("text", "json"),
+        default="text",
+        help="report format (default: text)",
+    )
+    p.add_argument(
+        "--baseline",
+        default=DEFAULT_BASELINE,
+        help="grandfathered-findings file (default: the committed, empty "
+        "analysis/baseline.json); pass an empty string for no baseline",
+    )
+    p.add_argument(
+        "--write-baseline",
+        action="store_true",
+        help="write all current blocking findings to --baseline and exit 0 "
+        "(the adoption ratchet)",
+    )
+    p.add_argument(
+        "--rules",
+        default="",
+        help="comma-separated rule ids to run (default: all)",
+    )
+    p.add_argument(
+        "--list-rules",
+        action="store_true",
+        help="print the rule table and exit",
+    )
+    return p
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    args = build_parser().parse_args(argv)
+
+    if args.list_rules:
+        for rule in ALL_RULES:
+            print(f"{rule.id:20s} {rule.title}")
+        return 0
+
+    rule_ids = None
+    if args.rules:
+        rule_ids = [r.strip() for r in args.rules.split(",") if r.strip()]
+        unknown = [r for r in rule_ids if r not in RULES_BY_ID]
+        if unknown:
+            print(f"unknown rule id(s): {', '.join(unknown)}", file=sys.stderr)
+            return 2
+
+    paths = args.paths or [ENGINE_ROOT]
+    baseline = args.baseline or None
+
+    try:
+        report = run_paths(paths, rules=rule_ids, baseline_path=baseline)
+    except ValueError as exc:  # malformed baseline
+        print(str(exc), file=sys.stderr)
+        return 2
+
+    if args.write_baseline:
+        if not baseline:
+            print("--write-baseline needs --baseline", file=sys.stderr)
+            return 2
+        save_baseline(baseline, report.blocking)
+        print(
+            f"wrote {len(report.blocking)} finding(s) to {baseline}"
+        )
+        return 0
+
+    print(format_report(report, args.format))
+    return 0 if report.clean else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,77 @@
+"""Cross-file facts rules need: the config registry's declared env vars and
+dispatch's registered kernel impls.
+
+Both are extracted STATICALLY from the already-parsed ``FileContext``s (no
+engine import, no runtime registry): the analyzer must be able to lint a
+broken tree, and the fixture corpus must be lintable without being
+importable as the real package.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, Optional, Set
+
+from .core import FileContext, dotted_name
+
+CONFIG_MODULE_SUFFIX = "utils/config.py"
+_DECLARE_FUNCS = ("declare", "declare_flag", "ConfigOption", "ConfigFlag")
+
+
+class ProjectContext:
+    """Facts visible only across files.
+
+    ``declared_env_vars`` — env var names declared in the typed registry
+    (``utils/config.py``); ``None`` when no config module is among the
+    analyzed files (fixture corpora), in which case declaration-existence
+    checks are skipped but raw-read checks still apply.
+
+    ``dispatch_impls`` — function names registered as kernel impls via
+    ``dispatch.register(name, site, impls=(..))`` anywhere in the analyzed
+    set: the allowlist for raw ``pl.pallas_call`` sites.
+    """
+
+    def __init__(self, contexts: Iterable[FileContext]):
+        self.declared_env_vars: Optional[Set[str]] = None
+        self.dispatch_impls: Set[str] = set()
+        self.by_relpath: Dict[str, FileContext] = {}
+        for ctx in contexts:
+            self.by_relpath[ctx.relpath] = ctx
+            if ctx.relpath.endswith(CONFIG_MODULE_SUFFIX):
+                declared = self._collect_declared(ctx)
+                if self.declared_env_vars is None:
+                    self.declared_env_vars = set()
+                self.declared_env_vars |= declared
+            self.dispatch_impls |= self._collect_impls(ctx)
+
+    @staticmethod
+    def _collect_declared(ctx: FileContext) -> Set[str]:
+        out: Set[str] = set()
+        for call in ctx.calls:
+            name = dotted_name(call.func).split(".")[-1]
+            if name not in _DECLARE_FUNCS:
+                continue
+            for arg in list(call.args[:1]) + [
+                kw.value for kw in call.keywords if kw.arg == "name"
+            ]:
+                if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+                    out.add(arg.value)
+        return out
+
+    @staticmethod
+    def _collect_impls(ctx: FileContext) -> Set[str]:
+        out: Set[str] = set()
+        for call in ctx.calls:
+            if dotted_name(call.func).split(".")[-1] != "register":
+                continue
+            impl_args = [kw.value for kw in call.keywords if kw.arg == "impls"]
+            if not impl_args and len(call.args) >= 3:
+                impl_args = [call.args[2]]
+            for node in impl_args:
+                if isinstance(node, (ast.Tuple, ast.List)):
+                    for el in node.elts:
+                        if isinstance(el, ast.Constant) and isinstance(
+                            el.value, str
+                        ):
+                            out.add(el.value)
+        return out

@@ -1,0 +1,30 @@
+"""The rule registry: six engine-grounded invariants, one shared pass.
+
+Adding a rule = subclass ``core.Rule``, give it a kebab-case ``id``, and
+list an instance here. Rules are documented (id, rationale, fixture pair)
+in ``docs/static-analysis.md``; every rule must ship a known-bad and a
+known-clean fixture under ``tests/lint_fixtures/``.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List
+
+from ..core import Rule
+from .env_registry import EnvVarRegistryRule
+from .exception_hygiene import ExceptionHygieneRule
+from .host_sync import HostSyncRule
+from .obs_emission import ObsEmissionRule
+from .pad_invariant import PadInvariantRule
+from .recompile import RecompileHazardRule
+
+ALL_RULES: List[Rule] = [
+    HostSyncRule(),
+    RecompileHazardRule(),
+    PadInvariantRule(),
+    EnvVarRegistryRule(),
+    ExceptionHygieneRule(),
+    ObsEmissionRule(),
+]
+
+RULES_BY_ID: Dict[str, Rule] = {r.id: r for r in ALL_RULES}

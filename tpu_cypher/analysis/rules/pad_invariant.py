@@ -1,0 +1,130 @@
+"""pad-invariant: size-static materializes round through the bucket
+lattice.
+
+Every data-dependent output size in the TPU backend is baked STATIC into
+its jitted materialize program (``jnp.nonzero(size=..)``,
+``total_repeat_length=..`` — docs/pad-invariants.md). A size that reaches
+one of those without passing ``bucketing.round_size`` (or the pow2 /
+multiple helpers) compiles one XLA program PER DISTINCT COUNT: correct
+output, quadratic compile bill, invisible until a BENCH delta. The
+sanctioned shapes are exactly two — the size is a (static) parameter of a
+jitted ``*_counted``-style primitive, or the size expression routes
+through a ``bucketing`` rounding helper before being passed down.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, Optional
+
+from ..core import FileContext, Finding, Rule, dotted_name
+from ..project import ProjectContext
+
+SCOPE_DIRS = ("backend/tpu/", "parallel/")
+_SIZE_KWARGS = ("size", "total_repeat_length")
+_ROUNDERS = (
+    "round_size",
+    "round_up_pow2",
+    "round_up_multiple",
+    "bucket_pad_host",
+)
+_BUCKETING_SUFFIX = "backend/tpu/bucketing.py"
+
+
+def _mentions_rounder(expr: ast.AST) -> bool:
+    for n in ast.walk(expr):
+        if isinstance(n, ast.Call) and dotted_name(n.func).split(".")[
+            -1
+        ] in _ROUNDERS:
+            return True
+    return False
+
+
+class PadInvariantRule(Rule):
+    id = "pad-invariant"
+    title = "size-static materializes route through bucketing.round_size"
+    rationale = (
+        "an unrounded data-dependent size compiles one XLA program per "
+        "distinct count — the recompile storm bucketing exists to kill"
+    )
+
+    def check(
+        self, ctx: FileContext, project: ProjectContext
+    ) -> Iterator[Finding]:
+        if not any(d in ctx.relpath for d in SCOPE_DIRS):
+            return
+        if ctx.relpath.endswith(_BUCKETING_SUFFIX):
+            return  # the lattice itself
+        for call in ctx.calls:
+            name = dotted_name(call.func)
+            size_kw = next(
+                (kw for kw in call.keywords if kw.arg in _SIZE_KWARGS), None
+            )
+            if size_kw is None:
+                # the classic trap: an UNSIZED jnp.nonzero is value-
+                # dependent — it can't live under jit and host-syncs outside
+                if name == "jnp.nonzero":
+                    yield ctx.finding(
+                        self.id,
+                        call,
+                        "unsized jnp.nonzero — value-dependent output "
+                        "shape; use the sized form with a bucketed size "
+                        "(jit_ops.mask_nonzero / *_counted variants)",
+                    )
+                continue
+            fn = ctx.enclosing_function(call)
+            if self._size_sanctioned(ctx, fn, size_kw.value, 0):
+                continue
+            yield ctx.finding(
+                self.id,
+                call,
+                f"{name or 'call'}({size_kw.arg}=..) with a size that "
+                "neither routes through bucketing.round_size/round_up_* "
+                "nor is a static parameter of the enclosing primitive — "
+                "every data-dependent materialize size must round the "
+                "bucket lattice (docs/pad-invariants.md)",
+            )
+
+    def _size_sanctioned(
+        self,
+        ctx: FileContext,
+        fn: Optional[ast.AST],
+        expr: ast.AST,
+        depth: int,
+    ) -> bool:
+        if depth > 4:
+            return False
+        if _mentions_rounder(expr):
+            return True
+        if isinstance(expr, ast.Constant):
+            return True  # a literal size is one fixed program
+        if isinstance(expr, ast.Attribute) or (
+            isinstance(expr, ast.Subscript)
+            and isinstance(expr.value, ast.Attribute)
+        ):
+            # shape-derived sizes (x.shape[0], self._cap) are already
+            # padded/static by the time they are attributes
+            return True
+        if isinstance(expr, ast.Call):
+            name = dotted_name(expr.func)
+            if name in ("len", "min", "max", "int"):
+                return all(
+                    self._size_sanctioned(ctx, fn, a, depth + 1)
+                    for a in expr.args
+                )
+            return False
+        if isinstance(expr, ast.BinOp):
+            return self._size_sanctioned(
+                ctx, fn, expr.left, depth + 1
+            ) and self._size_sanctioned(ctx, fn, expr.right, depth + 1)
+        if isinstance(expr, ast.Name):
+            if fn is not None and expr.id in ctx.param_names(fn):
+                # a parameter: the caller computed (and rounded) the size —
+                # this is the jitted *_counted primitive shape
+                return True
+            assigns = ctx.assignments(fn, expr.id)
+            return bool(assigns) and any(
+                self._size_sanctioned(ctx, fn, v, depth + 1)
+                for v in assigns
+            )
+        return False

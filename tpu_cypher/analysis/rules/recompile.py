@@ -1,0 +1,159 @@
+"""recompile-hazard: nothing on the query path may manufacture fresh XLA
+programs per call.
+
+PR 1's entire win — shape-bucketed, compile-once execution — dies quietly
+if someone (a) builds a ``jax.jit`` wrapper INSIDE a function (every call
+makes a new callable with its own cache), (b) reads ``os.environ`` or a
+config option inside a jitted body (the value is baked into the trace;
+changing it silently does nothing, and conditioning a Python branch on it
+re-traces), or (c) declares a ``static_argnames`` parameter whose default
+is an unhashable literal (first call with the default raises deep inside
+jax). None of these break tests on day one; all of them show up as BENCH
+compile-count regressions weeks later. Catch them at lint time.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, List
+
+from ..core import FileContext, Finding, Rule, dotted_name
+from ..project import ProjectContext
+
+_ENV_READS = ("os.environ.get", "os.getenv", "os.environ.setdefault")
+
+
+def _jit_target(call: ast.Call) -> bool:
+    """Is this Call expression ``jax.jit(..)`` or ``partial(jax.jit, ..)``?"""
+    name = dotted_name(call.func)
+    if name in ("jax.jit", "jit") or name.endswith(".jit"):
+        return True
+    if name.split(".")[-1] == "partial" and call.args:
+        inner = dotted_name(call.args[0])
+        return inner in ("jax.jit", "jit") or inner.endswith(".jit")
+    return False
+
+
+def _static_names(call: ast.Call) -> List[str]:
+    for kw in call.keywords:
+        if kw.arg == "static_argnames" and isinstance(
+            kw.value, (ast.Tuple, ast.List)
+        ):
+            return [
+                el.value
+                for el in kw.value.elts
+                if isinstance(el, ast.Constant) and isinstance(el.value, str)
+            ]
+    return []
+
+
+class RecompileHazardRule(Rule):
+    id = "recompile-hazard"
+    title = "no per-call jit wrappers, traced env reads, or unhashable statics"
+    rationale = (
+        "per-call jax.jit wrappers and value-varying reads inside jitted "
+        "bodies defeat the compile cache; unhashable static defaults raise "
+        "at the first defaulted call"
+    )
+
+    @staticmethod
+    def _stores_into_cache(fn: ast.AST) -> bool:
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Assign):
+                continue
+            for t in node.targets:
+                if (
+                    isinstance(t, ast.Subscript)
+                    and isinstance(t.value, ast.Name)
+                    and t.value.id.isupper()
+                ):
+                    return True
+        return False
+
+    def check(
+        self, ctx: FileContext, project: ProjectContext
+    ) -> Iterator[Finding]:
+        # (a) jax.jit constructed inside a function body — EXCEPT the
+        # engine's memoized-factory idiom, where the function stores the
+        # jitted callable into a module-level cache (an ALL_CAPS dict
+        # subscript store, e.g. _MESH_CHAIN_CACHE[(mesh, axis)] = run):
+        # those compile once per key, which is the whole point
+        for call in ctx.calls:
+            if not _jit_target(call):
+                continue
+            fn = ctx.enclosing_function(call)
+            if fn is not None and not self._stores_into_cache(fn):
+                yield ctx.finding(
+                    self.id,
+                    call,
+                    f"jax.jit constructed inside {fn.name}() — a fresh "
+                    "jitted callable (and compile cache) per call; hoist "
+                    "to module scope or memoize in a module-level cache",
+                )
+
+        for fn in ctx.functions:
+            jitted = ctx.is_jitted(fn)
+            # (c) unhashable defaults on static_argnames params
+            for dec in fn.decorator_list:
+                if not (isinstance(dec, ast.Call) and _jit_target(dec)):
+                    continue
+                statics = set(_static_names(dec))
+                if not statics:
+                    continue
+                args = fn.args
+                pos = args.posonlyargs + args.args
+                for name_node, default in list(
+                    zip(pos[len(pos) - len(args.defaults):], args.defaults)
+                ) + [
+                    (a, d)
+                    for a, d in zip(args.kwonlyargs, args.kw_defaults)
+                    if d is not None
+                ]:
+                    if name_node.arg in statics and isinstance(
+                        default, (ast.List, ast.Dict, ast.Set)
+                    ):
+                        yield ctx.finding(
+                            self.id,
+                            default,
+                            f"static arg {name_node.arg!r} of {fn.name}() "
+                            "has an unhashable default — jit hashes static "
+                            "args; use a tuple or None",
+                        )
+            if not jitted:
+                continue
+            # (b) value-varying reads inside a jitted body
+            for call in ctx.calls_under(fn):
+                name = dotted_name(call.func)
+                if name in _ENV_READS:
+                    yield ctx.finding(
+                        self.id,
+                        call,
+                        f"os.environ read inside jitted {fn.name}() — the "
+                        "value is baked into the trace at first call; read "
+                        "it outside and pass it in (static or operand)",
+                    )
+                elif (
+                    isinstance(call.func, ast.Attribute)
+                    and call.func.attr == "get"
+                    and isinstance(call.func.value, ast.Name)
+                    and call.func.value.id.isupper()
+                    and not call.args
+                ):
+                    # CONFIG_OPTION.get() inside a jitted body: same bake-in
+                    yield ctx.finding(
+                        self.id,
+                        call,
+                        f"config option {call.func.value.id}.get() inside "
+                        f"jitted {fn.name}() — the flag value is traced in; "
+                        "resolve it at the call site instead",
+                    )
+            for node in ast.walk(fn):
+                if isinstance(node, ast.Subscript) and dotted_name(
+                    node.value
+                ) == "os.environ":
+                    yield ctx.finding(
+                        self.id,
+                        node,
+                        f"os.environ subscript inside jitted {fn.name}() — "
+                        "the value is baked into the trace at first call",
+                    )

@@ -1,0 +1,194 @@
+"""Orchestration: collect files, build contexts once, run every rule,
+apply suppressions and the baseline, format the report.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence
+
+from . import baseline as baseline_mod
+from .core import FileContext, Finding
+from .project import ProjectContext
+from .rules import ALL_RULES, RULES_BY_ID
+
+# the engine package root (…/tpu_cypher) — what check_engine lints
+ENGINE_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+DEFAULT_BASELINE = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "baseline.json"
+)
+
+_SKIP_DIRS = {"__pycache__", ".git", "node_modules"}
+
+
+@dataclass
+class Report:
+    """Everything one analysis run produced. ``blocking`` is what fails
+    CI; suppressed and baselined findings are carried for the report so a
+    reader can audit the debt."""
+
+    blocking: List[Finding] = field(default_factory=list)
+    suppressed: List[Finding] = field(default_factory=list)
+    baselined: List[Finding] = field(default_factory=list)
+    suppress_reasons: Dict[Finding, str] = field(default_factory=dict)
+    files_checked: int = 0
+    rules_run: int = 0
+
+    @property
+    def clean(self) -> bool:
+        return not self.blocking
+
+    def to_json(self) -> Dict[str, object]:
+        return {
+            "clean": self.clean,
+            "files_checked": self.files_checked,
+            "rules_run": self.rules_run,
+            "findings": [f.to_json() for f in self.blocking],
+            "suppressed": [
+                {**f.to_json(), "reason": self.suppress_reasons.get(f, "")}
+                for f in self.suppressed
+            ],
+            "baselined": [f.to_json() for f in self.baselined],
+        }
+
+    def render_text(self) -> str:
+        out: List[str] = []
+        for f in self.blocking:
+            out.append(f"{f.location()}: [{f.rule}] {f.message}")
+        out.append(
+            f"{len(self.blocking)} finding(s) "
+            f"({len(self.suppressed)} suppressed, "
+            f"{len(self.baselined)} baselined) across "
+            f"{self.files_checked} file(s)"
+        )
+        return "\n".join(out)
+
+
+def _collect_files(paths: Sequence[str]) -> List[str]:
+    files: List[str] = []
+    for p in paths:
+        if os.path.isdir(p):
+            for dirpath, dirnames, fnames in os.walk(p):
+                dirnames[:] = sorted(
+                    d
+                    for d in dirnames
+                    if d not in _SKIP_DIRS and not d.startswith(".")
+                )
+                for fname in sorted(fnames):
+                    if fname.endswith(".py"):
+                        files.append(os.path.join(dirpath, fname))
+        elif p.endswith(".py"):
+            files.append(p)
+    # dedupe, stable order
+    seen = set()
+    out = []
+    for f in files:
+        a = os.path.abspath(f)
+        if a not in seen:
+            seen.add(a)
+            out.append(f)
+    return out
+
+
+def _relpath(path: str) -> str:
+    a = os.path.abspath(path)
+    rel = os.path.relpath(a, os.getcwd())
+    chosen = a if rel.startswith("..") else rel
+    return chosen.replace(os.path.sep, "/")
+
+
+def run_paths(
+    paths: Sequence[str],
+    rules: Optional[Sequence[str]] = None,
+    baseline_path: Optional[str] = None,
+) -> Report:
+    """Analyze ``paths`` (files or directories). ``rules`` limits to a
+    subset of rule ids; ``baseline_path`` points at a grandfather file
+    (None = no baseline). Raises ``KeyError`` on an unknown rule id."""
+    active = (
+        ALL_RULES
+        if rules is None
+        else [RULES_BY_ID[r] for r in rules]
+    )
+    report = Report(rules_run=len(active))
+
+    contexts: List[FileContext] = []
+    for path in _collect_files(paths):
+        rel = _relpath(path)
+        try:
+            with open(path, "r") as f:
+                source = f.read()
+            ctx = FileContext(path, rel, source)
+        except (SyntaxError, UnicodeDecodeError, OSError) as exc:
+            report.blocking.append(
+                Finding(
+                    "parse",
+                    rel,
+                    getattr(exc, "lineno", 0) or 0,
+                    0,
+                    f"unparsable file: {exc}",
+                )
+            )
+            continue
+        contexts.append(ctx)
+    report.files_checked = len(contexts)
+
+    project = ProjectContext(contexts)
+
+    raw: List[Finding] = []
+    for ctx in contexts:
+        # malformed / reason-less suppressions are findings themselves
+        for f in ctx.suppression_findings:
+            raw.append(
+                Finding(f.rule, ctx.relpath, f.line, f.col, f.message)
+            )
+        for rule in active:
+            for f in rule.check(ctx, project):
+                reason = ctx.allowed(f.line, f.rule)
+                if reason is not None:
+                    report.suppressed.append(f)
+                    report.suppress_reasons[f] = reason
+                else:
+                    raw.append(f)
+
+    raw.sort(key=lambda f: (f.path, f.line, f.col, f.rule, f.message))
+
+    if baseline_path is not None:
+        base = baseline_mod.load(baseline_path)
+        blocking, grandfathered = baseline_mod.split(raw, base)
+        report.blocking.extend(blocking)
+        report.baselined.extend(grandfathered)
+    else:
+        report.blocking.extend(raw)
+
+    report.blocking.sort(
+        key=lambda f: (f.path, f.line, f.col, f.rule, f.message)
+    )
+    return report
+
+
+def check_engine(
+    rules: Optional[Sequence[str]] = None,
+    baseline_path: Optional[str] = DEFAULT_BASELINE,
+) -> Report:
+    """Lint the installed ``tpu_cypher`` package — the thin invocation the
+    test suite (and bench.py's ``lint_clean``) uses."""
+    return run_paths([ENGINE_ROOT], rules=rules, baseline_path=baseline_path)
+
+
+def engine_is_clean() -> bool:
+    """True when the engine lints clean. Never raises — bench.py records
+    this on its one guaranteed JSON line even mid-incident."""
+    try:
+        return check_engine().clean
+    except Exception:  # fault-ok: a lint crash must not fail the bench line
+        return False
+
+
+def format_report(report: Report, fmt: str) -> str:
+    if fmt == "json":
+        return json.dumps(report.to_json(), indent=2)
+    return report.render_text()

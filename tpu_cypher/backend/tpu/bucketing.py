@@ -40,12 +40,13 @@ import numpy as np
 
 from ...obs import trace as _obs_trace
 from ...obs.metrics import REGISTRY as _REGISTRY
-from ...utils.config import ConfigOption
+from ...utils.config import BUCKET_MODE as MODE
 
 # off  — no bucketing (every size compiles its own program; seed behavior)
 # pow2 — next power of two at/above _BUCKET_FLOOR (<= 2x memory overhead)
 # 1.25 — geometric lattice of ratio 1.25 (<= 25% overhead, more programs)
-MODE = ConfigOption("TPU_CYPHER_BUCKET", "off", str)
+# (declared in utils/config.py; aliased so bucketing.MODE.set(..) keeps
+# working on the registry-shared object)
 
 # smallest nonzero bucket: tiny intermediates all share one program
 _BUCKET_FLOOR = 32
@@ -169,7 +170,7 @@ def bucket_pad_host(arr: np.ndarray, fill):
 
 # HBM budget for any single materialize's PADDED footprint; 0 = unlimited.
 # Set via env or CypherSession.tpu(memory_budget_bytes=..).
-MEM_BUDGET = ConfigOption("TPU_CYPHER_MEM_BUDGET", 0, int)
+from ...utils.config import MEM_BUDGET  # noqa: E402
 
 
 def memory_budget_bytes() -> int:

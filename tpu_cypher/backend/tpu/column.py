@@ -154,6 +154,7 @@ class Column:
             return False
         if self._beyond_f64 is None:
             big = self.valid_mask() & (jnp.abs(self.data) > 2**53)
+            # tpulint: allow[host-sync] reason=one cached scalar probe per column at compare time; runs inside the ladder's per-attempt fault boundary
             self._beyond_f64 = bool(jnp.any(big))
         return self._beyond_f64
 
@@ -571,6 +572,7 @@ class Column:
     def is_all_null(self) -> bool:
         if self.kind == OBJ:
             return all(v is None for v in self.data)
+        # tpulint: allow[host-sync] reason=one scalar nullness probe at decode/compare time; runs inside the ladder's per-attempt fault boundary
         return self.valid is not None and not bool(jnp.any(self.valid))
 
     def null_like(self, n: int) -> "Column":

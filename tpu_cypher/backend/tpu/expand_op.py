@@ -52,9 +52,9 @@ def _mxu_dense_mode() -> bool:
     for CPU (the native stamping kernels win there; dense N^3 does not).
     ``TPU_CYPHER_MXU_DENSE=force`` enables it anywhere (correctness tests),
     ``=0`` disables."""
-    import os
+    from ...utils.config import MXU_DENSE
 
-    mode = os.environ.get("TPU_CYPHER_MXU_DENSE", "auto")
+    mode = MXU_DENSE.get()
     if mode == "0":
         return False
     if mode in ("1", "force"):
@@ -73,15 +73,15 @@ def _mxu_tiled_enabled() -> bool:
     proven by the forced differential tests — not to outrace the sparse
     tiers at scale. Node gate: ``TPU_CYPHER_MXU_TILED_MAX`` (default
     131072, covers SF10's 100k nodes)."""
-    import os
+    from ...utils.config import MXU_DENSE
 
-    return os.environ.get("TPU_CYPHER_MXU_DENSE", "auto") in ("1", "force")
+    return MXU_DENSE.get() in ("1", "force")
 
 
 def _mxu_tiled_max() -> int:
-    import os
+    from ...utils.config import MXU_TILED_MAX
 
-    return int(os.environ.get("TPU_CYPHER_MXU_TILED_MAX", str(1 << 17)))
+    return int(MXU_TILED_MAX.get())
 
 
 # which MXU tier answered each dense-eligible count — bench.py reports the
@@ -336,6 +336,10 @@ class _FusedExpandBase(RelationalOperator):
         dead) and the compaction itself is bucket-sized."""
         if not self.enforced_pairs or not n_out:
             return row, orig, extras, n_out
+        # the enforcement compact syncs a count on both branches (the
+        # bucketed one inside _mask_to_idx_bucketed): same site as every
+        # other mask compaction
+        fault_point("compact")
         keep = self._enforce_pair_ids(gi, ctx, row, orig)
         if bucketing.enabled():
             if int(row.shape[0]) != n_out:
@@ -345,6 +349,7 @@ class _FusedExpandBase(RelationalOperator):
             return taken[0], taken[1], tuple(taken[2:]), n2
         n2 = int(J.mask_sum(keep))
         if n2 != n_out:
+            # tpulint: allow[pad-invariant] reason=bucketing-off branch only (the enabled branch above routes through _mask_to_idx_bucketed); exact size is the contract here
             idx = J.mask_nonzero(keep, size=n2)
             taken = J.tree_take((row, orig) + tuple(extras), idx)
             row, orig, extras = taken[0], taken[1], tuple(taken[2:])
@@ -650,6 +655,9 @@ class CsrExpandOp(_FusedExpandBase):
         gi.node_ids(ctx)  # build the compact id space (validates the graph)
         if gi.num_nodes == 0:
             return 0
+        # the fused count is an expand-class dispatch: its count syncs sit
+        # behind the expand fault site (injection + deadline coverage)
+        fault_point("expand")
         pairs = _collected_pairs(hops)
         if pairs:
             # rel-uniqueness enforced inside the count: the SpMV carries
@@ -756,6 +764,9 @@ class CsrExpandOp(_FusedExpandBase):
             gi.node_ids(ctx)
             if use_a and use_c and gi.num_nodes >= (1 << 30):
                 return None  # pos*V+pos pair key must stay below the sentinel
+            # eligible from here on: the distinct-count tiers below all
+            # sync, so the expand fault site covers them
+            fault_point("expand")
             pairs = _collected_pairs(hops)
             carry, mask_pairs = frozenset(), {}
             if pairs:
@@ -835,6 +846,7 @@ class CsrExpandOp(_FusedExpandBase):
         pres = J.frontier_multiplicity(pos, present, n=npad) > 0
         m_b = _pad_mask(gi.label_mask(base.far_labels, ctx), npad)
         m_c = _pad_mask(gi.label_mask(final_hop.far_labels, ctx), npad)
+        fault_point("expand")  # the dense-tier count sync below
         MXU_TIER_COUNTS.inc("dense")
         return int(
             J.mxu_distinct_pairs(
@@ -854,6 +866,7 @@ class CsrExpandOp(_FusedExpandBase):
             return None
         pos, present = gi.compact_of(id_col, ctx)
         pres = J.frontier_multiplicity(pos, present, n=t1.npad) > 0
+        fault_point("expand")  # the tiled-tier count sync below
         MXU_TIER_COUNTS.inc("tiled")
         return int(J.mxu_distinct_pairs_tiled(t1, t2, pres, m_b, m_c))
 
@@ -1082,6 +1095,8 @@ class CsrExpandIntoOp(_FusedExpandBase):
             gi.node_ids(ctx)
             if gi.num_nodes >= (1 << 30):
                 return None  # src*N + dst probe key must fit int64
+            # eligible from here on: the close-count tiers below all sync
+            fault_point("expand")
             keys = gi.edge_keys(self.types_key, ctx)
             src_is_base = self.source_fld == base.frontier_fld
             dense = False
@@ -1188,6 +1203,7 @@ class CsrExpandIntoOp(_FusedExpandBase):
         mult = J.frontier_multiplicity(pos, present, n=npad)
         m_b = _pad_mask(gi.label_mask(base.far_labels, ctx), npad)
         m_c = _pad_mask(gi.label_mask(final_hop.far_labels, ctx), npad)
+        fault_point("expand")  # the dense-tier count sync below
         MXU_TIER_COUNTS.inc("dense")
         return int(
             J.mxu_close_count(
@@ -1207,6 +1223,7 @@ class CsrExpandIntoOp(_FusedExpandBase):
             return None
         pos, present = gi.compact_of(id_col, ctx)
         mult = J.frontier_multiplicity(pos, present, n=t1.npad)
+        fault_point("expand")  # the tiled-tier count sync below
         MXU_TIER_COUNTS.inc("tiled")
         return int(J.mxu_close_count_tiled(t1, t2, tc, mult, m_b, m_c))
 
@@ -1580,6 +1597,7 @@ class CsrVarExpandOp(_FusedExpandBase):
             else:
                 k = int(k_dev)
                 if k:
+                    # tpulint: allow[pad-invariant] reason=exact emission gather — pad lanes would enter _assemble_levels' concat as live rows; the recompile driver (the hop program) is bucketed via round_size(total) below
                     idx = J.mask_nonzero(keep, size=k)
                     levels.append(J.tree_take((row00, far), idx))
         bucketed = bucketing.enabled()
@@ -1609,6 +1627,7 @@ class CsrVarExpandOp(_FusedExpandBase):
                 else:
                     k = int(k_dev)
                     if k:
+                        # tpulint: allow[pad-invariant] reason=exact emission gather — pad lanes would enter _assemble_levels' concat as live rows; the hop program above is the bucketed one
                         idx = J.mask_nonzero(keep, size=k)
                         levels.append(J.tree_take((row0, far), idx))
             pos, present = nbr, iso

@@ -37,7 +37,7 @@ from typing import Any, Callable, Dict, Optional, Tuple
 
 from ....obs import trace as _obs_trace
 from ....obs.metrics import REGISTRY as _REGISTRY
-from ....utils.config import ConfigOption
+from ....utils.config import PALLAS_MODE as MODE
 
 try:  # pragma: no cover - availability depends on the jax build
     from jax.experimental import pallas as pl  # noqa: F401
@@ -49,7 +49,7 @@ except Exception:  # pragma: no cover - fault-ok: import probe only
 # auto      — compiled kernels on a TPU backend, jnp fallback elsewhere
 # interpret — interpreted kernels on ANY backend (tests/CPU parity)
 # off       — kernels disabled entirely (today's exact execution path)
-MODE = ConfigOption("TPU_CYPHER_PALLAS", "auto", str)
+# (declared in utils/config.py as TPU_CYPHER_PALLAS)
 
 _VALID_MODES = ("auto", "interpret", "off")
 

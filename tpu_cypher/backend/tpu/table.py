@@ -976,6 +976,9 @@ class TpuTable(Table):
             return None
         if self._nrows == 0:
             return 0
+        # the pushed-down distinct count syncs one scalar: an agg-class
+        # device sync, so it gets the agg fault site (injection + deadline)
+        fault_point("agg")
         on = list(cols)
         datas = tuple(self._cols[c].data for c in on)
         valids = tuple(self._cols[c].valid for c in on)
@@ -1134,6 +1137,7 @@ class TpuTable(Table):
         segment program (``jit_ops.segment_aggregate``) — the TPU analog of
         the engines' shuffle aggregate plus the codegen UDAFs (reference
         ``PercentileUdafs.scala``, ``TemporalUdafs.scala``)."""
+        fault_point("agg")
         data, kind, vocab = col.data, col.kind, col.vocab
         if name == "collect":
             # output is host lists by definition; only this column decodes
@@ -1209,6 +1213,7 @@ class TpuTable(Table):
             # let the oracle raise the proper CypherTypeError
             raise TpuUnsupportedExpr("percentile fraction out of range")
         p = float(p)
+        fault_point("agg")
         data, kind, vocab = col.data, col.kind, col.vocab
         if kind in (OBJ, BOOL, DATE, LDT, DUR):
             # STR stays: percentileDisc over order-preserving dictionary

@@ -38,10 +38,10 @@ import threading
 import time
 from typing import Any, Callable, Dict, Iterator, List, Mapping, Optional, Sequence, Tuple
 
-from ..utils.config import PRINT_TIMINGS, ConfigOption
-
-# JSON-lines sink: when set, per-query metric events append here
-METRICS_FILE = ConfigOption("TPU_CYPHER_METRICS_FILE", "", str)
+# PRINT_TIMINGS: the stage-timing echo flag, ONE declaration shared with
+# the session's timing path; METRICS_FILE: the JSON-lines per-query sink.
+# Both live in the typed registry (utils/config.py).
+from ..utils.config import METRICS_FILE, PRINT_TIMINGS
 
 # schema version stamped on every exported event/snapshot — consumers
 # (the bench driver, log scrapers) key parsing off it

@@ -218,9 +218,9 @@ _BCAST_MAT_CACHE: Dict[Any, Any] = {}
 
 
 def _broadcast_limit() -> int:
-    import os
+    from ..utils.config import BROADCAST_LIMIT
 
-    return int(os.environ.get("TPU_CYPHER_BROADCAST_LIMIT", "4096"))
+    return int(BROADCAST_LIMIT.get())
 
 
 def _bcast_count_fn(mesh, axis):
@@ -347,6 +347,7 @@ def broadcast_join(
     from ..backend.tpu.jit_ops import mask_nonzero, tree_take
 
     total = int(counts_np.sum())
+    # tpulint: allow[pad-invariant] reason=final exact compact of the broadcast-join result (callers take every returned row as live); the materialize capacity above is already on the pow2 lattice
     idx = mask_nonzero(valid, size=total)
     return tree_take((l_out, r_out), idx)
 
@@ -434,6 +435,7 @@ def hash_repartition_join(
     from ..backend.tpu.jit_ops import mask_nonzero, tree_take
 
     total = int(counts_np.sum())
+    # tpulint: allow[pad-invariant] reason=final exact compact of the shuffle-join result (callers take every returned row as live); the per-shard capacities above are already on the pow2 lattice
     idx = mask_nonzero(valid, size=total)
     l_rows, r_rows = tree_take((l_out, r_out), idx)
     return l_rows, r_rows

@@ -217,7 +217,7 @@ class ScanGraph(RelationalCypherGraph):
             cache = {}
             try:
                 object.__setattr__(ctx, "_scan_op_cache", cache)
-            except Exception:  # pragma: no cover - exotic frozen context
+            except Exception:  # pragma: no cover - fault-ok: exotic frozen context, cache disabled
                 cache = None
         key = (id(self), var_name, ct)
         if cache is not None and key in cache:

@@ -57,7 +57,7 @@ def _plan_children(op: O.RelationalOperator):
 def _mention_var_exprs(m: Set[E.Expr], h, name: str) -> None:
     try:
         v = h.var(name)
-    except Exception:
+    except Exception:  # fault-ok: plan-time header probe, no device work at plan time
         return
     m.update(h.expressions_for(v))
 
@@ -84,7 +84,7 @@ def _mention_enforced_pairs(m: Set[E.Expr], op, h) -> None:
                 continue
             try:
                 m.add(h.id_expr(h.var(r)))
-            except Exception:
+            except Exception:  # fault-ok: plan-time expression probe, host-only
                 pass
 
 
@@ -118,7 +118,7 @@ def _local_mentions(op: O.RelationalOperator) -> Set[E.Expr]:
         for f in op.fields:
             try:
                 v = op.header.var(f)
-            except Exception:
+            except Exception:  # fault-ok: plan-time header probe, host-only
                 continue
             mt = v.cypher_type.material if v.cypher_type is not None else None
             if isinstance(
@@ -127,7 +127,7 @@ def _local_mentions(op: O.RelationalOperator) -> Set[E.Expr]:
                 try:
                     m.add(op.header.id_expr(v))
                     continue
-                except Exception:
+                except Exception:  # fault-ok: plan-time id-expr probe, host-only
                     pass
             _mention_var_exprs(m, op.header, f)
     elif isinstance(op, O.AggregateOp):
@@ -142,7 +142,7 @@ def _local_mentions(op: O.RelationalOperator) -> Set[E.Expr]:
             try:
                 v = op.header.var(f)
                 m.add(op.header.id_expr(v))
-            except Exception:
+            except Exception:  # fault-ok: plan-time header probe, host-only
                 m.update(op.header.expressions)
     elif isinstance(op, O.JoinOp):
         for le, re_ in op.join_exprs:
@@ -157,7 +157,7 @@ def _local_mentions(op: O.RelationalOperator) -> Set[E.Expr]:
         h = op.children[0].header
         try:
             m.add(h.id_expr(h.var(op.frontier_fld)))
-        except Exception:
+        except Exception:  # fault-ok: plan-time header probe, host-only
             m.update(h.expressions)
         _mention_enforced_pairs(m, op, h)
     elif isinstance(op, CsrExpandIntoOp):
@@ -165,7 +165,7 @@ def _local_mentions(op: O.RelationalOperator) -> Set[E.Expr]:
         for f in (op.source_fld, op.target_fld):
             try:
                 m.add(h.id_expr(h.var(f)))
-            except Exception:
+            except Exception:  # fault-ok: plan-time header probe, host-only
                 m.update(h.expressions)
         _mention_enforced_pairs(m, op, h)
     elif isinstance(op, CsrVarExpandOp):
@@ -251,7 +251,7 @@ def prune_fused_columns(root: O.RelationalOperator) -> O.RelationalOperator:
     """Apply requirement-flow pruning to fused expand ops (no-op without any)."""
     try:
         from ..backend.tpu.expand_op import _FusedExpandBase
-    except Exception:  # backend not importable: nothing to prune
+    except Exception:  # fault-ok: backend not importable, nothing to prune
         return root
     ops: List[O.RelationalOperator] = []
     seen: Set[int] = set()

@@ -27,6 +27,7 @@ from ..logical.optimizer import optimize as optimize_logical
 from ..logical.planner import LogicalPlannerContext, plan_logical
 from ..obs import metrics as OM
 from ..obs import trace as OT
+from ..utils import config as _config
 from .graphs import (
     ElementTable,
     EmptyGraph,
@@ -491,9 +492,7 @@ class CypherSession:
         # persistent compilation cache: the disk tier under the in-process
         # jit caches, so warm programs survive process restarts. Option
         # wins; the env var covers deployments that cannot touch code.
-        cache_dir = persistent_cache_dir or os.environ.get(
-            "TPU_CYPHER_COMPILE_CACHE_DIR"
-        )
+        cache_dir = persistent_cache_dir or _config.COMPILE_CACHE_DIR.get()
         if cache_dir:
             bucketing.enable_persistent_cache(cache_dir)
         self._catalog: Dict[str, RelationalCypherGraph] = {}

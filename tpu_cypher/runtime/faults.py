@@ -9,7 +9,7 @@ specs):
 
 * ``kind``  — ``oom`` | ``compile`` | ``lost`` | ``timeout``
 * ``site``  — a named fault site (``join``, ``expand``, ``var_expand``,
-  ``filter``, ``compact``, ``shuffle``, plus the Pallas kernel-tier sites
+  ``filter``, ``compact``, ``shuffle``, ``agg``, plus the Pallas kernel-tier sites
   ``kernel_join``/``kernel_expand``/``kernel_agg``/``kernel_frontier``
   fired by ``backend.tpu.pallas.dispatch.launch`` just before a kernel
   launch; grep ``fault_point(`` and ``dispatch.register(`` for the full
@@ -39,15 +39,15 @@ status markers jaxlib uses) so they flow through ``tpu_cypher.errors
 
 from __future__ import annotations
 
-import os
 import threading
 from typing import Dict, List, Optional, Tuple
 
 from ..errors import QueryTimeout
 from ..obs import trace as _obs_trace
 from ..obs.metrics import REGISTRY as _REGISTRY
+from ..utils.config import FAULTS as _FAULTS
 
-ENV = "TPU_CYPHER_FAULTS"
+ENV = _FAULTS.name
 
 # per-site invocation counts, served by the unified obs registry — sites
 # are exactly the engine's device sync points, so this series doubles as
@@ -154,7 +154,7 @@ def counters() -> Dict[str, int]:
 
 def _active_spec() -> Dict[str, List[Tuple[str, int, int]]]:
     global _parse_cache
-    raw = _override if _override is not None else os.environ.get(ENV)
+    raw = _override if _override is not None else (_FAULTS.get() or None)
     if not raw:
         return {}
     cached_raw, cached = _parse_cache

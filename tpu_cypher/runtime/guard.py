@@ -28,7 +28,7 @@ from typing import Optional
 
 from ..errors import QueryTimeout
 from ..obs.metrics import REGISTRY as _REGISTRY
-from ..utils.config import ConfigOption
+from ..utils.config import CHUNK_ROWS, DEADLINE_S, LADDER_MODE
 
 # ladder rungs, in degradation order (docs/robustness.md)
 RUNG_DEVICE = "device"
@@ -38,15 +38,10 @@ RUNG_HOST = "host-oracle"  # full local-backend re-execution
 
 LADDER = (RUNG_DEVICE, RUNG_BUCKET_EXACT, RUNG_CHUNKED, RUNG_HOST)
 
-# "on" (default): classified faults degrade-and-retry down the ladder;
-# "off": the typed error raises to the caller after the first rung
-LADDER_MODE = ConfigOption("TPU_CYPHER_LADDER", "on", str)
-
-# rows per gather slice at the chunked rung
-CHUNK_ROWS = ConfigOption("TPU_CYPHER_CHUNK_ROWS", 65536, int)
-
-# 0 = no deadline; session option overrides the env
-DEADLINE_S = ConfigOption("TPU_CYPHER_QUERY_DEADLINE_S", 0.0, float)
+# LADDER_MODE ("on": degrade-and-retry; "off": first-rung errors raise),
+# CHUNK_ROWS (rows per gather slice at the chunked rung), and DEADLINE_S
+# (0 = none; session option overrides the env) are declared in the typed
+# registry (utils/config.py) and aliased here for their call sites.
 
 # which ladder rungs actually executed, fleet-wide (the per-query view is
 # the ``execute`` trace span's ``rung`` attr and ``result.execution_log``)

@@ -106,7 +106,7 @@ class TckRunner:
             return ScenarioResult(scenario, True)
         except AssertionError as e:
             return ScenarioResult(scenario, False, f"assertion: {e}")
-        except Exception as e:
+        except Exception as e:  # fault-ok: scenario verdict — the failure IS the recorded result
             return ScenarioResult(scenario, False, f"{type(e).__name__}: {e}")
 
     def _run_steps(self, scenario: Scenario):
@@ -162,7 +162,7 @@ class TckRunner:
                     res = graph.cypher(step.docstring, dict(parameters))
                     records = res.records
                     result = list(records.collect()) if records is not None else []
-                except Exception as e:  # noqa: BLE001 — error steps assert on this
+                except Exception as e:  # noqa: BLE001 — fault-ok: error steps assert on this
                     error = e
             elif low.startswith("the result should be empty"):
                 self._require_no_error(error)

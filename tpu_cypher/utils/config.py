@@ -1,23 +1,43 @@
-"""Typed config flags backed by environment variables.
+"""Typed config registry: every ``TPU_CYPHER_*`` knob, declared ONCE.
 
 Re-design of the reference's ``ConfigOption``/``ConfigFlag`` system
-(``okapi-api/.../impl/configuration/ConfigOption.scala:31-60``; per-layer flag
-objects like ``CoraConfiguration.scala:33-39``): JVM system properties become
-environment variables with in-process overrides."""
+(``okapi-api/.../impl/configuration/ConfigOption.scala:31-60``; per-layer
+flag objects like ``CoraConfiguration.scala:33-39``): JVM system properties
+become environment variables with in-process overrides.
+
+PRs 1-4 grew knobs organically — ``ConfigOption``s declared in six modules
+plus raw ``os.environ`` reads in four more, with one var
+(``TPU_CYPHER_PRINT_TIMINGS``) read through two different paths. This
+module is now the SINGLE declaration point: ``declare``/``declare_flag``
+register each option in ``REGISTRY`` so the engine's whole configuration
+surface is enumerable (``options()``), and the ``env-var-registry`` lint
+rule (``tpu_cypher.analysis``) fails any raw ``TPU_CYPHER_*`` read or any
+``ConfigOption`` constructed outside this file. Engine modules import
+their options from here (often under a local alias, e.g.
+``bucketing.MODE is config.BUCKET_MODE``) so existing ``MODE.set(..)``
+call sites keep working on the same object.
+"""
 
 from __future__ import annotations
 
 import os
-from typing import Callable, Generic, Optional, TypeVar
+from typing import Callable, Dict, Generic, Mapping, Optional, TypeVar
 
 T = TypeVar("T")
 
 
 class ConfigOption(Generic[T]):
-    def __init__(self, name: str, default: T, parse: Callable[[str], T]):
+    def __init__(
+        self,
+        name: str,
+        default: T,
+        parse: Callable[[str], T],
+        help: str = "",
+    ):
         self.name = name
         self.default = default
         self.parse = parse
+        self.help = help
         self._override: Optional[T] = None
 
     def get(self) -> T:
@@ -37,20 +57,165 @@ class ConfigOption(Generic[T]):
     def reset(self):
         self._override = None
 
+    def __repr__(self) -> str:  # pragma: no cover - debugging nicety
+        return f"ConfigOption({self.name!r}, default={self.default!r})"
+
 
 def _parse_bool(s: str) -> bool:
     return s.strip().lower() in ("1", "true", "yes", "on")
 
 
 class ConfigFlag(ConfigOption[bool]):
-    def __init__(self, name: str, default: bool = False):
-        super().__init__(name, default, _parse_bool)
+    def __init__(self, name: str, default: bool = False, help: str = ""):
+        super().__init__(name, default, _parse_bool, help=help)
 
 
-# per-stage debug flags (reference PrintTimings / PrintIr / PrintLogicalPlan /
-# PrintRelationalPlan / PrintOptimizedRelationalPlan, Configuration.scala:36,
-# CoraConfiguration.scala:33-39)
-PRINT_TIMINGS = ConfigFlag("TPU_CYPHER_PRINT_TIMINGS")
-PRINT_IR = ConfigFlag("TPU_CYPHER_PRINT_IR")
-PRINT_LOGICAL = ConfigFlag("TPU_CYPHER_PRINT_LOGICAL_PLAN")
-PRINT_RELATIONAL = ConfigFlag("TPU_CYPHER_PRINT_RELATIONAL_PLAN")
+# ---------------------------------------------------------------------------
+# the registry
+# ---------------------------------------------------------------------------
+
+REGISTRY: Dict[str, ConfigOption] = {}
+
+
+def declare(
+    name: str,
+    default: T,
+    parse: Callable[[str], T],
+    help: str = "",
+) -> ConfigOption[T]:
+    """Declare one typed env-backed option. Idempotent per name (repeat
+    declarations return the first object so every importer shares override
+    state); the name must carry the engine prefix."""
+    if name in REGISTRY:
+        return REGISTRY[name]
+    opt = ConfigOption(name, default, parse, help=help)
+    REGISTRY[name] = opt
+    return opt
+
+
+def declare_flag(name: str, default: bool = False, help: str = "") -> ConfigFlag:
+    if name in REGISTRY:
+        return REGISTRY[name]  # type: ignore[return-value]
+    opt = ConfigFlag(name, default, help=help)
+    REGISTRY[name] = opt
+    return opt
+
+
+def options() -> Mapping[str, ConfigOption]:
+    """Every declared option, by env var name — the engine's enumerable
+    configuration surface."""
+    return dict(REGISTRY)
+
+
+# ---------------------------------------------------------------------------
+# declarations: the engine's whole TPU_CYPHER_* surface
+# ---------------------------------------------------------------------------
+
+# per-stage debug flags (reference PrintTimings / PrintIr / PrintLogicalPlan
+# / PrintRelationalPlan, Configuration.scala:36, CoraConfiguration.scala:33-39)
+PRINT_TIMINGS = declare_flag(
+    "TPU_CYPHER_PRINT_TIMINGS", help="echo per-stage wall timings to stdout"
+)
+PRINT_IR = declare_flag("TPU_CYPHER_PRINT_IR", help="dump the query IR")
+PRINT_LOGICAL = declare_flag(
+    "TPU_CYPHER_PRINT_LOGICAL_PLAN", help="dump the logical plan"
+)
+PRINT_RELATIONAL = declare_flag(
+    "TPU_CYPHER_PRINT_RELATIONAL_PLAN", help="dump the relational plan"
+)
+
+# shape bucketing + memory admission (backend/tpu/bucketing.py)
+BUCKET_MODE = declare(
+    "TPU_CYPHER_BUCKET",
+    "off",
+    str,
+    help="materialize-size bucket lattice: off | pow2 | 1.25",
+)
+MEM_BUDGET = declare(
+    "TPU_CYPHER_MEM_BUDGET",
+    0,
+    int,
+    help="HBM budget (bytes) for any single padded materialize; 0 = off",
+)
+
+# execution guard / degrade-and-retry ladder (runtime/guard.py)
+LADDER_MODE = declare(
+    "TPU_CYPHER_LADDER", "on", str, help="degrade-and-retry ladder: on | off"
+)
+CHUNK_ROWS = declare(
+    "TPU_CYPHER_CHUNK_ROWS",
+    65536,
+    int,
+    help="row slice size at the chunked-gather ladder rung",
+)
+DEADLINE_S = declare(
+    "TPU_CYPHER_QUERY_DEADLINE_S",
+    0.0,
+    float,
+    help="per-query wall deadline in seconds; 0 = none",
+)
+
+# deterministic fault injection (runtime/faults.py)
+FAULTS = declare(
+    "TPU_CYPHER_FAULTS",
+    "",
+    str,
+    help="fault schedule: kind@site[:n|:a-b|:*], comma-separated",
+)
+
+# Pallas kernel tier (backend/tpu/pallas/dispatch.py)
+PALLAS_MODE = declare(
+    "TPU_CYPHER_PALLAS", "auto", str, help="kernel tier: auto | interpret | off"
+)
+
+# MXU dense-expand tiers (backend/tpu/expand_op.py)
+MXU_DENSE = declare(
+    "TPU_CYPHER_MXU_DENSE",
+    "auto",
+    str,
+    help="dense MXU expand: auto | 1 | force | off",
+)
+MXU_TILED_MAX = declare(
+    "TPU_CYPHER_MXU_TILED_MAX",
+    1 << 17,
+    int,
+    help="node-count ceiling for the tiled MXU close-count tier",
+)
+
+# sharded shuffle (parallel/shuffle.py)
+BROADCAST_LIMIT = declare(
+    "TPU_CYPHER_BROADCAST_LIMIT",
+    4096,
+    int,
+    help="max rows broadcast to every shard instead of hash-shuffled",
+)
+
+# compiler diagnostics (backend/tpu/compiler.py)
+ISLAND_WARN_ROWS = declare(
+    "TPU_CYPHER_ISLAND_WARN_ROWS",
+    1_000_000,
+    int,
+    help="row count above which a cartesian island emits a warning",
+)
+
+# persistent compile cache (relational/session.py)
+COMPILE_CACHE_DIR = declare(
+    "TPU_CYPHER_COMPILE_CACHE_DIR",
+    "",
+    str,
+    help="persistent XLA compile cache directory; empty = disabled",
+)
+
+# observability (obs/metrics.py, utils/profiling.py, obs/trace.py)
+METRICS_FILE = declare(
+    "TPU_CYPHER_METRICS_FILE",
+    "",
+    str,
+    help="JSON-lines per-query event sink; empty = disabled",
+)
+PROFILE_DIR = declare(
+    "TPU_CYPHER_PROFILE_DIR",
+    "",
+    str,
+    help="jax.profiler trace directory; also annotates spans",
+)

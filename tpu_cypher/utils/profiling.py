@@ -18,9 +18,7 @@ import contextlib
 import os
 from typing import Any, Callable, Optional
 
-from .config import ConfigOption
-
-PROFILE_DIR = ConfigOption("TPU_CYPHER_PROFILE_DIR", "", str)
+from .config import PROFILE_DIR
 
 
 @contextlib.contextmanager
@@ -36,7 +34,7 @@ def profile_trace(log_dir: Optional[str] = None):
         import jax
 
         jax.profiler.start_trace(d)
-    except Exception:  # pragma: no cover - no jax, double-start, unsupported
+    except Exception:  # pragma: no cover - fault-ok: profiler start is best-effort (no jax, double-start)
         yield
         return
     try:
@@ -44,7 +42,7 @@ def profile_trace(log_dir: Optional[str] = None):
     finally:
         try:
             jax.profiler.stop_trace()
-        except Exception:  # pragma: no cover
+        except Exception:  # pragma: no cover - fault-ok: best-effort profiler stop
             pass
 
 
@@ -53,6 +51,7 @@ def lowered_hlo(fn: Callable, *args: Any, **kw: Any) -> str:
     plan introspection analog of the reference's ``tableEnv.explain``."""
     import jax
 
+    # tpulint: allow[recompile-hazard] reason=one-shot plan introspection, not on the query path
     return jax.jit(fn).lower(*args, **kw).as_text()
 
 
@@ -60,6 +59,7 @@ def compiled_hlo(fn: Callable, *args: Any, **kw: Any) -> str:
     """Post-XLA-optimization HLO (what actually runs on the device)."""
     import jax
 
+    # tpulint: allow[recompile-hazard] reason=one-shot HLO dump for diagnostics, not on the query path
     compiled = jax.jit(fn).lower(*args, **kw).compile()
     return "\n".join(m.to_string() for m in compiled.runtime_executable().hlo_modules())
 
