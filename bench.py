@@ -252,6 +252,35 @@ def _lint_clean() -> bool:
     return engine_is_clean()
 
 
+def _serve_soak() -> dict:
+    """Serving-layer health for the trajectory: a short non-chaos soak of
+    the multi-tenant query server (tests/soak_serve.py — concurrent
+    clients, admission scheduling, micro-batching) distilled to the four
+    numbers that regress: qps, p99_ms, recompiles_after_warmup (must stay
+    0: the serving layer adds no shape churn), batched_dispatch_ratio
+    (must stay > 0: bursts still coalesce). Like ``lint_clean``, never
+    raises — a broken server reports {"error": ...} in the same JSON
+    line instead of killing the bench."""
+    try:
+        tests_dir = os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), "tests"
+        )
+        if tests_dir not in sys.path:
+            sys.path.insert(0, tests_dir)
+        import soak_serve
+
+        rep = soak_serve.main(budget_s=4.0, clients=24, chaos=False)
+        return {
+            "qps": rep["qps"],
+            "p99_ms": rep["p99_ms"],
+            "recompiles_after_warmup": rep["recompiles_after_warmup"],
+            "batched_dispatch_ratio": rep["batched_dispatch_ratio"],
+            "failures": rep["failures"],
+        }
+    except Exception as exc:  # fault-ok: telemetry only
+        return {"error": str(exc)[:200]}
+
+
 def _time_query(g, query, params=None, repeats=3):
     """Median wall time of a warmed query (warmup compiles + builds CSR)
     plus WHICH tier answered (MXU dense/tiled, native C++, or the device
@@ -530,6 +559,10 @@ def main():
         # analyzer health rides the trajectory: False here means a rung ran
         # with unsuppressed invariant violations (tpu_cypher.analysis)
         "lint_clean": _lint_clean(),
+        # serving-layer health (multi-tenant query server): qps/p99 of a
+        # short concurrent soak + the two regression tripwires
+        # (recompiles_after_warmup, batched_dispatch_ratio)
+        "serve_soak": _serve_soak(),
         "probe_log": probe_log,
     }
     print(json.dumps(result))

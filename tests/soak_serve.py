@@ -1,0 +1,230 @@
+"""Serving-layer soak: N concurrent clients against one warm query server.
+
+Not collected by pytest (no ``test_`` prefix) — run directly, like
+``fuzz_soak.py``:
+
+    JAX_PLATFORMS=cpu python tests/soak_serve.py [seconds] [clients] [--faults]
+
+Defaults: 20 s x 100 clients. Every client keeps exactly one query in
+flight over its own TCP connection, drawing from a mixed TCK-shaped
+corpus (counts, filtered scans, multi-hop expands, parameterized lookups,
+ORDER BY/LIMIT, OPTIONAL MATCH); a 100-client run therefore sustains 100
+concurrent queries against the admission scheduler end-to-end.
+
+Checked per query: the streamed rows must be byte-identical (JSON wire
+form) to serial in-process execution of the same query on the same
+session — degrade-ladder rungs included. Reported at the end, one JSON
+line prefixed ``SERVE_SOAK``:
+
+    {"queries", "failures", "qps", "p50_ms", "p99_ms",
+     "recompiles_after_warmup", "batched_dispatch_ratio", "chaos"}
+
+* ``recompiles_after_warmup`` — XLA compile delta across the whole soak
+  (the corpus is warmed first); MUST be 0 in non-chaos runs and is
+  allowed to be nonzero under chaos (degraded rungs compile their own
+  programs: bucket-exact/chunked shapes are new by design).
+* ``batched_dispatch_ratio`` — batched dispatches / all dispatches; > 0
+  proves same-bucket bursts coalesced into shared device work.
+* ``--faults`` — chaos mode: ~1/3 of submits carry a random
+  ``TPU_CYPHER_FAULTS``-grammar spec, scoped to that client's query only
+  (``faults.scoped_spec`` via the server); results must STILL match the
+  serial goldens and p99 stays bounded while neighbors degrade.
+
+``bench.py`` imports ``main()`` for its ``serve_soak`` summary field.
+"""
+
+import asyncio
+import json
+import os
+import sys
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault("JAX_ENABLE_X64", "1")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# (query, {param: [values to draw from]} | None) — TCK-shaped mix; every
+# entry is plan-cacheable so bursts can share dispatches
+CORPUS = [
+    ("MATCH (a:P) RETURN count(a) AS n", None),
+    ("MATCH (a:P)-[:K]->(b:P) RETURN count(b) AS n", None),
+    ("MATCH (a:P) WHERE a.id >= 10 RETURN count(a) AS n", None),
+    ("MATCH (a:P) RETURN a.id AS id ORDER BY id LIMIT 7", None),
+    ("MATCH (a:P)-[:K]->(b:P)-[:K]->(c:P) RETURN count(c) AS n", None),
+    ("OPTIONAL MATCH (a:P {id: -1})-[:K]->(b:P) RETURN count(b) AS n", None),
+    ("MATCH (a:P {id: $i})-[:K]->(b:P) RETURN b.id AS id ORDER BY id",
+     {"i": [0, 1, 2, 3]}),
+    ("MATCH (a:P)-[:K]->(b:P) WHERE b.id < $x RETURN count(*) AS c",
+     {"x": [8, 24]}),
+]
+
+FAULT_SITES = ("join", "expand", "filter", "compact", "agg")
+FAULT_KINDS = ("oom", "compile", "lost")
+
+
+def _build_graph(session, n=48):
+    parts = [f"(n{i}:P {{id: {i}}})" for i in range(n)]
+    parts += [f"(n{i})-[:K]->(n{(i + 1) % n})" for i in range(n)]
+    parts += [f"(n{i})-[:K]->(n{(i + 11) % n})" for i in range(n)]
+    return session.create_graph_from_create_query("CREATE " + ", ".join(parts))
+
+
+def _combos():
+    """Flatten the corpus into concrete (query, params) submissions."""
+    out = []
+    for q, space in CORPUS:
+        if not space:
+            out.append((q, {}))
+            continue
+        key = next(iter(space))
+        for v in space[key]:
+            out.append((q, {key: v}))
+    return out
+
+
+def _random_fault_spec(rng) -> str:
+    site = FAULT_SITES[int(rng.integers(0, len(FAULT_SITES)))]
+    kind = FAULT_KINDS[int(rng.integers(0, len(FAULT_KINDS)))]
+    occ = "*" if rng.random() < 0.25 else str(int(rng.integers(1, 3)))
+    return f"{kind}@{site}:{occ}"
+
+
+async def _client(i, host, port, t_end, combos, goldens, rng, chaos, stats):
+    reader, writer = await asyncio.open_connection(host, port)
+    tenant = f"t{i % 4}"
+    k = 0
+    try:
+        while time.monotonic() < t_end:
+            q, params = combos[int(rng.integers(0, len(combos)))]
+            qid = f"c{i}-{k}"
+            k += 1
+            sub = {"op": "submit", "id": qid, "graph": "soak", "query": q,
+                   "parameters": params, "tenant": tenant}
+            if chaos and rng.random() < 0.33:
+                sub["faults"] = _random_fault_spec(rng)
+            t0 = time.perf_counter()
+            writer.write((json.dumps(sub) + "\n").encode())
+            await writer.drain()
+            rows, terminal = [], None
+            while terminal is None:
+                raw = await asyncio.wait_for(reader.readline(), 60)
+                if not raw:
+                    terminal = {"type": "error", "error": "disconnect"}
+                    break
+                m = json.loads(raw)
+                if m.get("id") != qid:
+                    continue
+                if m["type"] == "rows":
+                    rows.extend(m["rows"])
+                elif m["type"] in ("done", "error", "cancelled"):
+                    terminal = m
+            stats["latencies"].append(time.perf_counter() - t0)
+            stats["queries"] += 1
+            if terminal.get("type") != "done":
+                stats["failures"] += 1
+                stats["errors"].append(
+                    f"{qid} {q!r}: {terminal.get('error')}: "
+                    f"{terminal.get('message', '')[:200]}"
+                )
+            elif json.dumps(rows, sort_keys=True) != goldens[(q, _pkey(params))]:
+                stats["failures"] += 1
+                stats["errors"].append(
+                    f"{qid} {q!r} params={params}: rows diverged from serial"
+                )
+            elif terminal.get("batched", 1) > 1:
+                stats["batched_queries"] += 1
+    finally:
+        writer.close()
+
+
+def _pkey(params):
+    return tuple(sorted(params.items()))
+
+
+def main(budget_s: float = 20.0, clients: int = 100, chaos: bool = False,
+         seed: int = 0, batch_window_ms: float = 5.0,
+         max_concurrent: int = 8) -> dict:
+    import numpy as np
+
+    from tpu_cypher.backend.tpu import bucketing
+    from tpu_cypher.relational.session import CypherSession
+    from tpu_cypher.serve import QueryServer
+    from tpu_cypher.serve.batching import DISPATCHES
+    from tpu_cypher.serve.server import _encode_rows
+
+    session = CypherSession.tpu()
+    graph = _build_graph(session)
+    combos = _combos()
+
+    # serial goldens double as warmup: every corpus shape compiles here,
+    # so the soak itself must add zero compiles (non-chaos)
+    goldens = {}
+    for q, params in combos:
+        records = graph.cypher(q, params).records
+        goldens[(q, _pkey(params))] = json.dumps(
+            _encode_rows(records.collect(), records.columns), sort_keys=True
+        )
+
+    async def run():
+        server = QueryServer(
+            session, port=0, max_concurrent=max_concurrent,
+            batch_window_ms=batch_window_ms,
+        )
+        server.register_graph("soak", graph)
+        stats = {"queries": 0, "failures": 0, "batched_queries": 0,
+                 "latencies": [], "errors": []}
+        disp_before = {
+            lbl["batched"]: int(v) for lbl, v in DISPATCHES.items()
+        }
+        compiles_before = bucketing.compile_snapshot()
+        t0 = time.monotonic()
+        async with server:
+            await asyncio.gather(*[
+                _client(i, server.host, server.port, t0 + budget_s, combos,
+                        goldens, np.random.default_rng(seed + i), chaos,
+                        stats)
+                for i in range(clients)
+            ])
+        elapsed = time.monotonic() - t0
+        disp_after = {lbl["batched"]: int(v) for lbl, v in DISPATCHES.items()}
+        disp = {
+            k: disp_after.get(k, 0) - disp_before.get(k, 0)
+            for k in ("true", "false")
+        }
+        total_disp = max(disp["true"] + disp["false"], 1)
+        lat_ms = np.asarray(stats["latencies"]) * 1000.0
+        return {
+            "queries": stats["queries"],
+            "failures": stats["failures"],
+            "clients": clients,
+            "qps": round(stats["queries"] / max(elapsed, 1e-9), 1),
+            "p50_ms": round(float(np.percentile(lat_ms, 50)), 2) if len(lat_ms) else None,
+            "p99_ms": round(float(np.percentile(lat_ms, 99)), 2) if len(lat_ms) else None,
+            "recompiles_after_warmup": int(
+                bucketing.compile_delta(compiles_before)["compiles"]
+            ),
+            "batched_dispatch_ratio": round(disp["true"] / total_disp, 4),
+            "batched_queries": stats["batched_queries"],
+            "chaos": chaos,
+            "errors": stats["errors"][:10],
+        }
+
+    return asyncio.run(run())
+
+
+if __name__ == "__main__":
+    args = [a for a in sys.argv[1:] if a != "--faults"]
+    chaos = "--faults" in sys.argv[1:]
+    budget = float(args[0]) if len(args) > 0 else 20.0
+    clients = int(args[1]) if len(args) > 1 else 100
+    report = main(budget, clients, chaos=chaos)
+    errors = report.pop("errors")
+    print("SERVE_SOAK " + json.dumps(report))
+    for e in errors:
+        print("  " + e)
+    bad = report["failures"] > 0
+    if not chaos and report["recompiles_after_warmup"] > 0:
+        print("FAIL: recompiles after warmup in a non-chaos soak")
+        bad = True
+    sys.exit(1 if bad else 0)

@@ -53,6 +53,20 @@ def test_two_process_distributed_engine_query():
     same materialized row values; dryrun_multihost itself asserts both
     against numpy ground truth, so a REPORT line means the engine ran
     correctly across process boundaries."""
+    import pytest
+
+    if jax.default_backend() == "cpu":
+        # jax's CPU backend has no cross-process collective runtime
+        # ("Multiprocess computations aren't implemented on the CPU
+        # backend") — the wiring is still covered here by
+        # test_dryrun_multihost_engine_query (single-process degenerate
+        # form) and on real hardware by the MULTICHIP dryrun path
+        # (``parallel.multihost.dryrun_multihost`` via the driver's
+        # MULTICHIP artifact — see ROADMAP.md).
+        pytest.skip(
+            "two-process collectives need a non-CPU backend; "
+            "single-process dryrun covers the wiring on CPU"
+        )
     from multihost_worker import spawn_two_process
 
     results = spawn_two_process(29600 + (os.getpid() % 200))

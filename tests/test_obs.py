@@ -259,6 +259,147 @@ def test_metric_scopes_are_context_local_and_nested():
 
 
 # ---------------------------------------------------------------------------
+# asyncio isolation (the serving layer's concurrency model: interleaved
+# coroutines + fresh-context worker threads, tpu_cypher/serve/)
+# ---------------------------------------------------------------------------
+
+
+def test_asyncio_tasks_do_not_share_request_deadlines():
+    """Each asyncio task snapshots the context at creation: a request
+    deadline opened in one coroutine must be invisible to interleaved
+    neighbors, and concurrent scopes keep their own values."""
+    import asyncio
+
+    async def scoped(seconds, settle):
+        with guard.request_deadline(seconds):
+            await asyncio.sleep(settle)  # others interleave while open
+            return guard.request_deadline_s()
+
+    async def unscoped():
+        await asyncio.sleep(0.005)
+        return guard.request_deadline_s()
+
+    async def main():
+        return await asyncio.gather(
+            scoped(5.0, 0.02), unscoped(), scoped(0.5, 0.01)
+        )
+
+    a, none, c = asyncio.run(main())
+    assert a == 5.0 and none is None and c == 0.5
+
+
+def test_asyncio_tasks_have_private_fault_schedules():
+    """Two chaos-scoped coroutines with the SAME ``:1`` spec must EACH see
+    their own first-invocation window fire (private occurrence counters),
+    while an interleaved clean query stays on the device rung."""
+    import asyncio
+
+    s = CypherSession.tpu()
+    g = _chain_graph(s)
+    q = "MATCH (a:P)-[:K]->(b:P) RETURN count(*) AS c"
+
+    async def run_query(spec):
+        with faults.scoped_spec(spec):
+            await asyncio.sleep(0.01)  # interleave while the scope is open
+            r = g.cypher(q)
+            r.records.collect()
+            return [e["rung"] for e in r.execution_log]
+
+    async def main():
+        return await asyncio.gather(
+            run_query("oom@expand:1"), run_query(None),
+            run_query("oom@expand:1"),
+        )
+
+    chaotic1, clean, chaotic2 = asyncio.run(main())
+    assert chaotic1[0] == guard.RUNG_DEVICE
+    assert len(chaotic1) > 1  # the injected fault degraded the ladder
+    # a SHARED counter would put the second scope's first invocation at
+    # n=2, outside its :1 window — private counters fire both
+    assert chaotic2 == chaotic1
+    assert clean == [guard.RUNG_DEVICE]
+
+
+def test_asyncio_tasks_have_isolated_metric_scopes():
+    import asyncio
+
+    reg = OM.MetricsRegistry()
+    c = reg.counter("t_async_events_total", labels=("who",))
+
+    async def worker(who, n):
+        with reg.scope() as sc:
+            for _ in range(n):
+                c.inc(who=who)
+                await asyncio.sleep(0)  # yield between increments
+            return dict(sc.label_counts("t_async_events_total", "who"))
+
+    async def main():
+        return await asyncio.gather(worker("a", 3), worker("b", 5))
+
+    a, b = asyncio.run(main())
+    assert a == {"a": 3.0}
+    assert b == {"b": 5.0}
+
+
+def test_asyncio_fallback_scopes_do_not_leak():
+    import asyncio
+
+    from tpu_cypher.backend.tpu.table import FALLBACK_COUNTER
+
+    async def worker(record):
+        with FALLBACK_COUNTER.scope() as events:
+            await asyncio.sleep(0.005)
+            if record:
+                FALLBACK_COUNTER.record("t-async-leak-probe")
+            await asyncio.sleep(0.005)
+            return dict(events)
+
+    async def main():
+        return await asyncio.gather(worker(True), worker(False))
+
+    recorded, silent = asyncio.run(main())
+    assert recorded.get("t-async-leak-probe") == 1
+    assert "t-async-leak-probe" not in silent
+
+
+def test_asyncio_fresh_context_execution_isolates_span_trees():
+    """The serving layer's execution primitive (``SessionPool.run``: a
+    worker thread inside a FRESH contextvars.Context) keeps concurrent
+    queries' span trees disjoint — driven from one event loop, as the
+    server drives it."""
+    import asyncio
+
+    from tpu_cypher.serve import SessionPool
+
+    s = CypherSession.tpu()
+    g = _chain_graph(s)
+    pool = SessionPool(s, workers=4)
+
+    def exec_one(q):
+        r = g.cypher(q)
+        r.records.collect()
+        return r
+
+    async def main():
+        return await asyncio.gather(
+            *[pool.run(lambda q=q: exec_one(q))
+              for q in (THREE_HOP, "MATCH (a:P) RETURN count(*) AS c") * 2]
+        )
+
+    try:
+        results = asyncio.run(main())
+    finally:
+        pool.close()
+    for r in results:
+        tree = r.profile(execute=False).trace
+        assert [ch.name for ch in tree.root.children].count("execute") == 1
+    hop_names = {sp.name for sp in results[0].profile(execute=False).trace.spans()}
+    cnt_names = {sp.name for sp in results[1].profile(execute=False).trace.spans()}
+    assert "CsrExpandOp" in hop_names
+    assert "CsrExpandOp" not in cnt_names
+
+
+# ---------------------------------------------------------------------------
 # registry semantics
 # ---------------------------------------------------------------------------
 

@@ -1,0 +1,490 @@
+"""The multi-tenant query server (``tpu_cypher/serve/``): admission
+scheduling, micro-batching, isolation, and the observability surfaces.
+
+Three layers of coverage:
+
+* **scheduler/batcher units** — pure asyncio, no engine: cost ordering,
+  tenant fairness, quotas, queued-deadline expiry, coalescing semantics.
+* **server end-to-end over real sockets** — submit/stream/cancel on the
+  JSON protocol, per-query results byte-identical to serial execution,
+  same-bucket bursts sharing one dispatch, chaos queries degrading
+  without contaminating clean neighbors.
+* **HTTP goldens** — ``GET /metrics`` byte-identical to the in-process
+  ``session.metrics_text()``; ``GET /queries/<id>`` serving the span
+  tree JSON.
+"""
+
+import asyncio
+import json
+
+import pytest
+
+from tpu_cypher.errors import QueryTimeout
+from tpu_cypher.relational.session import CypherSession
+from tpu_cypher.serve import (
+    AdmissionScheduler,
+    BatchWindow,
+    QueryServer,
+    batch_key,
+    estimate_cost_bytes,
+)
+
+# ---------------------------------------------------------------------------
+# shared engine fixtures
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def session():
+    return CypherSession.tpu()
+
+
+@pytest.fixture(scope="module")
+def graph(session):
+    n = 16
+    parts = [f"(n{i}:P {{id: {i}}})" for i in range(n)]
+    parts += [f"(n{i})-[:K]->(n{(i + 1) % n})" for i in range(n)]
+    parts += [f"(n{i})-[:K]->(n{(i + 5) % n})" for i in range(n)]
+    return session.create_graph_from_create_query("CREATE " + ", ".join(parts))
+
+
+COUNT_Q = "MATCH (a:P) RETURN count(a) AS n"
+HOP_Q = "MATCH (a:P)-[:K]->(b:P) RETURN count(b) AS n"
+ROWS_Q = "MATCH (a:P {id: 3})-[:K]->(b:P) RETURN b.id AS id ORDER BY id"
+
+
+async def _client(host, port, lines, want=None):
+    """Drive the JSON protocol: send every line, read until each submit
+    reaches a terminal message. Returns the full message list."""
+    reader, writer = await asyncio.open_connection(host, port)
+    for line in lines:
+        writer.write((json.dumps(line) + "\n").encode())
+    await writer.drain()
+    if want is None:
+        want = sum(1 for l in lines if l.get("op") == "submit")
+    out, done = [], 0
+    while done < want:
+        raw = await asyncio.wait_for(reader.readline(), 30)
+        if not raw:
+            break
+        msg = json.loads(raw)
+        out.append(msg)
+        if msg.get("type") in ("done", "error", "cancelled"):
+            done += 1
+    writer.close()
+    return out
+
+
+async def _http(host, port, path):
+    reader, writer = await asyncio.open_connection(host, port)
+    writer.write(f"GET {path} HTTP/1.1\r\nHost: t\r\n\r\n".encode())
+    await writer.drain()
+    data = await reader.read()
+    writer.close()
+    head, _, body = data.partition(b"\r\n\r\n")
+    return head.decode().split("\r\n")[0], body
+
+
+def _terminals(msgs, typ="done"):
+    return {m["id"]: m for m in msgs if m["type"] == typ}
+
+
+def _rows_of(msgs, qid):
+    rows = []
+    for m in msgs:
+        if m["type"] == "rows" and m["id"] == qid:
+            rows.extend(m["rows"])
+    return rows
+
+
+# ---------------------------------------------------------------------------
+# scheduler units (no engine)
+# ---------------------------------------------------------------------------
+
+
+def test_scheduler_cost_ordering():
+    """With one slot, waiters are granted cheapest-padded-cost first."""
+
+    async def run():
+        s = AdmissionScheduler(max_concurrent=1)
+        await s.acquire(10, "t")  # occupy the slot
+        order = []
+
+        async def waiter(name, cost):
+            await s.acquire(cost, "t")
+            order.append(name)
+            s.release("t")
+
+        tasks = [
+            asyncio.ensure_future(waiter("big", 4096)),
+            asyncio.ensure_future(waiter("small", 64)),
+            asyncio.ensure_future(waiter("mid", 512)),
+        ]
+        await asyncio.sleep(0.01)  # all queued
+        s.release("t")
+        await asyncio.gather(*tasks)
+        return order
+
+    assert asyncio.run(run()) == ["small", "mid", "big"]
+
+
+def test_scheduler_tenant_fairness():
+    """The next slot goes to the tenant with the fewest in flight, even
+    when the hog's queries are cheaper."""
+
+    async def run():
+        s = AdmissionScheduler(max_concurrent=2)
+        await s.acquire(10, "hog")
+        await s.acquire(10, "hog")
+        order = []
+
+        async def waiter(name, tenant, cost):
+            await s.acquire(cost, tenant)
+            order.append(name)
+
+        tasks = [
+            asyncio.ensure_future(waiter("hog3", "hog", 1)),
+            asyncio.ensure_future(waiter("guest", "guest", 1000)),
+        ]
+        await asyncio.sleep(0.01)
+        s.release("hog")
+        await asyncio.sleep(0.01)
+        s.release("hog")
+        await asyncio.gather(*tasks)
+        return order
+
+    assert asyncio.run(run()) == ["guest", "hog3"]
+
+
+def test_scheduler_tenant_quota():
+    """A quota caps one tenant's in-flight count outright: its extra
+    queries wait even while slots sit free."""
+
+    async def run():
+        s = AdmissionScheduler(max_concurrent=4, tenant_quota=1)
+        await s.acquire(1, "t1")
+        task = asyncio.ensure_future(s.acquire(1, "t1"))
+        await asyncio.sleep(0.01)
+        assert not task.done() and s.running == 1  # slot free, still queued
+        await s.acquire(1, "t2")  # another tenant sails through
+        s.release("t1")
+        await asyncio.wait_for(task, 1)
+        return s.running
+
+    assert asyncio.run(run()) == 2
+
+
+def test_scheduler_queued_deadline_times_out_typed():
+    async def run():
+        s = AdmissionScheduler(max_concurrent=1)
+        await s.acquire(1, "t")
+        loop = asyncio.get_running_loop()
+        with pytest.raises(QueryTimeout):
+            await s.acquire(1, "t", deadline_at=loop.time() + 0.02)
+        # the expired waiter left no ghost entry; a release still pumps
+        s.release("t")
+        await s.acquire(1, "t")
+        return s.queued
+
+    assert asyncio.run(run()) == 0
+
+
+def test_scheduler_expired_deadline_rejected_before_slot():
+    async def run():
+        s = AdmissionScheduler(max_concurrent=1)
+        with pytest.raises(QueryTimeout):
+            await s.acquire(1, "t", deadline_at=0.0)
+        return s.running
+
+    assert asyncio.run(run()) == 0
+
+
+def test_estimate_cost_bytes_orders_by_shape(graph):
+    """More pattern fan-out -> strictly larger padded estimate; estimates
+    ride the bucket lattice (so they are stable within a bucket)."""
+    c1 = estimate_cost_bytes(graph, COUNT_Q)
+    c2 = estimate_cost_bytes(graph, HOP_Q)
+    c3 = estimate_cost_bytes(graph, "MATCH (a)-[:K]->()-[:K]->()-[:K]->(d) RETURN d")
+    assert 0 < c1 < c2 < c3
+
+
+# ---------------------------------------------------------------------------
+# batcher units
+# ---------------------------------------------------------------------------
+
+
+def test_batch_key_none_for_uncacheable(session, graph):
+    # catalog-interacting statements never batch (no plan-cache key)
+    assert batch_key(session, "CREATE GRAPH g { RETURN 1 }", graph, {}) is None
+    # table-valued parameters never batch either
+    assert batch_key(session, COUNT_Q, graph, {"rows": [{"a": 1}]}) is None
+
+
+def test_batch_key_separates_param_values(session, graph):
+    q = "MATCH (a:P {id: $i}) RETURN a.id AS id"
+    k1 = batch_key(session, q, graph, {"i": 1})
+    k2 = batch_key(session, q, graph, {"i": 2})
+    k1b = batch_key(session, q, graph, {"i": 1})
+    assert k1 is not None and k1 == k1b and k1 != k2
+
+
+def test_batch_window_coalesces_until_sealed():
+    async def run():
+        w = BatchWindow(window_ms=50)
+        b, lead = w.lead_or_join("k", "q1")
+        assert lead
+        b2, lead2 = w.lead_or_join("k", "q2")
+        assert b2 is b and not lead2
+        w.close(b)
+        # post-seal arrivals start a NEW batch
+        b3, lead3 = w.lead_or_join("k", "q3")
+        assert lead3 and b3 is not b
+        w.publish(b, result="r")
+        assert b.result == "r" and b.done.is_set()
+        return b.size
+
+    assert asyncio.run(run()) == 2
+
+
+def test_batch_window_zero_disables_coalescing():
+    async def run():
+        w = BatchWindow(window_ms=0)
+        b1, l1 = w.lead_or_join("k", "q1")
+        b2, l2 = w.lead_or_join("k", "q2")
+        return l1 and l2 and b1 is not b2
+
+    assert asyncio.run(run()) is True
+
+
+# ---------------------------------------------------------------------------
+# server end-to-end (real sockets)
+# ---------------------------------------------------------------------------
+
+
+def _serve(session, graph, **kw):
+    """Context helper: a started server with the module graph mounted."""
+    srv = QueryServer(session, port=0, **kw)
+    srv.register_graph("g", graph)
+    return srv
+
+
+def test_server_submit_stream_done(session, graph):
+    async def run():
+        async with _serve(session, graph) as srv:
+            msgs = await _client(srv.host, srv.port, [
+                {"op": "submit", "id": "a", "graph": "g", "query": ROWS_Q},
+            ])
+        return msgs
+
+    msgs = asyncio.run(run())
+    assert msgs[0] == {"type": "accepted", "id": "a"}
+    done = _terminals(msgs)["a"]
+    assert done["rungs"] == ["device"] and done["degraded"] is False
+    assert _rows_of(msgs, "a") == [{"id": 4}, {"id": 8}]
+
+
+def test_server_results_identical_to_serial(session, graph):
+    """Every served row page must reproduce serial in-process execution
+    byte-for-byte (JSON wire form vs the same encoding applied locally)."""
+    from tpu_cypher.serve.server import _encode_rows
+
+    queries = [COUNT_Q, HOP_Q, ROWS_Q,
+               "MATCH (a:P) RETURN a.id AS id ORDER BY id LIMIT 5"]
+
+    async def run():
+        async with _serve(session, graph) as srv:
+            return await _client(srv.host, srv.port, [
+                {"op": "submit", "id": f"q{i}", "graph": "g", "query": q}
+                for i, q in enumerate(queries)
+            ])
+
+    msgs = asyncio.run(run())
+    for i, q in enumerate(queries):
+        records = graph.cypher(q).records
+        want = _encode_rows(records.collect(), records.columns)
+        got = _rows_of(msgs, f"q{i}")
+        assert json.dumps(got, sort_keys=True) == json.dumps(want, sort_keys=True), q
+
+
+def test_server_burst_shares_one_dispatch(session, graph):
+    """Same-plan same-params burst inside the window -> ONE dispatch,
+    every client tagged with the batch size and the leader's id."""
+    from tpu_cypher.serve.batching import DISPATCHES
+
+    async def run():
+        async with _serve(session, graph, batch_window_ms=50) as srv:
+            before = sum(int(v) for _, v in DISPATCHES.items())
+            msgs = await _client(srv.host, srv.port, [
+                {"op": "submit", "id": f"b{i}", "graph": "g", "query": HOP_Q}
+                for i in range(4)
+            ])
+            after = sum(int(v) for _, v in DISPATCHES.items())
+        return msgs, after - before
+
+    msgs, dispatches = asyncio.run(run())
+    dones = _terminals(msgs)
+    assert len(dones) == 4
+    assert {d["batched"] for d in dones.values()} == {4}
+    assert len({d["batch_leader"] for d in dones.values()}) == 1
+    assert dispatches == 1
+    # all four clients got identical rows
+    pages = [json.dumps(_rows_of(msgs, f"b{i}")) for i in range(4)]
+    assert len(set(pages)) == 1
+
+
+def test_server_chaos_scoped_per_client(session, graph):
+    """A chaos-mode query degrades down the ladder; an interleaved clean
+    query of the SAME shape stays on the device rung — fault schedules are
+    context-local to the client that asked for them."""
+
+    async def run():
+        async with _serve(session, graph, batch_window_ms=10) as srv:
+            return await _client(srv.host, srv.port, [
+                {"op": "submit", "id": "chaos", "graph": "g", "query": HOP_Q,
+                 "faults": "oom@expand:*"},
+                {"op": "submit", "id": "clean", "graph": "g", "query": HOP_Q},
+            ])
+
+    msgs = asyncio.run(run())
+    dones = _terminals(msgs)
+    assert dones["chaos"]["degraded"] is True
+    assert dones["chaos"]["rungs"][0] == "device"
+    assert dones["chaos"]["rungs"][-1] == "host-oracle"
+    assert dones["clean"]["rungs"] == ["device"]
+    # degraded or not, both clients got the same rows
+    assert _rows_of(msgs, "chaos") == _rows_of(msgs, "clean")
+
+
+def test_server_expired_deadline_is_typed_error(session, graph):
+    async def run():
+        async with _serve(session, graph) as srv:
+            return await _client(srv.host, srv.port, [
+                {"op": "submit", "id": "t", "graph": "g", "query": COUNT_Q,
+                 "deadline_s": 1e-6},
+            ])
+
+    msgs = asyncio.run(run())
+    err = _terminals(msgs, "error")["t"]
+    assert err["error"] == "QueryTimeout"
+
+
+def test_server_cancel_queued_query(session, graph):
+    """Cancel while queued: the query never dispatches; the client gets a
+    terminal 'cancelled' message."""
+
+    async def run():
+        async with _serve(session, graph, max_concurrent=1) as srv:
+            # hold the server's only slot so the victim must queue
+            await srv.scheduler.acquire(1, "holder")
+            reader, writer = await asyncio.open_connection(srv.host, srv.port)
+
+            async def send(obj):
+                writer.write((json.dumps(obj) + "\n").encode())
+                await writer.drain()
+
+            async def recv():
+                return json.loads(await asyncio.wait_for(reader.readline(), 30))
+
+            await send({"op": "submit", "id": "victim", "graph": "g",
+                        "query": COUNT_Q})
+            assert (await recv())["type"] == "accepted"
+            await asyncio.sleep(0.05)  # window elapses; victim queues
+            await send({"op": "cancel", "id": "victim"})
+            terminal = None
+            while terminal is None:
+                m = await recv()
+                if m.get("type") in ("done", "error", "cancelled"):
+                    terminal = m
+            srv.scheduler.release("holder")
+            # the scheduler is healthy afterwards: a fresh query completes
+            await send({"op": "submit", "id": "after", "graph": "g",
+                        "query": COUNT_Q})
+            after = None
+            while after is None:
+                m = await recv()
+                if m.get("type") in ("done", "error", "cancelled"):
+                    after = m
+            writer.close()
+        return terminal, after
+
+    terminal, after = asyncio.run(run())
+    assert terminal == {"type": "cancelled", "id": "victim"}
+    assert after["type"] == "done" and after["id"] == "after"
+
+
+def test_server_protocol_errors(session, graph):
+    async def run():
+        async with _serve(session, graph) as srv:
+            return await _client(srv.host, srv.port, [
+                {"op": "submit", "id": "g1", "graph": "nope", "query": "RETURN 1"},
+                {"op": "submit", "id": "g2", "graph": "g", "query": "MATCH ("},
+                {"op": "nonsense", "id": "g3"},
+            ], want=3)
+
+    msgs = asyncio.run(run())
+    errs = _terminals(msgs, "error")
+    assert errs["g1"]["error"] == "UnknownGraph"
+    assert errs["g2"]["error"]  # typed planner error, surfaced not swallowed
+    assert errs["g3"]["error"] == "ProtocolError"
+
+
+# ---------------------------------------------------------------------------
+# HTTP observability surface
+# ---------------------------------------------------------------------------
+
+
+def test_http_metrics_golden_matches_in_process(session, graph):
+    """GET /metrics must serve ``session.metrics_text()`` VERBATIM — the
+    scrape surface and the in-process surface cannot drift."""
+
+    async def run():
+        async with _serve(session, graph) as srv:
+            # run a query first so the body is non-trivial
+            await _client(srv.host, srv.port, [
+                {"op": "submit", "id": "m", "graph": "g", "query": COUNT_Q},
+            ])
+            status, body = await _http(srv.host, srv.port, "/metrics")
+            golden = session.metrics_text()
+        return status, body, golden
+
+    status, body, golden = asyncio.run(run())
+    assert status.endswith("200 OK")
+    assert body.decode() == golden
+    assert "tpu_cypher_serve_queries_total" in golden
+
+
+def test_http_query_record_serves_profile(session, graph):
+    async def run():
+        async with _serve(session, graph) as srv:
+            await _client(srv.host, srv.port, [
+                {"op": "submit", "id": "p1", "graph": "g", "query": HOP_Q},
+            ])
+            ok = await _http(srv.host, srv.port, "/queries/p1")
+            missing = await _http(srv.host, srv.port, "/queries/zzz")
+        return ok, missing
+
+    (status, body), (mstatus, _) = asyncio.run(run())
+    assert status.endswith("200 OK")
+    rec = json.loads(body)
+    assert rec["status"] == "done" and rec["rungs"] == ["device"]
+    assert rec["batched"] == 1 and rec["tenant"] == "default"
+    # the span tree rode along (a plan-cache hit skips the planning
+    # phases, so only the execution-side spans are guaranteed)
+    names = json.dumps(rec["profile"])
+    for phase in ("execute", "collect"):
+        assert phase in names
+    assert mstatus.endswith("404 Not Found")
+
+
+def test_http_healthz_and_404(session, graph):
+    async def run():
+        async with _serve(session, graph) as srv:
+            h = await _http(srv.host, srv.port, "/healthz")
+            nf = await _http(srv.host, srv.port, "/bogus")
+        return h, nf
+
+    (hs, hb), (ns, _) = asyncio.run(run())
+    assert hs.endswith("200 OK")
+    health = json.loads(hb)
+    assert health["ok"] is True and health["graphs"] == ["g"]
+    assert ns.endswith("404 Not Found")

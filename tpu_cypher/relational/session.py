@@ -224,7 +224,11 @@ class CypherResult:
         device_backend = (
             getattr(session.table_cls, "plan_expand_fastpath", None) is not None
         )
+        # deadline resolution: session option > context-local request
+        # override (the serving layer's per-client deadline) > env default
         limit = session.query_deadline_s
+        if limit is None:
+            limit = G.request_deadline_s()
         if limit is None:
             limit = G.DEADLINE_S.get()
         deadline_at = (

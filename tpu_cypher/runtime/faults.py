@@ -39,6 +39,7 @@ status markers jaxlib uses) so they flow through ``tpu_cypher.errors
 
 from __future__ import annotations
 
+import contextvars
 import threading
 from typing import Dict, List, Optional, Tuple
 
@@ -128,6 +129,50 @@ def parse_spec(text: str) -> Dict[str, List[Tuple[str, int, int]]]:
     return out
 
 
+class _ScopedSchedule:
+    """One context's private fault schedule: a parsed spec plus its OWN
+    per-site occurrence counts, so two interleaved queries each see a fresh
+    deterministic window (``:1`` means THEIR first invocation)."""
+
+    __slots__ = ("spec", "counts")
+
+    def __init__(self, spec: Dict[str, List[Tuple[str, int, int]]]):
+        self.spec = spec
+        self.counts: Dict[str, int] = {}
+
+    def hit(self, site: str) -> int:
+        n = self.counts.get(site, 0) + 1
+        self.counts[site] = n
+        return n
+
+
+# context-local fault schedule: layered OVER the process-global
+# set_spec/env spec (a scope shadows it entirely while open). The serving
+# layer (serve/) opens one per chaos-mode client query so concurrent
+# requests never share occurrence windows.
+_CTX_SCHEDULE: contextvars.ContextVar[Optional[_ScopedSchedule]] = (
+    contextvars.ContextVar("tpu_cypher_fault_schedule", default=None)
+)
+
+
+class scoped_spec:
+    """``with faults.scoped_spec("oom@join:1"):`` — context-local fault
+    schedule with its own occurrence counters, shadowing the process-global
+    spec while open. None/empty installs an explicit no-fault scope (chaos
+    harnesses use that to pin a clean query next to a faulted one)."""
+
+    def __init__(self, text: Optional[str]):
+        self._sched = _ScopedSchedule(parse_spec(text) if text else {})
+        self._token = None
+
+    def __enter__(self) -> "scoped_spec":
+        self._token = _CTX_SCHEDULE.set(self._sched)
+        return self
+
+    def __exit__(self, *exc) -> None:
+        _CTX_SCHEDULE.reset(self._token)
+
+
 def set_spec(text: Optional[str]) -> None:
     """In-process override of ``TPU_CYPHER_FAULTS`` (None = back to the
     env). Resets the invocation counters: a fresh spec means a fresh
@@ -176,7 +221,13 @@ def fault_point(site: str) -> None:
     G.check_deadline(site)
     n = int(FAULT_SITE_HITS.inc(site=site))
     _obs_trace.note_site(site)
-    spec = _active_spec()
+    sched = _CTX_SCHEDULE.get()
+    if sched is not None:
+        # a context-local schedule shadows the global spec entirely and
+        # evaluates its windows against ITS OWN per-site counts
+        spec, n = sched.spec, sched.hit(site)
+    else:
+        spec = _active_spec()
     if not spec:
         return
     rules = spec.get(site)

@@ -74,6 +74,38 @@ _CURRENT: contextvars.ContextVar[Optional[ExecutionGuard]] = (
     contextvars.ContextVar("tpu_cypher_guard", default=None)
 )
 
+# per-REQUEST deadline override (seconds), context-local: the serving layer
+# (serve/) activates one around each client query so interleaved coroutines
+# each see their own deadline. Resolution order in the ladder
+# (relational/session.py): session option > request override > env default.
+_REQUEST_DEADLINE_S: contextvars.ContextVar[Optional[float]] = (
+    contextvars.ContextVar("tpu_cypher_request_deadline", default=None)
+)
+
+
+def request_deadline_s() -> Optional[float]:
+    """The context-local per-request deadline (seconds), or None when no
+    ``request_deadline`` scope is open in this context."""
+    return _REQUEST_DEADLINE_S.get()
+
+
+class request_deadline:
+    """``with guard.request_deadline(1.5):`` — scope a per-request deadline
+    over every query executed in this context. 0/None clears (queries fall
+    back to the session/env deadline). Context-local, so concurrent server
+    requests never see each other's deadlines."""
+
+    def __init__(self, seconds: Optional[float]):
+        self._seconds = float(seconds) if seconds and seconds > 0 else None
+        self._token = None
+
+    def __enter__(self) -> "request_deadline":
+        self._token = _REQUEST_DEADLINE_S.set(self._seconds)
+        return self
+
+    def __exit__(self, *exc) -> None:
+        _REQUEST_DEADLINE_S.reset(self._token)
+
 
 def ladder_enabled() -> bool:
     return LADDER_MODE.get().strip().lower() != "off"

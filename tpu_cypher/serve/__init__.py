@@ -1,0 +1,43 @@
+"""Multi-tenant query serving: N concurrent clients, one warm engine.
+
+The subsystem (``docs/serving.md``) in one line per layer:
+
+* ``session_pool`` — ONE warm ``CypherSession`` (the device, jit caches,
+  compile cache, and plan cache are process-global) multiplexed onto
+  bounded worker threads, each query inside a fresh
+  ``contextvars.Context`` so engine state never leaks between clients.
+* ``scheduler`` — admission by padded-memory cost (``bucketing.admit``
+  pre-flight, then cost-ordered tenant-fair slot grants) with queued
+  deadline expiry raising the engine's typed ``QueryTimeout``.
+* ``batching`` — same-plan/same-params/same-bucket queries arriving
+  within ``TPU_CYPHER_SERVE_BATCH_WINDOW_MS`` coalesce into ONE device
+  dispatch, demuxed per client.
+* ``server`` — the asyncio front end: newline-JSON submit/stream/cancel
+  plus ``GET /metrics`` (``session.metrics_text()`` verbatim) and
+  ``GET /queries/<id>`` (per-query profile JSON) on the same port.
+
+Run one with ``python -m tpu_cypher.serve`` (demo graph) or embed::
+
+    server = QueryServer(session, port=0)
+    server.register_graph("social", graph)
+    async with server:
+        ...
+"""
+
+from .batching import BatchWindow, batch_key, bucket_signature
+from .scheduler import AdmissionScheduler, estimate_cost_bytes, preflight_admit
+from .server import PAGE_ROWS, PROTOCOL_VERSION, QueryServer
+from .session_pool import SessionPool
+
+__all__ = [
+    "AdmissionScheduler",
+    "BatchWindow",
+    "PAGE_ROWS",
+    "PROTOCOL_VERSION",
+    "QueryServer",
+    "SessionPool",
+    "batch_key",
+    "bucket_signature",
+    "estimate_cost_bytes",
+    "preflight_admit",
+]

@@ -1,0 +1,63 @@
+"""``python -m tpu_cypher.serve`` — stand up a query server on a demo graph.
+
+Builds one warm TPU-backend session, mounts a small social-chain demo
+graph as ``demo``, warms the obvious query shapes, and serves
+``TPU_CYPHER_SERVE_PORT`` until interrupted. The point is a copy-paste
+smoke target::
+
+    python -m tpu_cypher.serve &
+    curl -s localhost:7687/healthz
+    printf '%s\n' '{"op":"submit","graph":"demo","query":"MATCH (a:P) RETURN count(a) AS n"}' | nc localhost 7687
+    curl -s localhost:7687/metrics | head
+
+Real deployments embed ``QueryServer`` and mount their own catalog
+graphs; see docs/serving.md.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import sys
+
+from ..relational.session import CypherSession
+from .server import QueryServer
+
+DEMO_WARMUP = (
+    "MATCH (a:P) RETURN count(a) AS n",
+    "MATCH (a:P)-[:K]->(b:P) RETURN count(b) AS n",
+    "MATCH (a:P {id: 0})-[:K]->(b:P) RETURN b.id AS id ORDER BY id",
+)
+
+
+def _demo_graph(session: CypherSession, n: int = 32):
+    parts = [f"(n{i}:P {{id: {i}}})" for i in range(n)]
+    parts += [f"(n{i})-[:K]->(n{(i + 1) % n})" for i in range(n)]
+    parts += [f"(n{i})-[:K]->(n{(i + 7) % n})" for i in range(n)]
+    return session.create_graph_from_create_query("CREATE " + ", ".join(parts))
+
+
+async def _main() -> int:
+    session = CypherSession.tpu()
+    server = QueryServer(session)
+    server.register_graph("demo", _demo_graph(session))
+    stats = server.warmup(DEMO_WARMUP, "demo")
+    await server.start()
+    print(
+        f"tpu-cypher query server on {server.host}:{server.port} "
+        f"(graphs: demo; warmup compiles: {stats.get('compiles', '?')})",
+        flush=True,
+    )
+    try:
+        await server.serve_forever()
+    except (KeyboardInterrupt, asyncio.CancelledError):
+        pass
+    finally:
+        await server.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    try:
+        sys.exit(asyncio.run(_main()))
+    except KeyboardInterrupt:
+        sys.exit(130)

@@ -1,0 +1,537 @@
+"""The asyncio query server: JSON protocol, micro-batching, obs endpoints.
+
+One ``QueryServer`` owns one warm engine (``SessionPool``), an admission
+scheduler, and a batch coalescer, and serves two protocols on ONE port:
+
+* **query protocol** — newline-delimited JSON, request/stream/response::
+
+      -> {"op": "submit", "query": "MATCH ...", "graph": "g",
+          "parameters": {...}, "tenant": "t1", "deadline_s": 1.5,
+          "faults": "oom@join:1", "id": "my-1"}
+      <- {"type": "accepted", "id": "my-1"}
+      <- {"type": "rows", "id": "my-1", "seq": 0, "rows": [{...}, ...]}
+      <- {"type": "done", "id": "my-1", "rows": 12, "seconds": 0.004,
+          "batched": 3, "batch_leader": "q7", "rungs": ["device"],
+          "degraded": false}
+
+  plus ``{"op": "cancel", "id": ...}`` -> ``{"type": "cancelled"}`` and
+  typed failures as ``{"type": "error", "id", "error": "QueryTimeout",
+  "message"}``. Multiple queries stream concurrently on one connection;
+  every message carries the query id it belongs to.
+
+* **observability over HTTP** (sniffed from the first line, so curl and a
+  Prometheus scraper need no special port): ``GET /metrics`` returns
+  ``session.metrics_text()`` VERBATIM (golden-tested against the
+  in-process text so the surfaces cannot drift), ``GET /queries/<id>``
+  returns the per-query record — status, execution log, ladder rungs,
+  batch tags, and the full ``profile()`` span tree as JSON.
+
+Execution path per submit: resolve graph -> batch coalescing
+(``serve/batching.py``) -> pre-flight budget admission + cost-ordered,
+tenant-fair slot wait (``serve/scheduler.py``) -> one isolated-context
+execution on the warm session (``serve/session_pool.py``) with the
+client's deadline (``guard.request_deadline``) and chaos schedule
+(``faults.scoped_spec``) scoped in -> per-client demux of rows, spans,
+and degrade-ladder tags.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import contextlib
+import itertools
+import json
+import time
+from collections import OrderedDict
+from typing import Any, Dict, List, Optional, Tuple
+
+from .. import errors as ERR
+from ..api import values as V
+from ..obs.metrics import REGISTRY as _REGISTRY
+from ..relational.session import CypherSession, PropertyGraph
+from ..runtime import faults as F
+from ..runtime import guard as G
+from ..utils.config import (
+    SERVE_BATCH_WINDOW_MS,
+    SERVE_MAX_CONCURRENT,
+    SERVE_PORT,
+    SERVE_TENANT_QUOTA,
+)
+from .batching import BatchWindow, batch_key
+from .scheduler import AdmissionScheduler, preflight_admit
+from .session_pool import SessionPool
+
+PROTOCOL_VERSION = 1
+PAGE_ROWS = 256  # rows per streamed "rows" message
+_QUERY_LOG_MAX = 512  # bounded /queries/<id> history
+
+QUERIES_TOTAL = _REGISTRY.counter(
+    "tpu_cypher_serve_queries_total",
+    "client queries by terminal status",
+    labels=("status",),
+)
+QUERY_SECONDS = _REGISTRY.histogram(
+    "tpu_cypher_serve_query_seconds",
+    "wall seconds from submit to done, per client query",
+)
+
+
+def _json_value(v: Any) -> Any:
+    """JSON-safe wire form of a Cypher value. Scalars pass through;
+    structured and temporal values ride their deterministic Cypher text
+    (``api.values.to_cypher_string`` — the TCK formatting), which is what
+    makes 'byte-identical to serial execution' a checkable property."""
+    if v is None or isinstance(v, (bool, int, str)):
+        return v
+    if isinstance(v, float):
+        return v
+    return V.to_cypher_string(v)
+
+
+def _encode_rows(rows, columns) -> List[Dict[str, Any]]:
+    return [{c: _json_value(r.get(c)) for c in columns} for r in rows]
+
+
+class _Ticket:
+    """One client query, from submit to terminal message."""
+
+    __slots__ = (
+        "qid", "query", "graph_name", "parameters", "tenant", "deadline_s",
+        "faults", "conn", "status", "cancelled", "task", "submitted_at",
+    )
+
+    def __init__(self, qid, query, graph_name, parameters, tenant,
+                 deadline_s, faults, conn):
+        self.qid = qid
+        self.query = query
+        self.graph_name = graph_name
+        self.parameters = parameters
+        self.tenant = tenant
+        self.deadline_s = deadline_s
+        self.faults = faults
+        self.conn = conn
+        self.status = "queued"
+        self.cancelled = False
+        self.task: Optional[asyncio.Task] = None
+        self.submitted_at = time.monotonic()
+
+
+class _Conn:
+    """One client connection: serialized writes, many in-flight queries."""
+
+    def __init__(self, writer: asyncio.StreamWriter):
+        self.writer = writer
+        self.lock = asyncio.Lock()
+        self.closed = False
+
+    async def send(self, obj: Dict[str, Any]) -> None:
+        if self.closed:
+            return
+        data = (json.dumps(obj) + "\n").encode()
+        async with self.lock:
+            if self.closed:
+                return
+            try:
+                self.writer.write(data)
+                await self.writer.drain()
+            except (ConnectionError, OSError):  # fault-ok: client went away
+                self.closed = True
+
+
+class QueryServer:
+    """The multi-tenant front end over one warm ``CypherSession``."""
+
+    def __init__(
+        self,
+        session: Optional[CypherSession] = None,
+        host: str = "127.0.0.1",
+        port: Optional[int] = None,
+        max_concurrent: Optional[int] = None,
+        batch_window_ms: Optional[float] = None,
+        tenant_quota: Optional[int] = None,
+    ):
+        self.host = host
+        self.port = int(port if port is not None else SERVE_PORT.get())
+        max_c = int(
+            max_concurrent if max_concurrent is not None
+            else SERVE_MAX_CONCURRENT.get()
+        )
+        window = float(
+            batch_window_ms if batch_window_ms is not None
+            else SERVE_BATCH_WINDOW_MS.get()
+        )
+        quota = int(
+            tenant_quota if tenant_quota is not None
+            else SERVE_TENANT_QUOTA.get()
+        )
+        self.pool = SessionPool(session, workers=max_c)
+        self.session = self.pool.session
+        self.scheduler = AdmissionScheduler(max_c, tenant_quota=quota)
+        self.batcher = BatchWindow(window)
+        self._graphs: Dict[str, PropertyGraph] = {}
+        self._tickets: Dict[str, _Ticket] = {}
+        self._records: "OrderedDict[str, Dict[str, Any]]" = OrderedDict()
+        self._qids = itertools.count(1)
+        self._server: Optional[asyncio.base_events.Server] = None
+
+    # -- graphs ----------------------------------------------------------
+
+    def register_graph(self, name: str, graph: PropertyGraph) -> None:
+        """Mount a catalog graph for clients to query by name."""
+        self._graphs[name] = graph
+
+    def warmup(self, queries, graph_name: str,
+               parameters: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
+        """Pre-compile a query corpus against a mounted graph (blocking;
+        call before accepting traffic)."""
+        return self.pool.warmup(
+            queries, graph=self._graphs[graph_name], parameters=parameters
+        )
+
+    # -- lifecycle -------------------------------------------------------
+
+    async def start(self) -> None:
+        self._server = await asyncio.start_server(
+            self._handle_conn, self.host, self.port
+        )
+        self.port = self._server.sockets[0].getsockname()[1]
+
+    async def stop(self) -> None:
+        for t in list(self._tickets.values()):
+            if t.task is not None and not t.task.done():
+                t.task.cancel()
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()
+        self.pool.close()
+
+    async def serve_forever(self) -> None:
+        if self._server is None:
+            await self.start()
+        async with self._server:
+            await self._server.serve_forever()
+
+    async def __aenter__(self) -> "QueryServer":
+        await self.start()
+        return self
+
+    async def __aexit__(self, *exc) -> None:
+        await self.stop()
+
+    # -- connection handling ---------------------------------------------
+
+    async def _handle_conn(
+        self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter
+    ) -> None:
+        conn = _Conn(writer)
+        try:
+            first = await reader.readline()
+            if not first:
+                return
+            if first[:4] in (b"GET ", b"HEAD"):
+                await self._handle_http(first, reader, writer)
+                return
+            await self._handle_line(first, conn)
+            while True:
+                line = await reader.readline()
+                if not line:
+                    break
+                await self._handle_line(line, conn)
+        except (ConnectionError, asyncio.IncompleteReadError, OSError):
+            pass  # fault-ok: disconnects are routine, queries clean up below
+        finally:
+            conn.closed = True
+            with contextlib.suppress(Exception):  # fault-ok: teardown only
+                writer.close()
+                await writer.wait_closed()
+
+    async def _handle_line(self, line: bytes, conn: _Conn) -> None:
+        try:
+            msg = json.loads(line)
+            if not isinstance(msg, dict):
+                raise ValueError("message must be a JSON object")
+        except ValueError as exc:
+            await conn.send(
+                {"type": "error", "id": None, "error": "ProtocolError",
+                 "message": f"bad JSON line: {exc}"}
+            )
+            return
+        op = msg.get("op")
+        if op == "submit":
+            await self._op_submit(msg, conn)
+        elif op == "cancel":
+            await self._op_cancel(msg, conn)
+        elif op == "ping":
+            await conn.send({"type": "pong", "protocol": PROTOCOL_VERSION})
+        else:
+            await conn.send(
+                {"type": "error", "id": msg.get("id"), "error": "ProtocolError",
+                 "message": f"unknown op {op!r}"}
+            )
+
+    # -- protocol ops ----------------------------------------------------
+
+    async def _op_submit(self, msg: Dict[str, Any], conn: _Conn) -> None:
+        qid = str(msg.get("id") or f"q{next(self._qids)}")
+        if qid in self._tickets:
+            await conn.send(
+                {"type": "error", "id": qid, "error": "ProtocolError",
+                 "message": f"duplicate query id {qid!r}"}
+            )
+            return
+        query = msg.get("query")
+        graph_name = msg.get("graph")
+        if not isinstance(query, str) or not query.strip():
+            await conn.send(
+                {"type": "error", "id": qid, "error": "ProtocolError",
+                 "message": "submit requires a non-empty 'query' string"}
+            )
+            return
+        if graph_name not in self._graphs:
+            await conn.send(
+                {"type": "error", "id": qid, "error": "UnknownGraph",
+                 "message": f"graph {graph_name!r} is not mounted "
+                 f"(have: {sorted(self._graphs)})"}
+            )
+            return
+        deadline_s = msg.get("deadline_s")
+        t = _Ticket(
+            qid, query, graph_name, dict(msg.get("parameters") or {}),
+            str(msg.get("tenant") or "default"),
+            float(deadline_s) if deadline_s else None,
+            msg.get("faults"), conn,
+        )
+        self._tickets[qid] = t
+        await conn.send({"type": "accepted", "id": qid})
+        t.task = asyncio.ensure_future(self._run_ticket(t))
+
+    async def _op_cancel(self, msg: Dict[str, Any], conn: _Conn) -> None:
+        qid = str(msg.get("id") or "")
+        t = self._tickets.get(qid)
+        if t is None or t.status in ("done", "error", "cancelled"):
+            await conn.send(
+                {"type": "error", "id": qid or None, "error": "UnknownQuery",
+                 "message": f"no cancellable query {qid!r}"}
+            )
+            return
+        t.cancelled = True
+        if t.status == "queued" and t.task is not None:
+            # still pre-dispatch: tear the task down now (a sealed batch
+            # with followers is handled inside the task — it executes for
+            # them and only this client's results are dropped)
+            t.task.cancel()
+        await conn.send({"type": "cancel_requested", "id": qid})
+
+    # -- the execution pipeline ------------------------------------------
+
+    async def _run_ticket(self, t: _Ticket) -> None:
+        graph = self._graphs[t.graph_name]
+        # chaos schedules and per-request deadlines are client-scoped
+        # state: such queries never share a dispatch
+        key = None
+        if t.faults is None and t.deadline_s is None:
+            key = batch_key(self.session, t.query, graph, t.parameters)
+        batch, is_leader = self.batcher.lead_or_join(key, t.qid)
+        try:
+            if is_leader:
+                await self.batcher.window()
+                self.batcher.close(batch)
+                if t.cancelled and batch.size == 1:
+                    raise asyncio.CancelledError
+                await self._dispatch(t, graph, batch)
+            else:
+                await batch.done.wait()
+            await self._finish(t, batch)
+        except asyncio.CancelledError:
+            if is_leader:
+                self.batcher.abandon(batch)
+            self._terminal(t, "cancelled", {"type": "cancelled", "id": t.qid})
+            await t.conn.send({"type": "cancelled", "id": t.qid})
+        except Exception as exc:  # fault-ok: surfaced as a typed error reply
+            await self._fail(t, exc)
+
+    async def _dispatch(self, t: _Ticket, graph, batch) -> None:
+        """The leader's path: admission, one isolated execution, publish."""
+        try:
+            cost = preflight_admit(graph, t.query, t.tenant)
+            deadline_at = (
+                t.submitted_at + t.deadline_s if t.deadline_s else None
+            )
+            await self.scheduler.acquire(cost, t.tenant, deadline_at)
+            t.status = "running"
+            try:
+                payload = await self.pool.run(
+                    lambda: self._execute(graph, t)
+                )
+            finally:
+                self.scheduler.release(t.tenant)
+            self.batcher.publish(batch, result=payload)
+        except asyncio.CancelledError:
+            raise
+        except Exception as exc:  # fault-ok: published to every member as a typed error
+            self.batcher.publish(batch, error=exc)
+
+    def _execute(self, graph, t: _Ticket) -> Dict[str, Any]:
+        """One engine execution — runs on a pool worker thread inside a
+        FRESH contextvars.Context; everything scoped here dies with the
+        query."""
+        t0 = time.perf_counter()
+        with contextlib.ExitStack() as stack:
+            if t.deadline_s:
+                # remaining budget: queue wait already consumed part of it
+                remaining = max(
+                    t.deadline_s - (time.monotonic() - t.submitted_at), 1e-6
+                )
+                stack.enter_context(G.request_deadline(remaining))
+            if t.faults is not None:
+                stack.enter_context(F.scoped_spec(t.faults))
+            result = self.session.cypher(t.query, t.parameters, graph=graph)
+            records = result.records
+            rows = records.collect() if records is not None else []
+            columns = list(records.columns) if records is not None else []
+        log = list(result.execution_log)
+        rungs = [e["rung"] for e in log]
+        return {
+            "rows": _encode_rows(rows, columns),
+            "columns": columns,
+            "seconds": round(time.perf_counter() - t0, 6),
+            "execution_log": log,
+            "rungs": rungs,
+            "degraded": bool(rungs and rungs[-1] != G.RUNG_DEVICE),
+            "compile_stats": result.compile_stats,
+            "profile": result.profile(execute=False).to_dict(),
+        }
+
+    async def _finish(self, t: _Ticket, batch) -> None:
+        if batch.error is not None:
+            raise batch.error
+        payload = batch.result
+        if t.cancelled:
+            self._terminal(t, "cancelled", {"type": "cancelled", "id": t.qid})
+            await t.conn.send({"type": "cancelled", "id": t.qid})
+            return
+        rows = payload["rows"]
+        for seq in range(0, max(len(rows), 1), PAGE_ROWS):
+            page = rows[seq : seq + PAGE_ROWS]
+            if page or seq == 0:
+                await t.conn.send(
+                    {"type": "rows", "id": t.qid, "seq": seq // PAGE_ROWS,
+                     "rows": page}
+                )
+        done = {
+            "type": "done",
+            "id": t.qid,
+            "rows": len(rows),
+            "seconds": payload["seconds"],
+            "batched": batch.size,
+            "batch_leader": batch.leader_id,
+            "rungs": payload["rungs"],
+            "degraded": payload["degraded"],
+        }
+        self._terminal(t, "done", done, payload=payload, batch=batch)
+        await t.conn.send(done)
+
+    async def _fail(self, t: _Ticket, exc: Exception) -> None:
+        typed = ERR.classify(exc)
+        name = type(typed if typed is not None else exc).__name__
+        msg = {
+            "type": "error", "id": t.qid, "error": name,
+            "message": str(exc)[:500],
+        }
+        self._terminal(t, "error", msg)
+        await t.conn.send(msg)
+
+    def _terminal(self, t: _Ticket, status: str, message: Dict[str, Any],
+                  payload: Optional[Dict[str, Any]] = None,
+                  batch=None) -> None:
+        """Record the query's terminal state for ``GET /queries/<id>``."""
+        t.status = status
+        QUERIES_TOTAL.inc(status=status)
+        QUERY_SECONDS.observe(time.monotonic() - t.submitted_at)
+        record: Dict[str, Any] = {
+            "id": t.qid,
+            "status": status,
+            "query": t.query,
+            "graph": t.graph_name,
+            "tenant": t.tenant,
+            "message": {k: v for k, v in message.items() if k != "type"},
+        }
+        if payload is not None:
+            record.update(
+                rows=len(payload["rows"]),
+                seconds=payload["seconds"],
+                execution_log=payload["execution_log"],
+                rungs=payload["rungs"],
+                degraded=payload["degraded"],
+                compile_stats=payload["compile_stats"],
+                profile=payload["profile"],
+            )
+        if batch is not None:
+            record.update(batched=batch.size, batch_leader=batch.leader_id)
+        self._records[t.qid] = record
+        while len(self._records) > _QUERY_LOG_MAX:
+            self._records.popitem(last=False)
+        self._tickets.pop(t.qid, None)
+
+    # -- HTTP observability surface --------------------------------------
+
+    async def _handle_http(
+        self, first: bytes, reader: asyncio.StreamReader,
+        writer: asyncio.StreamWriter,
+    ) -> None:
+        # drain headers (we key off the request line only)
+        while True:
+            line = await reader.readline()
+            if not line or line in (b"\r\n", b"\n"):
+                break
+        try:
+            _, path, _ = first.decode("latin-1").split(" ", 2)
+        except ValueError:
+            path = "/"
+        status, ctype, body = self._http_response(path)
+        head = (
+            f"HTTP/1.1 {status}\r\n"
+            f"Content-Type: {ctype}\r\n"
+            f"Content-Length: {len(body)}\r\n"
+            "Connection: close\r\n\r\n"
+        )
+        writer.write(head.encode("latin-1") + body)
+        await writer.drain()
+
+    def _http_response(self, path: str) -> Tuple[str, str, bytes]:
+        path = path.split("?", 1)[0]
+        if path == "/metrics":
+            # VERBATIM session.metrics_text(): the golden test pins the
+            # HTTP body byte-identical to the in-process text
+            return (
+                "200 OK",
+                "text/plain; version=0.0.4",
+                self.session.metrics_text().encode(),
+            )
+        if path.startswith("/queries/"):
+            qid = path[len("/queries/"):]
+            rec = self._records.get(qid)
+            if rec is None and qid in self._tickets:
+                t = self._tickets[qid]
+                rec = {"id": qid, "status": t.status, "query": t.query,
+                       "graph": t.graph_name, "tenant": t.tenant}
+            if rec is None:
+                return (
+                    "404 Not Found", "application/json",
+                    json.dumps({"error": f"unknown query {qid!r}"}).encode(),
+                )
+            return ("200 OK", "application/json", json.dumps(rec).encode())
+        if path == "/healthz":
+            return (
+                "200 OK", "application/json",
+                json.dumps(
+                    {"ok": True, "protocol": PROTOCOL_VERSION,
+                     "graphs": sorted(self._graphs),
+                     "running": self.scheduler.running,
+                     "queued": self.scheduler.queued}
+                ).encode(),
+            )
+        return (
+            "404 Not Found", "application/json",
+            json.dumps({"error": f"no route {path!r}"}).encode(),
+        )

@@ -1,0 +1,77 @@
+"""Warm-session pool: one warm ``CypherSession``, isolated per-query contexts.
+
+The device is process-global, and so are the things that make the engine
+fast under traffic — the jit caches, the persistent compile cache, the
+bucket lattice, the plan cache. A "pool" of real sessions would fracture
+all of them, so the pool holds exactly ONE warm ``CypherSession`` and
+multiplexes concurrent queries onto a bounded thread pool instead (device
+execution is synchronous; asyncio alone cannot overlap it).
+
+What the pool guarantees per query is ISOLATION: each query runs inside a
+**fresh** ``contextvars.Context`` (``Context().run``, not a copy of the
+caller's), so every context-local piece of engine state — the obs trace
+span tree, metric scopes, the execution guard's deadline and ladder rung,
+scoped fault schedules, the fallback-counter scopes — starts empty and
+dies with the query. Interleaved coroutines sharing worker threads can
+never leak state into each other; ``tests/test_serve.py`` and the asyncio
+isolation tests in ``tests/test_obs.py`` pin this.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import contextvars
+from concurrent.futures import ThreadPoolExecutor
+from typing import Any, Callable, Dict, Optional, Sequence
+
+from ..relational.session import CypherSession, PropertyGraph
+
+
+class SessionPool:
+    """One warm engine, N isolated execution lanes.
+
+    ``workers`` bounds how many queries can be ON a worker thread at once;
+    the admission scheduler (``serve/scheduler.py``) bounds how many are
+    admitted, so the pool is sized to match ``max_concurrent``.
+    """
+
+    def __init__(
+        self,
+        session: Optional[CypherSession] = None,
+        workers: int = 8,
+    ):
+        self.session = session if session is not None else CypherSession.tpu()
+        self._executor = ThreadPoolExecutor(
+            max_workers=max(int(workers), 1),
+            thread_name_prefix="tpu-cypher-serve",
+        )
+
+    # -- warmup ----------------------------------------------------------
+
+    def warmup(
+        self,
+        queries: Sequence[str],
+        graph: Optional[PropertyGraph] = None,
+        parameters: Optional[Dict[str, Any]] = None,
+    ) -> Dict[str, Any]:
+        """Compile the corpus ahead of traffic (``CypherSession.warmup``):
+        after this, a soak of same-bucket traffic should report
+        recompiles-after-warmup == 0."""
+        return self.session.warmup(queries, graph=graph, parameters=parameters)
+
+    # -- isolated execution ----------------------------------------------
+
+    @staticmethod
+    def _isolated(fn: Callable[[], Any]) -> Any:
+        # a FRESH context (not a snapshot of the submitting coroutine's):
+        # every engine contextvar starts at its default
+        return contextvars.Context().run(fn)
+
+    async def run(self, fn: Callable[[], Any]) -> Any:
+        """Run blocking engine work on a worker thread inside a fresh
+        ``contextvars.Context``; awaitable from the event loop."""
+        loop = asyncio.get_running_loop()
+        return await loop.run_in_executor(self._executor, self._isolated, fn)
+
+    def close(self) -> None:
+        self._executor.shutdown(wait=False, cancel_futures=True)

@@ -206,6 +206,35 @@ COMPILE_CACHE_DIR = declare(
     help="persistent XLA compile cache directory; empty = disabled",
 )
 
+# multi-tenant query server (serve/): the asyncio front end that admits,
+# schedules, and micro-batches concurrent queries on one warm engine
+SERVE_PORT = declare(
+    "TPU_CYPHER_SERVE_PORT",
+    7687,
+    int,
+    help="query-server TCP port (0 = ephemeral, for tests)",
+)
+SERVE_MAX_CONCURRENT = declare(
+    "TPU_CYPHER_SERVE_MAX_CONCURRENT",
+    8,
+    int,
+    help="max queries executing concurrently; the rest wait in the "
+    "cost-ordered admission queue",
+)
+SERVE_BATCH_WINDOW_MS = declare(
+    "TPU_CYPHER_SERVE_BATCH_WINDOW_MS",
+    2.0,
+    float,
+    help="micro-batch coalescing window: same-bucket queries arriving "
+    "within it share one device dispatch; 0 = batching off",
+)
+SERVE_TENANT_QUOTA = declare(
+    "TPU_CYPHER_SERVE_TENANT_QUOTA",
+    0,
+    int,
+    help="max in-flight queries per tenant; 0 = no quota (fair-share only)",
+)
+
 # observability (obs/metrics.py, utils/profiling.py, obs/trace.py)
 METRICS_FILE = declare(
     "TPU_CYPHER_METRICS_FILE",
