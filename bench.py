@@ -241,15 +241,20 @@ def _metrics_snapshot():
         return {"error": str(exc)[:200]}
 
 
-def _lint_clean() -> bool:
+def _lint_clean() -> dict:
     """Static-analyzer verdict for the engine tree this rung ran
     (``python -m tpu_cypher.analysis tpu_cypher/``): the trajectory records
     analyzer health next to the perf numbers, so an invariant regression
     (host-sync, recompile hazard, pad discipline...) shows up in the same
-    JSON line as the BENCH delta it will eventually cause. Never raises."""
-    from tpu_cypher.analysis import engine_is_clean
+    JSON line as the BENCH delta it will eventually cause — and names the
+    regressed rule, with per-rule finding counts rather than one opaque
+    boolean. Never raises."""
+    try:
+        from tpu_cypher.analysis import engine_lint_summary
 
-    return engine_is_clean()
+        return engine_lint_summary()
+    except Exception as exc:  # fault-ok: telemetry only
+        return {"clean": False, "findings_by_rule": {}, "error": str(exc)[:200]}
 
 
 def _serve_soak() -> dict:

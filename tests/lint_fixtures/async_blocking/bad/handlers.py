@@ -1,0 +1,17 @@
+"""known-bad: async defs that block the event loop."""
+import time
+
+from work import crunch_indirect
+
+
+async def sleeps_inline(ms):
+    time.sleep(ms / 1000.0)  # a direct blocking intrinsic on the loop
+
+
+async def blocks_via_helper():
+    # the sleep is two sync calls away, in another module
+    return crunch_indirect()
+
+
+async def queries_inline(session, q):
+    return session.cypher(q)  # a device-bound engine call on the loop

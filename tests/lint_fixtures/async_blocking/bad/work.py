@@ -1,0 +1,12 @@
+"""sync helpers that block — transitively reached from async defs."""
+import time
+
+
+def crunch():
+    time.sleep(0.1)
+    return 42
+
+
+def crunch_indirect():
+    # one more hop: async callers of this are two calls from the sleep
+    return crunch()

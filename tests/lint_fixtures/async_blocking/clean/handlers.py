@@ -1,0 +1,18 @@
+"""known-clean: the loop never blocks — worker lanes do the work."""
+import asyncio
+
+from work import crunch_indirect
+
+
+async def offloads():
+    loop = asyncio.get_running_loop()
+    return await loop.run_in_executor(None, crunch_indirect)
+
+
+async def to_thread_offload():
+    return await asyncio.to_thread(crunch_indirect)
+
+
+async def pure_async(x):
+    await asyncio.sleep(0)  # asyncio.sleep yields; it is not time.sleep
+    return x + 1

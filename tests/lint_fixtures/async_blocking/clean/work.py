@@ -1,0 +1,11 @@
+"""sync helpers that block — safe only when a worker lane runs them."""
+import time
+
+
+def crunch():
+    time.sleep(0.1)
+    return 42
+
+
+def crunch_indirect():
+    return crunch()

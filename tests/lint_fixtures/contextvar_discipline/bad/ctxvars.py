@@ -1,0 +1,18 @@
+"""known-bad: ContextVar discipline violations."""
+import contextvars
+
+REQUEST_ID = contextvars.ContextVar("request_id")
+STACK = contextvars.ContextVar("stack", default=[])  # mutable default
+
+REQUEST_ID.set("module-scope")  # leaks into every context ever created
+
+
+def forgets_token(rid):
+    REQUEST_ID.set(rid)  # token discarded: nothing can ever reset this
+
+
+def leaks_on_exception(rid, work):
+    tok = REQUEST_ID.set(rid)
+    out = work()
+    REQUEST_ID.reset(tok)  # not in a finally: an exception path leaks
+    return out

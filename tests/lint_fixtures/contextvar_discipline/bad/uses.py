@@ -1,0 +1,8 @@
+"""known-bad: setting an IMPORTED ContextVar and dropping the token —
+the declaration lives in another module; only cross-module resolution
+can tell this receiver is a ContextVar at all."""
+from ctxvars import REQUEST_ID
+
+
+def set_and_forget(rid):
+    REQUEST_ID.set(rid)

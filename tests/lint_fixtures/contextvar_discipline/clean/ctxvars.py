@@ -1,0 +1,24 @@
+"""known-clean: token-disciplined ContextVar use."""
+import contextvars
+
+REQUEST_ID = contextvars.ContextVar("request_id")
+TRACE = contextvars.ContextVar("trace", default=None)  # immutable default
+
+
+def scoped(rid, work):
+    tok = REQUEST_ID.set(rid)
+    try:
+        return work()
+    finally:
+        REQUEST_ID.reset(tok)
+
+
+class Scope:
+    """the engine's context-manager idiom: token on self, reset in exit"""
+
+    def __enter__(self):
+        self._tok = TRACE.set("on")
+        return self
+
+    def __exit__(self, *exc):
+        TRACE.reset(self._tok)

@@ -1,0 +1,11 @@
+"""device-valued helpers — no syncs here; the callers are the bugs."""
+import jax.numpy as jnp
+
+
+def device_total(mask):
+    return jnp.sum(mask)
+
+
+def device_total_indirect(mask):
+    # one more hop: callers of this are TWO calls from the jnp reduction
+    return device_total(mask)

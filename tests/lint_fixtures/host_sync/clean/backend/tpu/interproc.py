@@ -1,0 +1,14 @@
+"""known-clean: cross-module device values sync only under fault_point,
+and host-valued helper returns never count as syncs."""
+from runtime.faults import fault_point
+
+from .helpers import device_total, row_count
+
+
+def guarded_cross_module(mask):
+    fault_point("compact")
+    return int(device_total(mask))
+
+
+def host_helper_is_not_a_sync(x):
+    return int(row_count(x))

@@ -1,0 +1,35 @@
+"""known-bad: shared-state mutations outside the owning discipline."""
+import threading
+
+
+class Tally:  # shared-by: lanes
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.count = 0
+
+    def bump(self):
+        self.count += 1  # lanes-shared mutation without the lock
+
+
+class Registry:  # shared-by: everyone
+    """unknown owner: the annotation must name lanes or loop"""
+
+    def __init__(self):
+        self.items = {}
+
+
+class Pool:
+    def run(self, fn):
+        return fn()
+
+
+class LoopOwned:  # shared-by: loop
+    def __init__(self, pool):
+        self.pool = pool
+        self.inflight = 0
+
+    def bump(self):
+        self.inflight += 1  # sync mutator, and a lane runs it (below)
+
+    async def dispatch(self):
+        return await self.pool.run(lambda: self.bump())

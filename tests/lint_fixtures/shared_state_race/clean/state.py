@@ -1,0 +1,43 @@
+"""known-clean: ownership discipline respected."""
+import threading
+
+
+class Tally:  # shared-by: lanes
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.count = 0
+
+    def bump(self):
+        with self._lock:
+            self.count += 1
+
+
+# shared-by: lanes
+class AboveForm:
+    """the annotation-above-the-class form"""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.items = []
+
+    def add(self, v):
+        with self._lock:
+            self.items.append(v)
+
+
+class LoopOwned:  # shared-by: loop
+    def __init__(self):
+        self.inflight = 0
+
+    async def bump(self):
+        self.inflight += 1  # async: always on the loop, single-threaded
+
+
+class Unshared:
+    """no annotation: the rule does not apply"""
+
+    def __init__(self):
+        self.x = 0
+
+    def set_value(self, v):
+        self.x = v

@@ -41,6 +41,7 @@ from .runner import (
     ENGINE_ROOT,
     check_engine,
     engine_is_clean,
+    engine_lint_summary,
     run_paths,
 )
 from .rules import ALL_RULES
@@ -53,5 +54,6 @@ __all__ = [
     "Rule",
     "check_engine",
     "engine_is_clean",
+    "engine_lint_summary",
     "run_paths",
 ]

@@ -6,6 +6,8 @@ Exit codes: 0 clean, 1 findings, 2 usage/internal error.
 from __future__ import annotations
 
 import argparse
+import os
+import subprocess
 import sys
 from typing import List, Optional
 
@@ -60,7 +62,48 @@ def build_parser() -> argparse.ArgumentParser:
         action="store_true",
         help="print the rule table and exit",
     )
+    p.add_argument(
+        "--changed-only",
+        action="store_true",
+        help="check only files git reports modified/untracked; the whole "
+        "tree is still parsed so cross-module (interprocedural) facts stay "
+        "complete",
+    )
     return p
+
+
+def _git_changed_files() -> Optional[List[str]]:
+    """Absolute paths of the .py files git reports changed (staged,
+    unstaged, or untracked) in the repo containing the engine package.
+    None when git is unavailable or this is not a work tree."""
+    cwd = os.path.dirname(ENGINE_ROOT)
+    try:
+        top = subprocess.run(
+            ["git", "rev-parse", "--show-toplevel"],
+            cwd=cwd, capture_output=True, text=True, timeout=10,
+        )
+        if top.returncode != 0:
+            return None
+        root = top.stdout.strip()
+        st = subprocess.run(
+            ["git", "status", "--porcelain"],
+            cwd=root, capture_output=True, text=True, timeout=10,
+        )
+        if st.returncode != 0:
+            return None
+    except (OSError, subprocess.SubprocessError):
+        return None
+    changed: List[str] = []
+    for line in st.stdout.splitlines():
+        if len(line) < 4:
+            continue
+        path = line[3:]
+        if " -> " in path:  # rename: lint the new name
+            path = path.split(" -> ", 1)[1]
+        path = path.strip().strip('"')
+        if path.endswith(".py"):
+            changed.append(os.path.join(root, path))
+    return changed
 
 
 def main(argv: Optional[List[str]] = None) -> int:
@@ -82,8 +125,17 @@ def main(argv: Optional[List[str]] = None) -> int:
     paths = args.paths or [ENGINE_ROOT]
     baseline = args.baseline or None
 
+    restrict = None
+    if args.changed_only:
+        restrict = _git_changed_files()
+        if restrict is None:
+            print("--changed-only needs a git work tree", file=sys.stderr)
+            return 2
+
     try:
-        report = run_paths(paths, rules=rule_ids, baseline_path=baseline)
+        report = run_paths(
+            paths, rules=rule_ids, baseline_path=baseline, restrict_to=restrict
+        )
     except ValueError as exc:  # malformed baseline
         print(str(exc), file=sys.stderr)
         return 2

@@ -1,0 +1,496 @@
+"""Interprocedural dataflow: the device-value taint lattice and the
+blocking-call summaries.
+
+Two analyses share the call graph, both computed as fixpoints over
+per-function summaries (classic bottom-up summary propagation — each
+function is summarized once, call sites consume summaries, iteration
+continues until nothing changes):
+
+**Device taint** — "does this expression hold a traced device array?"
+The lattice is ``device > unknown > host`` with one refinement: a
+function whose return value depends only on its parameters gets a
+PASSTHROUGH summary naming them, so call sites classify the actual
+arguments (``helper(x)`` is device-valued exactly when ``x`` is). Taint
+enters at the jax/jnp/lax/J intrinsics and at ``dispatch.launch``, flows
+through single-target assignments, arithmetic, subscripts, returns, and
+call sites (both directions: returns flow OUT to callers, argument taint
+flows IN to parameters), and dies at shape/dtype metadata. This is what
+makes the ``host-sync`` rule semantic: ``int(helper(x))`` flags when
+``helper`` returns a traced array from two files away, and a helper that
+syncs its own parameter flags when ANY caller passes it a device value.
+
+**Blocking summaries** — "can a call to this function block the thread?"
+Seeded at the blocking intrinsics (``time.sleep``, socket/subprocess
+ops, ``session.cypher``, device syncs — which reuse the device taint),
+propagated along call edges, with each summary carrying the CHAIN of
+calls that reaches the intrinsic so the ``async-blocking`` finding can
+say *why* (``handler -> helper -> time.sleep``). Calls inside lambdas do
+not propagate (a deferred body is not executed by its lexical encloser);
+callables handed to ``run_in_executor``/``to_thread`` are the sanctioned
+escape hatch and never taint the async def that awaits them.
+
+Everything is conservative in the direction that avoids false positives:
+an unresolvable call is UNKNOWN (not device, not blocking), a parameter
+with no resolved caller is UNKNOWN, and UNKNOWN never fires a rule.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Sequence, Set, Tuple, Union
+
+from .callgraph import CallGraph, FunctionInfo
+from .core import FileContext, dotted_name
+
+# -- the taint lattice -------------------------------------------------------
+
+DEVICE = "device"
+HOST = "host"
+UNKNOWN = "unknown"
+
+# a summary is a fixed verdict or ("passthrough", frozenset(param names)):
+# the return taint equals the join of those arguments' taints at the site
+Summary = Union[str, Tuple[str, frozenset]]
+
+# dotted-prefix spelling of "this call returns a device value" in this
+# codebase: jax/jnp/lax directly, J (the jit_ops alias), pl (pallas)
+_DEVICE_PREFIXES = ("jnp.", "jax.", "lax.", "J.", "pl.")
+_DEVICE_EXACT = ("dispatch.launch", "launch")
+# dtype/shape introspection: host-side metadata, not device values
+_METADATA_FUNCS = ("iinfo", "finfo", "dtype", "result_type", "ndim", "shape")
+_HOST_ATTRS = ("shape", "ndim", "size", "dtype")
+_HOST_BUILTINS = ("len", "range", "enumerate", "zip", "sorted", "repr", "str")
+
+
+def is_device_intrinsic(name: str) -> bool:
+    if not name:
+        return False
+    if name in _DEVICE_EXACT:
+        return True
+    if name.startswith("jax.device_put") or ".shape" in name:
+        return False
+    if name.split(".")[-1] in _METADATA_FUNCS:
+        return False
+    return name.startswith(_DEVICE_PREFIXES)
+
+
+def _join(verdicts) -> str:
+    out = HOST
+    saw = False
+    for v in verdicts:
+        saw = True
+        if v == DEVICE:
+            return DEVICE
+        if v != HOST:
+            out = UNKNOWN
+    return out if saw else UNKNOWN
+
+
+class DeviceTaint:
+    """Per-function return summaries + per-parameter taints, to fixpoint."""
+
+    def __init__(self, graph: CallGraph):
+        self.graph = graph
+        self.returns: Dict[ast.AST, Summary] = {}
+        self.params: Dict[Tuple[ast.AST, str], str] = {}
+        self._solve()
+
+    # -- public -------------------------------------------------------------
+
+    def classify(
+        self, ctx: FileContext, fn: Optional[ast.AST], expr: ast.AST
+    ) -> str:
+        """'device' | 'host' | 'unknown' for an expression at a rule's
+        query site, with parameters resolved through the computed
+        cross-call taints."""
+        v = self._classify(ctx, fn, expr, 0, symbolic=False)
+        return v if isinstance(v, str) else UNKNOWN
+
+    def return_summary(self, node: ast.AST) -> Summary:
+        return self.returns.get(node, UNKNOWN)
+
+    # -- fixpoint -----------------------------------------------------------
+
+    def _solve(self, max_rounds: int = 8) -> None:
+        infos = list(self.graph.infos.values())
+        for _ in range(max_rounds):
+            changed = False
+            for info in infos:
+                new = self._summarize(info)
+                if self.returns.get(info.node) != new:
+                    self.returns[info.node] = new
+                    changed = True
+            changed |= self._flow_params(infos)
+            if not changed:
+                return
+
+    def _summarize(self, info: FunctionInfo) -> Summary:
+        ctx, fn = info.ctx, info.node
+        verdicts: List[str] = []
+        passthrough: Set[str] = set()
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Return) or node.value is None:
+                continue
+            if ctx.enclosing_function(node) is not fn:
+                continue  # a nested def's return is not ours
+            v = self._classify(ctx, fn, node.value, 0, symbolic=True)
+            if isinstance(v, tuple) and v[0] == "param":
+                passthrough.add(v[1])
+            else:
+                verdicts.append(v)
+        if DEVICE in verdicts:
+            return DEVICE
+        if passthrough:
+            # host-valued alternate returns don't break passthrough — the
+            # call site join handles them
+            if all(v == HOST for v in verdicts) or not verdicts:
+                return ("passthrough", frozenset(passthrough))
+            return UNKNOWN
+        if verdicts and all(v == HOST for v in verdicts):
+            return HOST
+        return UNKNOWN
+
+    def _flow_params(self, infos: Sequence[FunctionInfo]) -> bool:
+        """Argument taint -> parameter taint, joined over every resolved
+        call site. A parameter nobody is seen calling stays UNKNOWN."""
+        incoming: Dict[Tuple[ast.AST, str], List[str]] = {}
+        for info in infos:
+            for site, targets in self.graph.callees(info):
+                if not targets:
+                    continue
+                arg_taints = [
+                    self._arg_taint(site.ctx, info.node, a)
+                    for a in site.call.args
+                ]
+                kw_taints = {
+                    kw.arg: self._arg_taint(site.ctx, info.node, kw.value)
+                    for kw in site.call.keywords
+                    if kw.arg is not None
+                }
+                for tgt in targets:
+                    names = tgt.ctx.param_names(tgt.node)
+                    if names and names[0] == "self":
+                        names = names[1:]
+                    for i, t in enumerate(arg_taints):
+                        if i < len(names):
+                            incoming.setdefault(
+                                (tgt.node, names[i]), []
+                            ).append(t)
+                    for k, t in kw_taints.items():
+                        if k in names:
+                            incoming.setdefault((tgt.node, k), []).append(t)
+        changed = False
+        for key, taints in incoming.items():
+            new = _join(taints)
+            if self.params.get(key, UNKNOWN) != new:
+                self.params[key] = new
+                changed = True
+        return changed
+
+    def _arg_taint(
+        self, ctx: FileContext, fn: Optional[ast.AST], expr: ast.AST
+    ) -> str:
+        v = self._classify(ctx, fn, expr, 0, symbolic=False)
+        return v if isinstance(v, str) else UNKNOWN
+
+    # -- the expression classifier ------------------------------------------
+
+    def _classify(
+        self,
+        ctx: FileContext,
+        fn: Optional[ast.AST],
+        expr: ast.AST,
+        depth: int,
+        symbolic: bool,
+    ):
+        """-> DEVICE | HOST | UNKNOWN | ("param", name) (symbolic mode
+        keeps parameters symbolic for passthrough summaries; query mode
+        resolves them through the cross-call taints)."""
+        if depth > 6:
+            return UNKNOWN
+        if isinstance(expr, ast.Constant):
+            return HOST
+        if isinstance(expr, (ast.JoinedStr, ast.FormattedValue, ast.Dict,
+                             ast.DictComp, ast.Lambda)):
+            return HOST
+        if isinstance(expr, ast.Attribute):
+            if expr.attr in _HOST_ATTRS:
+                return HOST
+            return self._classify(ctx, fn, expr.value, depth + 1, symbolic)
+        if isinstance(expr, ast.Subscript):
+            return self._classify(ctx, fn, expr.value, depth + 1, symbolic)
+        if isinstance(expr, (ast.Tuple, ast.List, ast.Set)):
+            vs = [
+                self._classify(ctx, fn, e, depth + 1, symbolic)
+                for e in expr.elts
+            ]
+            if DEVICE in vs:
+                return DEVICE  # a container OF device values syncs too
+            return HOST
+        if isinstance(expr, ast.Call):
+            return self._classify_call(ctx, fn, expr, depth, symbolic)
+        if isinstance(expr, (ast.BinOp, ast.BoolOp, ast.Compare, ast.UnaryOp)):
+            if isinstance(expr, ast.BinOp):
+                sides = [expr.left, expr.right]
+            elif isinstance(expr, ast.BoolOp):
+                sides = list(expr.values)
+            elif isinstance(expr, ast.Compare):
+                sides = [expr.left] + list(expr.comparators)
+            else:
+                sides = [expr.operand]
+            vs = [
+                self._classify(ctx, fn, s, depth + 1, symbolic)
+                for s in sides
+            ]
+            if DEVICE in vs:
+                return DEVICE
+            if any(isinstance(v, tuple) for v in vs):
+                # arithmetic ON a param is still param-shaped
+                name = next(v[1] for v in vs if isinstance(v, tuple))
+                return ("param", name)
+            return _join(v for v in vs if isinstance(v, str))
+        if isinstance(expr, ast.IfExp):
+            vs = [
+                self._classify(ctx, fn, s, depth + 1, symbolic)
+                for s in (expr.body, expr.orelse)
+            ]
+            if DEVICE in vs:
+                return DEVICE
+            return _join(v if isinstance(v, str) else UNKNOWN for v in vs)
+        if isinstance(expr, ast.Name):
+            return self._classify_name(ctx, fn, expr.id, depth, symbolic)
+        return UNKNOWN
+
+    def _classify_name(
+        self,
+        ctx: FileContext,
+        fn: Optional[ast.AST],
+        name: str,
+        depth: int,
+        symbolic: bool,
+    ):
+        if fn is not None and name in ctx.param_names(fn):
+            # parameter: symbolic for summaries, cross-call taint for rules
+            assigns = ctx.assignments(fn, name)
+            if not assigns:
+                if symbolic:
+                    return ("param", name)
+                return self.params.get((fn, name), UNKNOWN)
+        verdicts = []
+        for v in ctx.assignments(fn, name):
+            verdicts.append(self._classify(ctx, fn, v, depth + 1, symbolic))
+        if DEVICE in verdicts:
+            return DEVICE
+        params = [v for v in verdicts if isinstance(v, tuple)]
+        if params:
+            return params[0]
+        if verdicts:
+            return _join(verdicts)
+        return UNKNOWN
+
+    def _classify_call(
+        self,
+        ctx: FileContext,
+        fn: Optional[ast.AST],
+        call: ast.Call,
+        depth: int,
+        symbolic: bool,
+    ):
+        name = dotted_name(call.func)
+        if name in _HOST_BUILTINS or ".shape" in name:
+            return HOST
+        if is_device_intrinsic(name):
+            return DEVICE
+        if name in ("int", "float", "bool"):
+            return HOST  # the sync itself produces a host scalar
+        # metadata calls and .item() RETURN host values regardless of the
+        # receiver (the host-sync rule looks at .item()'s receiver itself)
+        if isinstance(call.func, ast.Attribute):
+            leaf = call.func.attr
+            if leaf in _METADATA_FUNCS or leaf == "item":
+                return HOST
+        targets = self.graph.resolve_call(ctx, call)
+        if targets:
+            vs: List[str] = []
+            for tgt in targets:
+                summary = self.returns.get(tgt.node, UNKNOWN)
+                if isinstance(summary, tuple):
+                    vs.append(
+                        self._passthrough_at_site(
+                            ctx, fn, call, tgt, summary[1], depth, symbolic
+                        )
+                    )
+                else:
+                    vs.append(summary)
+            if DEVICE in vs:
+                return DEVICE
+            return _join(vs)
+        if isinstance(call.func, ast.Attribute):
+            recv = self._classify(
+                ctx, fn, call.func.value, depth + 1, symbolic
+            )
+            if recv == DEVICE:
+                return DEVICE
+        return UNKNOWN
+
+    def _passthrough_at_site(
+        self,
+        ctx: FileContext,
+        fn: Optional[ast.AST],
+        call: ast.Call,
+        tgt: FunctionInfo,
+        param_names: frozenset,
+        depth: int,
+        symbolic: bool,
+    ) -> str:
+        names = tgt.ctx.param_names(tgt.node)
+        if names and names[0] == "self":
+            names = names[1:]
+        taints: List[str] = []
+        for i, arg in enumerate(call.args):
+            if i < len(names) and names[i] in param_names:
+                v = self._classify(ctx, fn, arg, depth + 1, symbolic)
+                taints.append(v if isinstance(v, str) else UNKNOWN)
+        for kw in call.keywords:
+            if kw.arg in param_names:
+                v = self._classify(ctx, fn, kw.value, depth + 1, symbolic)
+                taints.append(v if isinstance(v, str) else UNKNOWN)
+        if DEVICE in taints:
+            return DEVICE
+        return _join(taints) if taints else UNKNOWN
+
+
+# -- blocking summaries ------------------------------------------------------
+
+# calls that block the calling thread outright, by dotted name or prefix
+_BLOCKING_EXACT = {
+    "time.sleep": "time.sleep",
+    "os.system": "os.system",
+    "socket.create_connection": "socket.create_connection",
+    "jax.device_get": "jax.device_get (device sync)",
+    "device_get": "jax.device_get (device sync)",
+}
+_BLOCKING_PREFIXES = (
+    ("subprocess.", "subprocess"),
+    ("requests.", "requests network I/O"),
+    ("urllib.request.", "urllib network I/O"),
+)
+# attribute leaves that block when called on anything: the engine's own
+# synchronous query entry, raw device syncs, and thread-pool waits
+_BLOCKING_ATTRS = {
+    "cypher": "session.cypher (synchronous engine execution)",
+    "block_until_ready": "block_until_ready (device sync)",
+    "warmup": "warmup (compiles synchronously)",
+}
+
+
+class BlockingInfo:
+    """Why a function blocks: the call chain down to the intrinsic."""
+
+    __slots__ = ("chain",)
+
+    def __init__(self, chain: Tuple[str, ...]):
+        self.chain = chain
+
+    def via(self, hop: str) -> "BlockingInfo":
+        return BlockingInfo((hop,) + self.chain)
+
+    def render(self) -> str:
+        return " -> ".join(self.chain)
+
+
+def blocking_intrinsic(call: ast.Call) -> Optional[str]:
+    """The human-readable reason this call blocks the thread, or None."""
+    name = dotted_name(call.func)
+    if name in _BLOCKING_EXACT:
+        return _BLOCKING_EXACT[name]
+    for prefix, why in _BLOCKING_PREFIXES:
+        if name.startswith(prefix):
+            return why
+    if isinstance(call.func, ast.Attribute):
+        leaf = call.func.attr
+        if leaf in _BLOCKING_ATTRS:
+            return _BLOCKING_ATTRS[leaf]
+        # sock.recv/accept/connect: only when the receiver LOOKS like a
+        # socket (named sock/socket/conn_sock) — keeps asyncio writers out
+        if leaf in ("recv", "accept", "connect", "sendall"):
+            recv_name = dotted_name(call.func.value)
+            if "sock" in recv_name.split(".")[-1]:
+                return f"socket.{leaf}"
+    return None
+
+
+class BlockingSummaries:
+    """Transitive can-block verdicts for every project function."""
+
+    def __init__(self, graph: CallGraph, taint: DeviceTaint):
+        self.graph = graph
+        self.taint = taint
+        self.blocks: Dict[ast.AST, BlockingInfo] = {}
+        self._solve()
+
+    def direct_reason(
+        self, info: FunctionInfo, site_call: ast.Call
+    ) -> Optional[str]:
+        """The reason this ONE call blocks the calling thread (an intrinsic
+        or a taint-resolved device sync), or None. Shared with the
+        async-blocking rule so both agree on what 'blocking' means."""
+        reason = blocking_intrinsic(site_call)
+        if reason is not None:
+            return reason
+        # a device sync (int/float/bool/np.asarray of a device value,
+        # .item() on one) blocks on the device stream
+        name = dotted_name(site_call.func)
+        ctx, fn = info.ctx, info.node
+        if name in ("int", "float", "bool") and len(site_call.args) == 1:
+            if self.taint.classify(ctx, fn, site_call.args[0]) == DEVICE:
+                return f"{name}(<device value>) (device sync)"
+        if name in ("np.asarray", "numpy.asarray") and site_call.args:
+            if self.taint.classify(ctx, fn, site_call.args[0]) == DEVICE:
+                return "np.asarray(<device value>) (device sync)"
+        if (
+            isinstance(site_call.func, ast.Attribute)
+            and site_call.func.attr == "item"
+            and not site_call.args
+        ):
+            if self.taint.classify(ctx, fn, site_call.func.value) != HOST:
+                return ".item() (device sync)"
+        return None
+
+    def _solve(self, max_rounds: int = 12) -> None:
+        infos = list(self.graph.infos.values())
+        # seed: direct intrinsics (never through a lambda — deferred)
+        for info in infos:
+            for site, _targets in self.graph.callees(info):
+                if site.in_lambda:
+                    continue
+                reason = self.direct_reason(info, site.call)
+                if reason is not None and info.node not in self.blocks:
+                    self.blocks[info.node] = BlockingInfo((reason,))
+        for _ in range(max_rounds):
+            changed = False
+            for info in infos:
+                if info.node in self.blocks or info.is_async:
+                    # an async def never blocks its CALLER by being called
+                    # (calling it just builds a coroutine)
+                    continue
+                for site, targets in self.graph.callees(info):
+                    if site.in_lambda:
+                        continue
+                    for tgt in targets:
+                        if tgt.is_async:
+                            continue
+                        sub = self.blocks.get(tgt.node)
+                        if sub is not None:
+                            self.blocks[info.node] = sub.via(
+                                f"{tgt.qualname}()"
+                            )
+                            changed = True
+                            break
+                    if info.node in self.blocks:
+                        break
+            if not changed:
+                return
+
+    def blocking_reason(self, node: ast.AST) -> Optional[BlockingInfo]:
+        return self.blocks.get(node)

@@ -29,13 +29,22 @@ class ProjectContext:
     ``dispatch_impls`` — function names registered as kernel impls via
     ``dispatch.register(name, site, impls=(..))`` anywhere in the analyzed
     set: the allowlist for raw ``pl.pallas_call`` sites.
+
+    The interprocedural substrate — ``callgraph``, ``device_taint``,
+    ``blocking`` — is built LAZILY on first access and shared by every
+    rule in the run: rules that stay per-file never pay for it, and the
+    fixpoints run at most once per analysis invocation.
     """
 
     def __init__(self, contexts: Iterable[FileContext]):
+        self.contexts = list(contexts)
         self.declared_env_vars: Optional[Set[str]] = None
         self.dispatch_impls: Set[str] = set()
         self.by_relpath: Dict[str, FileContext] = {}
-        for ctx in contexts:
+        self._callgraph = None
+        self._device_taint = None
+        self._blocking = None
+        for ctx in self.contexts:
             self.by_relpath[ctx.relpath] = ctx
             if ctx.relpath.endswith(CONFIG_MODULE_SUFFIX):
                 declared = self._collect_declared(ctx)
@@ -43,6 +52,32 @@ class ProjectContext:
                     self.declared_env_vars = set()
                 self.declared_env_vars |= declared
             self.dispatch_impls |= self._collect_impls(ctx)
+
+    # -- the interprocedural substrate (lazy, shared across rules) ----------
+
+    @property
+    def callgraph(self):
+        if self._callgraph is None:
+            from .callgraph import CallGraph
+
+            self._callgraph = CallGraph(self.contexts, self.dispatch_impls)
+        return self._callgraph
+
+    @property
+    def device_taint(self):
+        if self._device_taint is None:
+            from .dataflow import DeviceTaint
+
+            self._device_taint = DeviceTaint(self.callgraph)
+        return self._device_taint
+
+    @property
+    def blocking(self):
+        if self._blocking is None:
+            from .dataflow import BlockingSummaries
+
+            self._blocking = BlockingSummaries(self.callgraph, self.device_taint)
+        return self._blocking
 
     @staticmethod
     def _collect_declared(ctx: FileContext) -> Set[str]:

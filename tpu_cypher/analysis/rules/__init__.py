@@ -1,9 +1,14 @@
-"""The rule registry: six engine-grounded invariants, one shared pass.
+"""The rule registry: nine engine-grounded invariants, one shared pass.
 
 Adding a rule = subclass ``core.Rule``, give it a kebab-case ``id``, and
 list an instance here. Rules are documented (id, rationale, fixture pair)
 in ``docs/static-analysis.md``; every rule must ship a known-bad and a
 known-clean fixture under ``tests/lint_fixtures/``.
+
+Six rules are per-file; ``host-sync`` and the concurrency pack
+(``async-blocking``, ``contextvar-discipline``, ``shared-state-race``)
+additionally consume the interprocedural substrate (``callgraph.py`` /
+``dataflow.py``) the ``ProjectContext`` builds lazily on first use.
 """
 
 from __future__ import annotations
@@ -11,12 +16,15 @@ from __future__ import annotations
 from typing import Dict, List
 
 from ..core import Rule
+from .async_blocking import AsyncBlockingRule
+from .contextvar_discipline import ContextvarDisciplineRule
 from .env_registry import EnvVarRegistryRule
 from .exception_hygiene import ExceptionHygieneRule
 from .host_sync import HostSyncRule
 from .obs_emission import ObsEmissionRule
 from .pad_invariant import PadInvariantRule
 from .recompile import RecompileHazardRule
+from .shared_state_race import SharedStateRaceRule
 
 ALL_RULES: List[Rule] = [
     HostSyncRule(),
@@ -25,6 +33,9 @@ ALL_RULES: List[Rule] = [
     EnvVarRegistryRule(),
     ExceptionHygieneRule(),
     ObsEmissionRule(),
+    AsyncBlockingRule(),
+    ContextvarDisciplineRule(),
+    SharedStateRaceRule(),
 ]
 
 RULES_BY_ID: Dict[str, Rule] = {r.id: r for r in ALL_RULES}

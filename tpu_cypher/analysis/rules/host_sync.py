@@ -9,10 +9,16 @@ that is what makes the fault-injection matrix exhaustive and the ladder's
 retry windows deterministic. A sync outside a fault-pointed function is
 invisible to injection, to the deadline, and to obs.
 
-Detection is scope-resolved, not textual: ``int(x)`` is only a sync when
-``x`` (after chasing single-target assignments in the same function) comes
-from a device-producing call (``jnp.*`` / ``jax.*`` / ``lax.*`` / jit-op
-aliases); ``int(x.shape[0])`` and host arithmetic never flag.
+Detection is SEMANTIC, not textual: the project-wide device-taint lattice
+(``analysis/dataflow.py``) decides whether a value is device-array-valued,
+chasing assignments, returns, and call sites across modules. ``int(x)``
+flags when ``x`` came from ``helper(rows)`` and ``helper`` returns
+``jnp.cumsum(...)`` two files away — or when ``helper`` is a passthrough
+and the ARGUMENT was device-valued; ``int(x.shape[0])``, host arithmetic,
+and metadata never flag. Containment stays lexical on purpose: the
+contract is that the sync site's OWN function (or a lexical encloser)
+passes through ``fault_point`` — a fault-pointed caller three frames up
+does not make the sync observable at the right site name.
 """
 
 from __future__ import annotations
@@ -22,30 +28,11 @@ from typing import Iterator, Optional
 
 from ..core import FileContext, Finding, Rule, dotted_name
 from ..project import ProjectContext
+from ..dataflow import DEVICE, HOST
 
 SCOPE_DIRS = ("backend/tpu/", "parallel/")
 
-# dotted-prefix spelling of "this call returns a device value" in this
-# codebase: jax/jnp/lax directly, J (the jit_ops alias), dispatch.launch
-_DEVICE_PREFIXES = ("jnp.", "jax.", "lax.", "J.", "pl.")
-_DEVICE_EXACT = ("dispatch.launch", "launch")
 _SYNC_BUILTINS = ("int", "float", "bool")
-
-
-# dtype/shape metadata: host-side introspection, not device values
-_METADATA_FUNCS = ("iinfo", "finfo", "dtype", "result_type", "ndim", "shape")
-
-
-def _is_device_call(name: str) -> bool:
-    if not name:
-        return False
-    if name in _DEVICE_EXACT:
-        return True
-    if name.startswith("jax.device_put") or ".shape" in name:
-        return False
-    if name.split(".")[-1] in _METADATA_FUNCS:
-        return False
-    return name.startswith(_DEVICE_PREFIXES)
 
 
 class HostSyncRule(Rule):
@@ -61,11 +48,12 @@ class HostSyncRule(Rule):
     ) -> Iterator[Finding]:
         if not any(d in ctx.relpath for d in SCOPE_DIRS):
             return
+        taint = project.device_taint
         for call in ctx.calls:
             fn = ctx.enclosing_function(call)
             if fn is None:
                 continue  # module scope: import-time constants, not syncs
-            sync = self._sync_kind(ctx, fn, call)
+            sync = self._sync_kind(taint, ctx, fn, call)
             if sync is None:
                 continue
             if self._under_fault_point(ctx, fn):
@@ -80,8 +68,9 @@ class HostSyncRule(Rule):
 
     # -- sync detection -----------------------------------------------------
 
+    @staticmethod
     def _sync_kind(
-        self, ctx: FileContext, fn: ast.AST, call: ast.Call
+        taint, ctx: FileContext, fn: ast.AST, call: ast.Call
     ) -> Optional[str]:
         name = dotted_name(call.func)
         if name in ("jax.device_get", "device_get"):
@@ -91,61 +80,17 @@ class HostSyncRule(Rule):
             and call.func.attr == "item"
             and not call.args
         ):
-            if self._classify(ctx, fn, call.func.value, 0) != "host":
+            if taint.classify(ctx, fn, call.func.value) != HOST:
                 return ".item()"
             return None
         if name in _SYNC_BUILTINS and len(call.args) == 1:
-            if self._classify(ctx, fn, call.args[0], 0) == "device":
+            if taint.classify(ctx, fn, call.args[0]) == DEVICE:
                 return f"{name}(<device value>)"
             return None
         if name in ("np.asarray", "numpy.asarray") and call.args:
-            if self._classify(ctx, fn, call.args[0], 0) == "device":
+            if taint.classify(ctx, fn, call.args[0]) == DEVICE:
                 return "np.asarray(<device value>)"
         return None
-
-    def _classify(
-        self, ctx: FileContext, fn: ast.AST, expr: ast.AST, depth: int
-    ) -> str:
-        """'device' | 'host' | 'unknown' for one expression, chasing
-        single-target assignments in the same function up to 4 hops."""
-        if depth > 4:
-            return "unknown"
-        if isinstance(expr, ast.Constant):
-            return "host"
-        if isinstance(expr, ast.Attribute):
-            if expr.attr in ("shape", "ndim", "size", "dtype"):
-                return "host"
-            return self._classify(ctx, fn, expr.value, depth + 1)
-        if isinstance(expr, ast.Subscript):
-            return self._classify(ctx, fn, expr.value, depth + 1)
-        if isinstance(expr, ast.Call):
-            name = dotted_name(expr.func)
-            if name == "len" or ".shape" in name:
-                return "host"
-            if _is_device_call(name):
-                return "device"
-            return "unknown"
-        if isinstance(expr, ast.BinOp):
-            sides = {
-                self._classify(ctx, fn, expr.left, depth + 1),
-                self._classify(ctx, fn, expr.right, depth + 1),
-            }
-            if "device" in sides:
-                return "device"
-            if sides == {"host"}:
-                return "host"
-            return "unknown"
-        if isinstance(expr, ast.Name):
-            verdicts = {
-                self._classify(ctx, fn, v, depth + 1)
-                for v in ctx.assignments(fn, expr.id)
-            }
-            if "device" in verdicts:
-                return "device"
-            if verdicts == {"host"}:
-                return "host"
-            return "unknown"
-        return "unknown"
 
     # -- fault_point containment --------------------------------------------
 

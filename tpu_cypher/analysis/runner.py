@@ -1,5 +1,12 @@
 """Orchestration: collect files, build contexts once, run every rule,
 apply suppressions and the baseline, format the report.
+
+Parsing is cached process-wide keyed by ``(abspath, mtime_ns, size)`` —
+repeated ``run_paths`` calls in one process (the test suite runs the
+analyzer dozens of times) re-parse only files that actually changed.
+``restrict_to`` narrows which files RULES run on while still parsing the
+whole tree, so the interprocedural substrate (call graph, taint) sees
+every definition even when only a git-changed subset is being checked.
 """
 
 from __future__ import annotations
@@ -7,7 +14,7 @@ from __future__ import annotations
 import json
 import os
 from dataclasses import dataclass, field
-from typing import Dict, List, Optional, Sequence
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
 
 from . import baseline as baseline_mod
 from .core import FileContext, Finding
@@ -21,7 +28,14 @@ DEFAULT_BASELINE = os.path.join(
     os.path.dirname(os.path.abspath(__file__)), "baseline.json"
 )
 
+# version stamp on the ``suppressions`` section of --format json output
+SUPPRESSION_SCHEMA_VERSION = 1
+
 _SKIP_DIRS = {"__pycache__", ".git", "node_modules"}
+
+# (abspath) -> ((mtime_ns, size, relpath), FileContext): one parse per
+# file VERSION per process, shared across run_paths calls
+_PARSE_CACHE: Dict[str, Tuple[Tuple[int, int, str], FileContext]] = {}
 
 
 @dataclass
@@ -34,12 +48,22 @@ class Report:
     suppressed: List[Finding] = field(default_factory=list)
     baselined: List[Finding] = field(default_factory=list)
     suppress_reasons: Dict[Finding, str] = field(default_factory=dict)
+    # every well-formed suppression seen, fired or not — the auditable
+    # debt ledger ``--format json`` exports as the ``suppressions`` section
+    suppression_entries: List[Dict[str, object]] = field(default_factory=list)
     files_checked: int = 0
     rules_run: int = 0
 
     @property
     def clean(self) -> bool:
         return not self.blocking
+
+    def counts_by_rule(self) -> Dict[str, int]:
+        """{rule id: blocking finding count} — the bench.py lint field."""
+        out: Dict[str, int] = {}
+        for f in self.blocking:
+            out[f.rule] = out.get(f.rule, 0) + 1
+        return out
 
     def to_json(self) -> Dict[str, object]:
         return {
@@ -52,6 +76,10 @@ class Report:
                 for f in self.suppressed
             ],
             "baselined": [f.to_json() for f in self.baselined],
+            "suppressions": {
+                "schema_version": SUPPRESSION_SCHEMA_VERSION,
+                "entries": self.suppression_entries,
+            },
         }
 
     def render_text(self) -> str:
@@ -100,46 +128,85 @@ def _relpath(path: str) -> str:
     return chosen.replace(os.path.sep, "/")
 
 
+def _load_context(path: str, rel: str) -> FileContext:
+    """Parse ``path`` into a FileContext, reusing the process-wide cache
+    when (mtime_ns, size, relpath) are unchanged. FileContext is immutable
+    after construction, so sharing one across runs is safe."""
+    a = os.path.abspath(path)
+    try:
+        st = os.stat(a)
+        key = (st.st_mtime_ns, st.st_size, rel)
+    except OSError:
+        key = None
+    if key is not None:
+        hit = _PARSE_CACHE.get(a)
+        if hit is not None and hit[0] == key:
+            return hit[1]
+    with open(path, "r") as f:
+        source = f.read()
+    ctx = FileContext(path, rel, source)
+    if key is not None:
+        _PARSE_CACHE[a] = (key, ctx)
+    return ctx
+
+
 def run_paths(
     paths: Sequence[str],
     rules: Optional[Sequence[str]] = None,
     baseline_path: Optional[str] = None,
+    restrict_to: Optional[Iterable[str]] = None,
 ) -> Report:
     """Analyze ``paths`` (files or directories). ``rules`` limits to a
     subset of rule ids; ``baseline_path`` points at a grandfather file
-    (None = no baseline). Raises ``KeyError`` on an unknown rule id."""
+    (None = no baseline). ``restrict_to`` (paths) narrows which files the
+    RULES check and report on — the whole tree is still parsed so the
+    interprocedural substrate stays complete (``--changed-only``). Raises
+    ``KeyError`` on an unknown rule id."""
     active = (
         ALL_RULES
         if rules is None
         else [RULES_BY_ID[r] for r in rules]
     )
+    active_ids = {r.id for r in active}
     report = Report(rules_run=len(active))
+
+    restrict = (
+        None
+        if restrict_to is None
+        else {os.path.abspath(p) for p in restrict_to}
+    )
 
     contexts: List[FileContext] = []
     for path in _collect_files(paths):
         rel = _relpath(path)
+        in_scope = restrict is None or os.path.abspath(path) in restrict
         try:
-            with open(path, "r") as f:
-                source = f.read()
-            ctx = FileContext(path, rel, source)
+            ctx = _load_context(path, rel)
         except (SyntaxError, UnicodeDecodeError, OSError) as exc:
-            report.blocking.append(
-                Finding(
-                    "parse",
-                    rel,
-                    getattr(exc, "lineno", 0) or 0,
-                    0,
-                    f"unparsable file: {exc}",
+            if in_scope:
+                report.blocking.append(
+                    Finding(
+                        "parse",
+                        rel,
+                        getattr(exc, "lineno", 0) or 0,
+                        0,
+                        f"unparsable file: {exc}",
+                    )
                 )
-            )
             continue
         contexts.append(ctx)
-    report.files_checked = len(contexts)
+
+    checked = [
+        c
+        for c in contexts
+        if restrict is None or os.path.abspath(c.path) in restrict
+    ]
+    report.files_checked = len(checked)
 
     project = ProjectContext(contexts)
 
     raw: List[Finding] = []
-    for ctx in contexts:
+    for ctx in checked:
         # malformed / reason-less suppressions are findings themselves
         for f in ctx.suppression_findings:
             raw.append(
@@ -153,6 +220,46 @@ def run_paths(
                     report.suppress_reasons[f] = reason
                 else:
                     raw.append(f)
+
+    # suppression inventory + stale detection: an allow whose named rules
+    # ALL ran this pass but suppressed nothing marks a site that is clean
+    # now — the comment itself becomes the finding. Suppressions naming
+    # any rule OUTSIDE the active set are skipped (a restricted run cannot
+    # know whether the other rule still fires there).
+    for ctx in checked:
+        for s in ctx.suppressions:
+            if not any(r in RULES_BY_ID for r in s.rules):
+                # syntax examples in docstrings (allow[rule-id] ...) parse
+                # as suppressions for nonexistent rules; they suppress
+                # nothing and don't belong in the inventory
+                continue
+            fired = any(
+                f.path == ctx.relpath
+                and f.rule in s.rules
+                and f.line in s.covers
+                for f in report.suppressed
+            )
+            report.suppression_entries.append(
+                {
+                    "path": ctx.relpath,
+                    "line": s.line,
+                    "rules": list(s.rules),
+                    "reason": s.reason,
+                    "active": fired,
+                }
+            )
+            if not fired and all(r in active_ids for r in s.rules):
+                raw.append(
+                    Finding(
+                        "suppression",
+                        ctx.relpath,
+                        s.line,
+                        0,
+                        "stale suppression: allow[%s] matched no finding "
+                        "this run — the site is clean now; delete the "
+                        "comment" % ",".join(s.rules),
+                    )
+                )
 
     raw.sort(key=lambda f: (f.path, f.line, f.col, f.rule, f.message))
 
@@ -186,6 +293,23 @@ def engine_is_clean() -> bool:
         return check_engine().clean
     except Exception:  # fault-ok: a lint crash must not fail the bench line
         return False
+
+
+def engine_lint_summary() -> Dict[str, object]:
+    """The bench.py ``lint_clean`` payload: verdict plus per-rule blocking
+    finding counts, so a regressed invariant names itself on the JSON line
+    instead of flipping an opaque boolean. Never raises — an analyzer
+    crash reports ``{"clean": False, "error": ...}``."""
+    try:
+        report = check_engine()
+        return {
+            "clean": report.clean,
+            "findings_by_rule": report.counts_by_rule(),
+            "suppressed": len(report.suppressed),
+            "files_checked": report.files_checked,
+        }
+    except Exception as exc:  # fault-ok: a lint crash must not fail the bench line
+        return {"clean": False, "findings_by_rule": {}, "error": str(exc)[:200]}
 
 
 def format_report(report: Report, fmt: str) -> str:

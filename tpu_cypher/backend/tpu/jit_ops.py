@@ -1108,22 +1108,28 @@ def mxu_close_count_tiled(t1, t2, tc, mult, mask_b, mask_c):
     as ``DenseTiles`` row-block providers. Lifts the dense tier's
     node-count cap (graphs larger than ``dense_adj``'s limit still ride
     the MXU)."""
+    from ...runtime.faults import fault_point
+
     block = t1.block
     mc = jnp.ones(t1.npad, jnp.bfloat16) if mask_c is None else mask_c
     acc = 0
     for i, p2 in _mxu_tiled_p2(t1, t2, mask_b):
         mult_i = lax.dynamic_slice_in_dim(mult, i * block, block, 0)
+        fault_point("mxu_tile")  # per-row-block scalar sync below
         acc += int(_mxu_close_finish(p2, tc.tile(i), mc, mult_i))
     return acc
 
 
 def mxu_distinct_pairs_tiled(t1, t2, present, mask_b, mask_c):
     """Tiled variant of ``mxu_distinct_pairs`` (see above)."""
+    from ...runtime.faults import fault_point
+
     block = t1.block
     mc = jnp.ones(t1.npad, jnp.bfloat16) if mask_c is None else mask_c
     acc = 0
     for i, p2 in _mxu_tiled_p2(t1, t2, mask_b):
         pres_i = lax.dynamic_slice_in_dim(present, i * block, block, 0)
+        fault_point("mxu_tile")  # per-row-block scalar sync below
         acc += int(_mxu_distinct_finish(p2, mc, pres_i))
     return acc
 

@@ -243,11 +243,14 @@ def join_probe_bucketed(
     )
 
     def pallas_fn(interpret: bool):
+        from ....runtime.faults import fault_point
+
         size = bucketing.round_up_pow2(2 * nvalid_cap)
         build = _hash_build(
             rd.astype(jnp.int64), r_order, nvalid, cap=nvalid_cap, size=size
         )
-        if not bool(build[4]):  # one scalar sync: the build verdict
+        fault_point("join_build")  # the build-verdict scalar sync below
+        if not bool(build[4]):
             return None
         lo, counts, total = _hash_probe_pallas(
             build[0], build[1], build[2], build[3],

@@ -104,7 +104,7 @@ class _HistState:
         return out
 
 
-class Metric:
+class Metric:  # shared-by: lanes
     """One metric family: (name, help, label names) plus its series map."""
 
     kind = "untyped"
@@ -295,7 +295,7 @@ class MetricsScope:
         return out
 
 
-class MetricsRegistry:
+class MetricsRegistry:  # shared-by: lanes
     """The metric namespace: get-or-create by name, idempotent (a second
     registration with a different kind or label set is an error, not a
     silent shadow)."""

@@ -36,11 +36,7 @@ def _demo_graph(session: CypherSession, n: int = 32):
     return session.create_graph_from_create_query("CREATE " + ", ".join(parts))
 
 
-async def _main() -> int:
-    session = CypherSession.tpu()
-    server = QueryServer(session)
-    server.register_graph("demo", _demo_graph(session))
-    stats = server.warmup(DEMO_WARMUP, "demo")
+async def _serve(server: QueryServer, stats) -> int:
     await server.start()
     print(
         f"tpu-cypher query server on {server.host}:{server.port} "
@@ -56,8 +52,19 @@ async def _main() -> int:
     return 0
 
 
+def _main() -> int:
+    # the blocking setup — session bring-up, demo graph, warmup compiles —
+    # happens BEFORE the event loop exists; the loop only ever runs
+    # non-blocking serving code (the async-blocking lint pins this)
+    session = CypherSession.tpu()
+    server = QueryServer(session)
+    server.register_graph("demo", _demo_graph(session))
+    stats = server.warmup(DEMO_WARMUP, "demo")
+    return asyncio.run(_serve(server, stats))
+
+
 if __name__ == "__main__":
     try:
-        sys.exit(asyncio.run(_main()))
+        sys.exit(_main())
     except KeyboardInterrupt:
         sys.exit(130)

@@ -58,7 +58,7 @@ def batch_key(session, query: str, graph, parameters: Dict[str, Any]):
     return (plan_key, values, bucket_signature())
 
 
-class Batch:
+class Batch:  # shared-by: loop
     """One open coalescing group: the leader executes, members share."""
 
     __slots__ = ("key", "leader_id", "members", "done", "result", "error")
@@ -76,7 +76,7 @@ class Batch:
         return len(self.members)
 
 
-class BatchWindow:
+class BatchWindow:  # shared-by: loop
     """The coalescer. Protocol (all on the event loop):
 
     * ``lead_or_join(key, qid)`` -> ``(batch, is_leader)``. The leader

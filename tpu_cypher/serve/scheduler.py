@@ -101,7 +101,7 @@ class _Waiter:
         self.event = asyncio.Event()
 
 
-class AdmissionScheduler:
+class AdmissionScheduler:  # shared-by: loop
     """Bounded concurrency with cost-ordered, tenant-fair slot grants."""
 
     def __init__(self, max_concurrent: int, tenant_quota: int = 0):

@@ -116,7 +116,7 @@ class _Ticket:
         self.submitted_at = time.monotonic()
 
 
-class _Conn:
+class _Conn:  # shared-by: loop
     """One client connection: serialized writes, many in-flight queries."""
 
     def __init__(self, writer: asyncio.StreamWriter):
@@ -138,7 +138,7 @@ class _Conn:
                 self.closed = True
 
 
-class QueryServer:
+class QueryServer:  # shared-by: loop
     """The multi-tenant front end over one warm ``CypherSession``."""
 
     def __init__(
