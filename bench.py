@@ -63,6 +63,14 @@ VAR_LENGTH = (
     "MATCH (a:Person) WHERE a.id >= $lo AND a.id < $hi WITH a "
     "MATCH (a)-[:KNOWS*1..3]->(b:Person) RETURN count(*) AS walks"
 )
+CLIQUE4 = (
+    # directed 4-clique: the triangle plus a fourth vertex every corner
+    # points at — two cycle-closing ExpandIntos, so the WCOJ plan runs a
+    # 2-close multiway intersection where the binary plan joins 6 scans
+    "MATCH (a:Person)-[:KNOWS]->(b:Person)-[:KNOWS]->(c:Person)-[:KNOWS]->(a), "
+    "(a)-[:KNOWS]->(d:Person), (b)-[:KNOWS]->(d), (c)-[:KNOWS]->(d) "
+    "RETURN count(*) AS cliques"
+)
 
 
 # ---------------------------------------------------------------------------
@@ -220,9 +228,15 @@ def _tier_snapshot():
     from tpu_cypher.backend.tpu import expand_op as X
     from tpu_cypher.backend.tpu.pallas import dispatch as PD
 
+    from tpu_cypher.backend.tpu import wcoj as W
+
     return {
         **{f"mxu_{k}": v for k, v in X.MXU_TIER_COUNTS.items()},
         **{f"native_{k}": v for k, v in X.NATIVE_TIER_COUNTS.items()},
+        # which tier answered each multiway-intersect pull (count /
+        # materialize / shadow) — the per-rung tier strings record e.g.
+        # "wcoj_count"
+        **{f"wcoj_{k}": v for k, v in W.WCOJ_TIER_COUNTS.items()},
         # which Pallas kernels actually launched (vs fell back) — the
         # per-rung tier strings record e.g. "pallas_join_probe"
         **{f"pallas_{k}": v["pallas"] for k, v in PD.use_counts().items()},
@@ -331,6 +345,46 @@ def _roofline(n: int, e: int, paths: int, dt: float, on_tpu: bool) -> dict:
     return entry
 
 
+def _wcoj_vs_binary(g, feasible_binary: bool) -> dict:
+    """Triangle + 4-clique counting under the multiway-intersect plan vs
+    the binary-join plan, in the same process on the same warm graph. The
+    mode override works at PLAN time (``plan_multiway_intersect_fastpath``
+    reads ``TPU_CYPHER_WCOJ`` per query), so each leg replans; counts must
+    match bit-identically whenever both legs run."""
+    from tpu_cypher.utils.config import WCOJ_MODE
+
+    entry = {}
+    for label, query, key in (
+        ("triangle", TRIANGLE, "triangles"),
+        ("clique4", CLIQUE4, "cliques"),
+    ):
+        WCOJ_MODE.set("force")
+        try:
+            dtw, outw, tierw = _time_query(g, query, repeats=1)
+        finally:
+            WCOJ_MODE.reset()
+        leg = {
+            "wcoj_seconds": round(dtw, 6),
+            "count": int(outw[0][key]),
+            "wcoj_tier": tierw,
+        }
+        if feasible_binary:
+            WCOJ_MODE.set("off")
+            try:
+                dtb, outb, tierb = _time_query(g, query, repeats=1)
+            finally:
+                WCOJ_MODE.reset()
+            leg["binary_seconds"] = round(dtb, 6)
+            leg["binary_tier"] = tierb
+            leg["counts_match"] = int(outb[0][key]) == leg["count"]
+            leg["wcoj_speedup"] = round(dtb / max(dtw, 1e-9), 2)
+        else:
+            leg["binary_seconds"] = None
+            leg["binary_skipped"] = "binary transient arrays over budget"
+        entry[label] = leg
+    return entry
+
+
 def run_config(
     name: str, scale: float, session, results: dict, budget_rows: int,
     on_tpu: bool = False,
@@ -369,17 +423,23 @@ def run_config(
         rung["seconds_two_hop_distinct"] = None
         rung["distinct_skipped"] = f"2-hop rows {two_hop_paths} over budget"
 
-    # triangle runs as the fused chain+close-probe program (no row-set
-    # materialization); the transient per-program arrays still scale with
-    # the 2-hop row count, so keep a generous gate
-    if two_hop_paths <= budget_rows * 8:
-        dt, out, tier = _time_query(g, TRIANGLE, repeats=1)
-        rung["seconds_triangle"] = round(dt, 6)
-        rung["triangles"] = int(out[0]["triangles"])
-        rung["tier_triangle"] = tier
-    else:
-        rung["seconds_triangle"] = None
-        rung["triangle_skipped"] = f"2-hop rows {two_hop_paths} over budget"
+    # the triangle always runs: oversized rungs route through the WCOJ
+    # multiway intersection (auto eligibility — the degree-stats estimate
+    # E*max_deg dwarfs TPU_CYPHER_WCOJ_MIN_ROWS at ladder scale), whose
+    # count tier never materializes the 2-hop row set, so the old
+    # ``triangle_skipped`` budget bail is gone
+    dt, out, tier = _time_query(g, TRIANGLE, repeats=1)
+    rung["seconds_triangle"] = round(dt, 6)
+    rung["triangles"] = int(out[0]["triangles"])
+    rung["tier_triangle"] = tier
+
+    # WCOJ-vs-binary differential rung: the same cyclic shapes timed under
+    # both plans in the same run (the ISSUE-10 / ROADMAP-2 acceptance
+    # measurement). The binary leg is skipped when its transient arrays
+    # would blow the budget — exactly the regime WCOJ exists for.
+    rung["wcoj_vs_binary"] = _wcoj_vs_binary(
+        g, feasible_binary=two_hop_paths <= budget_rows * 8
+    )
 
     # var-length: pick a mid-range source-id window (away from the zipf
     # hubs at low ids) sized so the projected <=3-hop walk count stays
@@ -494,6 +554,66 @@ _TPU_ENV_HINTS = (
 )
 
 
+def _gce_metadata(path: str):
+    """One GCE metadata-server attribute, or None off-GCE / on timeout."""
+    import urllib.request
+
+    req = urllib.request.Request(
+        f"http://metadata.google.internal/computeMetadata/v1/{path}",
+        headers={"Metadata-Flavor": "Google"},
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=2) as r:
+            return r.read().decode().strip() or None
+    except Exception:  # fault-ok: no metadata server outside GCE
+        return None
+
+
+def _derive_tpu_env(log: list) -> None:
+    """BENCH_r05's real-TPU attempt died INSIDE libtpu env detection
+    (rc=1 before any JSON line): a host with a chip but without
+    ``TPU_ACCELERATOR_TYPE``/``TPU_WORKER_HOSTNAMES`` aborts ``import
+    jax``. Derive and export them BEFORE any jax import (the probe
+    children inherit this environ) when a chip device node is present:
+    accelerator type from the GCE metadata server, hostnames from the
+    worker-network-endpoints attribute with a localhost single-host
+    default. Chipless hosts are left untouched (the CPU fallback then
+    scrubs the hint vars exactly as before), what was set is recorded in
+    the probe log, and nothing here can raise — the one-JSON-line
+    guarantee does not depend on metadata availability."""
+    import glob
+
+    entry = {}
+    try:
+        if not (glob.glob("/dev/accel*") or glob.glob("/dev/vfio/*")):
+            return
+        if not os.environ.get("TPU_ACCELERATOR_TYPE"):
+            acc = _gce_metadata("instance/attributes/accelerator-type")
+            if acc:
+                os.environ["TPU_ACCELERATOR_TYPE"] = acc
+                entry["TPU_ACCELERATOR_TYPE"] = acc
+        if not os.environ.get("TPU_WORKER_HOSTNAMES"):
+            hosts = None
+            eps = _gce_metadata("instance/attributes/worker-network-endpoints")
+            if eps:
+                # attribute format: "<index>:<uid>:<ip>" per worker
+                parts = [
+                    p.split(":")[2] for p in eps.split(",") if p.count(":") >= 2
+                ]
+                hosts = ",".join(parts) or None
+            if not hosts:
+                hosts = "localhost"  # single-host: the chip is local
+            os.environ["TPU_WORKER_HOSTNAMES"] = hosts
+            entry["TPU_WORKER_HOSTNAMES"] = hosts
+            if not os.environ.get("TPU_WORKER_ID"):
+                os.environ["TPU_WORKER_ID"] = "0"
+                entry["TPU_WORKER_ID"] = "0"
+    except Exception as exc:  # fault-ok: derivation is best-effort
+        entry["error"] = str(exc)[:200]
+    if entry:
+        log.append({"derived_tpu_env": entry})
+
+
 def main():
     force_cpu = os.environ.get("TPU_CYPHER_BENCH_FORCE_CPU") == "1"
     timeouts = [
@@ -505,6 +625,7 @@ def main():
     probe_log: list = []
     tpu_ok = False
     if not force_cpu:
+        _derive_tpu_env(probe_log)
         tpu_ok = probe_tpu(timeouts, probe_log)
     if not tpu_ok:
         os.environ["JAX_PLATFORMS"] = "cpu"

@@ -331,6 +331,26 @@ def _kernel_tier_benches(rows, reps):
         ),
     )
 
+    # WCOJ sorted-key range count (the leapfrog search step): ascending
+    # synthetic edge keys probed by a zipf-ish query stream — the exact
+    # work profile of one close-constraint membership pass
+    from tpu_cypher.backend.tpu.pallas import intersect as PI
+
+    n_keys = max(rows // 2, 16)
+    keys = jnp.asarray(
+        np.sort(rng.integers(0, n_keys * 8, n_keys).astype(np.int64))
+    )
+    q = jnp.asarray(rng.integers(0, n_keys * 8, rows).astype(np.int64))
+    qvalid = jnp.asarray(rng.random(rows) < 0.9)
+    npow = bucketing.round_up_pow2(n_keys)
+    emit_kernel(
+        "intersect_range_count",
+        lambda: PI._range_count_pallas(
+            keys, q, qvalid, npow=npow, interpret=interpret
+        ),
+        lambda: PI._range_count_jnp(keys, q, qvalid),
+    )
+
     # masked grouped segment sum
     k = 64
     data = jnp.asarray(rng.integers(-1000, 1000, rows))

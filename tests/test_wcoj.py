@@ -1,0 +1,479 @@
+"""Worst-case-optimal multiway join (ISSUE 10).
+
+Five guarantees under test:
+
+* DIFFERENTIAL — cyclic patterns (triangle, 2-cycle, diamond, 4-clique,
+  reversed orientations, self-loops, empty adjacency) answered through
+  ``MultiwayIntersectOp`` under ``TPU_CYPHER_WCOJ=force`` are bit-identical
+  to the forced binary plan (``=off``) and to the local host oracle, on
+  loopy and loop-free graphs, both bucket modes, kernels on and off; and
+  the ``pallas/intersect.py`` range-count kernel under ``interpret=True``
+  matches the jnp searchsorted formulation at the contract level.
+* ELIGIBILITY — ``auto`` mode applies the EmptyHeaded-style rule: routes
+  to WCOJ only when the degree-stats blowup estimate clears
+  ``TPU_CYPHER_WCOJ_MIN_ROWS``; small graphs keep the binary plan.
+* FAULTS — ``kernel_intersect`` drives the degrade-and-retry ladder like
+  every other kernel site: typed failures in ``execution_log``, results
+  oracle-identical, ``:*`` lands on the host oracle (the intersect kernel
+  runs at every device rung), the unsupported multi-close materialize
+  degrades to the classic shadow plan.
+* GUARDS — the kernel is dispatch-registered (site + impl allowlist), the
+  ``TPU_CYPHER_WCOJ*`` knobs live in the config registry, the engine lint
+  reports zero findings on the new modules, and warm cyclic queries with
+  kernels on compile ZERO new XLA programs.
+* SORTED CSR — every CSR row's neighbor column is nondecreasing
+  (``GraphIndex.csr_sorted``), the edge keys are globally sorted, and a
+  build that violates the contract raises instead of mis-searching.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from tpu_cypher import CypherSession
+from tpu_cypher import errors as ERR
+from tpu_cypher.backend.tpu import bucketing
+from tpu_cypher.backend.tpu import graph_index as GI
+from tpu_cypher.backend.tpu.graph_index import GraphIndex, GraphIndexError
+from tpu_cypher.backend.tpu.pallas import dispatch, intersect as PI
+from tpu_cypher.backend.tpu import wcoj as W
+from tpu_cypher.runtime import faults, guard
+from tpu_cypher.utils.config import REGISTRY, WCOJ_MIN_ROWS, WCOJ_MODE
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    """Every test leaves WCOJ routing, kernel mode, broken memoization,
+    bucketing, and fault specs as it found them."""
+    yield
+    WCOJ_MODE.reset()
+    WCOJ_MIN_ROWS.reset()
+    dispatch.MODE.reset()
+    dispatch.reset()
+    bucketing.MODE.reset()
+    faults.set_spec(None)
+
+
+def _tiers():
+    return dict(W.WCOJ_TIER_COUNTS)
+
+
+TRIANGLE = "MATCH (a)-[:K]->(b)-[:K]->(c)-[:K]->(a) RETURN count(*) AS t"
+
+CYCLIC_CORPUS = [
+    TRIANGLE,
+    "MATCH (a:N)-[:K]->(b:N)-[:K]->(c:N)-[:K]->(a) RETURN count(*) AS t",
+    "MATCH (a)-[:K]->(b)-[:K]->(a) RETURN count(*) AS t",
+    "MATCH (a)<-[:K]-(b)-[:K]->(c)-[:K]->(a) RETURN count(*) AS t",
+    "MATCH (a)-[:K]->(b)-[:K]->(c)-[:K]->(d)-[:K]->(a) RETURN count(*) AS t",
+    "MATCH (a)-[:K]->(b)-[:K]->(c)-[:K]->(a), (a)-[:K]->(d), "
+    "(b)-[:K]->(d), (c)-[:K]->(d) RETURN count(*) AS t",
+    "MATCH (a)-[:K]->(b)-[:K]->(c)-[:K]->(a) "
+    "RETURN id(a) AS ia, id(c) AS ic ORDER BY ia, ic",
+    "MATCH (a:N)-[:K]->(b)-[:K]->(c)-[:K]->(a) "
+    "RETURN a.v AS av, c.v AS cv ORDER BY av, cv",
+]
+
+
+def _loopy_create(seed=7, n=30, e=150):
+    rng = np.random.default_rng(seed)
+    src, dst = rng.integers(0, n, e), rng.integers(0, n, e)
+    parts = [f"(n{i}:{'N' if i % 3 else 'N:M'} {{v: {i % 9}}})" for i in range(n)]
+    parts += [f"(n{s})-[:K]->(n{d})" for s, d in zip(src, dst)]
+    return "CREATE " + ", ".join(parts)
+
+
+def _loop_free_create(seed=13, n=40, e=220):
+    rng = np.random.default_rng(seed)
+    src = rng.integers(0, n, e)
+    dst = (src + 1 + rng.integers(0, n - 1, e)) % n
+    parts = [f"(n{i}:N)" for i in range(n)]
+    parts += [f"(n{s})-[:K]->(n{d})" for s, d in zip(src, dst)]
+    return "CREATE " + ", ".join(parts)
+
+
+# ---------------------------------------------------------------------------
+# kernel-contract differential: pallas range count vs jnp searchsorted
+# ---------------------------------------------------------------------------
+
+KERNEL_SHAPES = [
+    ("single_key", 1, 4, 1.0),
+    ("dense", 700, 900, 0.85),
+    ("all_invalid", 64, 200, 0.0),
+    ("dup_heavy", 512, 1500, 0.6),
+    ("non_pow2", 333, 1025, 0.5),
+]
+
+
+@pytest.mark.parametrize("name,nk,nq,density", KERNEL_SHAPES)
+def test_intersect_kernel_differential(name, nk, nq, density):
+    rng = np.random.default_rng(abs(hash(name)) % 2**31)
+    lo = 0 if name != "dup_heavy" else 5  # duplicates: narrow key space
+    keys = jnp.asarray(np.sort(rng.integers(lo, max(nk, 8), nk).astype(np.int64)))
+    q = jnp.asarray(rng.integers(0, max(nk, 8) + 2, nq).astype(np.int64))
+    qvalid = jnp.asarray(rng.random(nq) < density)
+    npow = bucketing.round_up_pow2(nk)
+    want = PI._range_count_jnp(keys, q, qvalid)
+    got = PI._range_count_pallas(keys, q, qvalid, npow=npow, interpret=True)
+    for w, g, nm in zip(want, got, ("lo", "counts", "total")):
+        assert (np.asarray(w) == np.asarray(g)).all(), (name, nm)
+
+
+def test_intersect_kernel_sentinel_padded_keys():
+    """Keys arrive device-padded with the ``1 << 62`` sentinel (the
+    ``GraphIndex.edge_keys`` contract): the kernel's pow2 pad must stack
+    more sentinels without perturbing any real range."""
+    real = np.sort(np.random.default_rng(3).integers(0, 50, 37).astype(np.int64))
+    padded = np.concatenate([real, np.full(7, 1 << 62, np.int64)])
+    q = jnp.asarray(np.arange(-2, 55, dtype=np.int64))
+    qvalid = jnp.ones(q.shape[0], bool)
+    want = PI._range_count_jnp(jnp.asarray(padded), q, qvalid)
+    got = PI._range_count_pallas(
+        jnp.asarray(padded), q, qvalid,
+        npow=bucketing.round_up_pow2(len(padded)), interpret=True,
+    )
+    for w, g in zip(want, got):
+        assert (np.asarray(w) == np.asarray(g)).all()
+    # and against the unpadded truth: sentinels are invisible
+    base = PI._range_count_jnp(jnp.asarray(real), q, qvalid)
+    assert (np.asarray(base[1]) == np.asarray(got[1])).all()
+
+
+def test_intersect_kernel_launches_and_declines(monkeypatch):
+    dispatch.MODE.set("interpret")
+    keys = jnp.asarray(np.arange(32, dtype=np.int64))
+    q = jnp.asarray(np.arange(16, dtype=np.int64))
+    ok = jnp.ones(16, bool)
+    lo, cnt, total = PI.intersect_range_count(keys, q, ok)
+    want = PI._range_count_jnp(keys, q, ok)
+    assert (np.asarray(want[1]) == np.asarray(cnt)).all()
+    assert int(total) == int(np.asarray(cnt).sum())
+    assert dispatch.use_counts()["intersect"]["pallas"] == 1
+    # past the VMEM residency cap the launch must decline to the
+    # searchsorted path (same results, no kernel)
+    monkeypatch.setattr(PI, "MAX_KEYS", 8)
+    lo2, cnt2, _ = PI.intersect_range_count(keys, q, ok)
+    assert (np.asarray(cnt2) == np.asarray(cnt)).all()
+    assert (np.asarray(lo2) == np.asarray(lo)).all()
+    assert dispatch.use_counts()["intersect"]["pallas"] == 1
+
+
+# ---------------------------------------------------------------------------
+# engine differential: WCOJ vs forced-binary vs host oracle
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def loopy_oracle():
+    g = CypherSession.local().create_graph_from_create_query(_loopy_create())
+    return {q: g.cypher(q).records.collect() for q in CYCLIC_CORPUS}
+
+
+@pytest.mark.parametrize("bucket_mode", ["pow2", "off"])
+def test_engine_differential_wcoj_vs_binary_vs_oracle(
+    loopy_oracle, bucket_mode
+):
+    bucketing.MODE.set(bucket_mode)
+    create = _loopy_create()
+    WCOJ_MODE.set("off")
+    g_bin = CypherSession.tpu().create_graph_from_create_query(create)
+    before = _tiers()
+    binary = {q: g_bin.cypher(q).records.collect() for q in CYCLIC_CORPUS}
+    assert _tiers() == before, "=off must never route to the multiway op"
+
+    WCOJ_MODE.set("force")
+    g_wcoj = CypherSession.tpu().create_graph_from_create_query(create)
+    before = _tiers()
+    for q in CYCLIC_CORPUS:
+        got = [dict(r) for r in g_wcoj.cypher(q).records.collect()]
+        assert got == [dict(r) for r in loopy_oracle[q]], f"oracle diverged: {q}"
+        assert got == [dict(r) for r in binary[q]], f"binary diverged: {q}"
+    after = _tiers()
+    assert sum(after.values()) > sum(before.values()), (
+        "force mode never reached the multiway op"
+    )
+
+
+def test_engine_differential_with_kernels_on(loopy_oracle):
+    dispatch.MODE.set("interpret")
+    bucketing.MODE.set("pow2")
+    WCOJ_MODE.set("force")
+    g = CypherSession.tpu().create_graph_from_create_query(_loopy_create())
+    for q in CYCLIC_CORPUS:
+        got = [dict(r) for r in g.cypher(q).records.collect()]
+        assert got == [dict(r) for r in loopy_oracle[q]], q
+    assert dispatch.use_counts()["intersect"]["pallas"] > 0
+
+
+def test_count_tier_on_loop_free_graph():
+    """A loop-free graph lets the planner DROP the uniqueness filters by
+    proof, so a pure count(*) triangle rides the count tier: no output
+    materialize, no acyclic intermediate."""
+    WCOJ_MODE.set("force")
+    create = _loop_free_create()
+    g_loc = CypherSession.local().create_graph_from_create_query(create)
+    g_tpu = CypherSession.tpu().create_graph_from_create_query(create)
+    want = g_loc.cypher(TRIANGLE).records.to_bag()
+    before = _tiers()
+    got = g_tpu.cypher(TRIANGLE).records.to_bag()
+    after = _tiers()
+    assert got == want
+    assert after["count"] == before["count"] + 1
+    assert after["materialize"] == before["materialize"]
+    assert after["shadow"] == before["shadow"]
+
+
+CORNER_GRAPHS = [
+    ("CREATE (x:N)-[:K]->(x)", 0),
+    ("CREATE (x:N)-[:K]->(y:N), (y)-[:K]->(x), (x)-[:K]->(x)", 3),
+    ("CREATE (x:N), (y:N)", 0),  # empty adjacency
+]
+
+
+@pytest.mark.parametrize("create,expected", CORNER_GRAPHS)
+def test_corner_graphs(create, expected):
+    WCOJ_MODE.set("force")
+    g_loc = CypherSession.local().create_graph_from_create_query(create)
+    g_tpu = CypherSession.tpu().create_graph_from_create_query(create)
+    want = g_loc.cypher(TRIANGLE).records.to_bag()
+    got = g_tpu.cypher(TRIANGLE).records.to_bag()
+    assert got == want
+    rows = [dict(r) for r in g_tpu.cypher(TRIANGLE).records.collect()]
+    assert rows == [{"t": expected}]
+
+
+def test_multi_close_materialize_degrades_to_shadow(loopy_oracle):
+    """A 4-clique on a LOOPY graph carries uniqueness pairs, forcing the
+    materializing tier — which supports exactly one close constraint.
+    The fused op must answer through its classic shadow plan, correctly."""
+    WCOJ_MODE.set("force")
+    clique = CYCLIC_CORPUS[5]
+    g = CypherSession.tpu().create_graph_from_create_query(_loopy_create())
+    before = _tiers()
+    got = [dict(r) for r in g.cypher(clique).records.collect()]
+    after = _tiers()
+    assert got == [dict(r) for r in loopy_oracle[clique]]
+    assert after["shadow"] > before["shadow"]
+
+
+# ---------------------------------------------------------------------------
+# eligibility: the EmptyHeaded-style auto rule
+# ---------------------------------------------------------------------------
+
+
+def test_auto_mode_keeps_binary_plan_on_small_graphs():
+    """Default threshold: a 30-node graph's blowup estimate stays under
+    TPU_CYPHER_WCOJ_MIN_ROWS, so auto mode keeps today's binary plan."""
+    g = CypherSession.tpu().create_graph_from_create_query(_loopy_create())
+    before = _tiers()
+    g.cypher(TRIANGLE).records.to_bag()
+    assert _tiers() == before
+
+
+def test_auto_mode_routes_past_threshold():
+    WCOJ_MIN_ROWS.set(1)  # any nonempty graph clears the bar
+    g = CypherSession.tpu().create_graph_from_create_query(_loopy_create())
+    g_loc = CypherSession.local().create_graph_from_create_query(_loopy_create())
+    before = _tiers()
+    got = g.cypher(TRIANGLE).records.to_bag()
+    after = _tiers()
+    assert sum(after.values()) > sum(before.values())
+    assert got == g_loc.cypher(TRIANGLE).records.to_bag()
+    # the loopy graph keeps uniqueness enforcement, so the op lands on
+    # the materializing tier (the count tier needs enforced_pairs gone)
+    assert after["materialize"] > before["materialize"]
+    assert after["count"] == before["count"]
+
+
+def test_auto_mode_hands_pure_count_back_to_fused_binary():
+    """Pure counts hand back to the classic plan in auto mode whenever a
+    fused binary counting tier is in reach (always true on the CPU
+    backend these tests run on): the count lands on the shadow tier,
+    never the sum(min-deg) probing tier — and the shadow child is the
+    PRUNED fused expand-into, so it costs what ``off`` mode costs.
+    ``force`` keeps the pure WCOJ path (the wcoj-vs-binary bench legs)."""
+    WCOJ_MIN_ROWS.set(1)
+    create = _loop_free_create()
+    g = CypherSession.tpu().create_graph_from_create_query(create)
+    g_loc = CypherSession.local().create_graph_from_create_query(create)
+    before = _tiers()
+    got = g.cypher(TRIANGLE).records.to_bag()
+    after = _tiers()
+    assert got == g_loc.cypher(TRIANGLE).records.to_bag()
+    assert after["shadow"] == before["shadow"] + 1
+    assert after["count"] == before["count"]
+    assert after["materialize"] == before["materialize"]
+
+
+# ---------------------------------------------------------------------------
+# fault injection at kernel_intersect: the full ladder
+# ---------------------------------------------------------------------------
+
+KIND_TO_ERROR = {
+    "oom": ERR.DeviceOOM,
+    "compile": ERR.CompileFailure,
+    "lost": ERR.DeviceLost,
+}
+
+
+@pytest.fixture(scope="module")
+def fault_graphs():
+    create = _loopy_create(seed=11, n=12, e=50)
+    return (
+        CypherSession.tpu().create_graph_from_create_query(create),
+        CypherSession.local().create_graph_from_create_query(create),
+    )
+
+
+@pytest.mark.parametrize("kind", sorted(KIND_TO_ERROR))
+@pytest.mark.parametrize("depth", ["1", "*"])
+def test_kernel_intersect_fault_matrix(fault_graphs, kind, depth):
+    g_tpu, g_loc = fault_graphs
+    want = g_loc.cypher(TRIANGLE).records.to_bag()
+
+    WCOJ_MODE.set("force")
+    dispatch.MODE.set("interpret")
+    bucketing.MODE.set("pow2")
+    faults.set_spec(f"{kind}@kernel_intersect:{depth}")
+    r = g_tpu.cypher(TRIANGLE)
+    got = r.records.to_bag()
+    faults.set_spec(None)
+
+    assert got == want, f"kernel_intersect/{kind}:{depth} diverged"
+    log = r.execution_log
+    assert log and log[-1]["ok"] is True
+    failed = [e for e in log if not e["ok"]]
+    assert failed, f"injected fault never fired: {log}"
+    for e in failed:
+        assert e["error"] == KIND_TO_ERROR[kind].__name__, log
+    if depth == "*":
+        # unlike the join/expand kernels, the intersect kernel runs at
+        # every device rung (range counting is not a bucketed-only branch)
+        # so only the host oracle escapes a persistent fault
+        assert log[-1]["rung"] == guard.RUNG_HOST, log
+    else:
+        assert log[-1]["rung"] not in (guard.RUNG_DEVICE, guard.RUNG_HOST), log
+
+
+# ---------------------------------------------------------------------------
+# guards: registry, config knobs, engine lint, compile flatness
+# ---------------------------------------------------------------------------
+
+
+def test_intersect_kernel_is_dispatch_registered():
+    spec = dispatch.registry()["intersect"]
+    assert spec.site == "kernel_intersect"
+    assert "_range_count_pallas" in spec.impls
+
+
+def test_wcoj_knobs_in_config_registry():
+    assert "TPU_CYPHER_WCOJ" in REGISTRY
+    assert "TPU_CYPHER_WCOJ_MIN_ROWS" in REGISTRY
+    assert REGISTRY["TPU_CYPHER_WCOJ"].get() == "auto"
+    assert REGISTRY["TPU_CYPHER_WCOJ_MIN_ROWS"].get() == 4096
+
+
+def test_engine_lint_clean_on_wcoj_modules():
+    from tpu_cypher import analysis
+
+    root = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "tpu_cypher",
+        "backend",
+        "tpu",
+    )
+    targets = [
+        os.path.join(root, "wcoj.py"),
+        os.path.join(root, "pallas", "intersect.py"),
+    ]
+    # parse the whole backend so interprocedural rules keep their
+    # substrate; report only on the new modules (--changed-only semantics)
+    report = analysis.run_paths([root], restrict_to=targets)
+    assert report.clean, report.render_text()
+
+
+def test_wcoj_keeps_compile_stats_flat():
+    """Acceptance: ZERO warm recompiles — a repeated cyclic query with the
+    kernel tier on must reuse every compiled program."""
+    WCOJ_MODE.set("force")
+    dispatch.MODE.set("interpret")
+    bucketing.MODE.set("pow2")
+    g = CypherSession.tpu().create_graph_from_create_query(_loopy_create())
+    g.cypher(TRIANGLE).records.to_bag()  # cold: compiles the lattice
+    before = bucketing.compile_snapshot()
+    g.cypher(TRIANGLE).records.to_bag()
+    assert bucketing.compile_delta(before)["compiles"] == 0
+
+
+# ---------------------------------------------------------------------------
+# sorted-CSR regression (the correctness substrate of every binary search)
+# ---------------------------------------------------------------------------
+
+
+def test_csr_sorted_contract():
+    assert GraphIndex.csr_sorted is True
+    rng = np.random.default_rng(5)
+    a = rng.integers(0, 9, 64)
+    b = rng.integers(0, 9, 64)
+    row_ptr, order, a_sorted = GraphIndex._sorted_csr(a, b, 9)
+    b_sorted = b[order]
+    for r in range(9):
+        row = b_sorted[row_ptr[r]:row_ptr[r + 1]]
+        assert (np.diff(row) >= 0).all(), f"row {r} not neighbor-sorted"
+    # the flattened (a*N + b) keys — what edge_keys serves — are globally
+    # nondecreasing, which is exactly what makes close ranges contiguous
+    keys = a_sorted.astype(np.int64) * 9 + b_sorted.astype(np.int64)
+    assert (np.diff(keys) >= 0).all()
+
+
+def test_csr_build_violation_raises(monkeypatch):
+    monkeypatch.setattr(
+        GI.np, "lexsort", lambda keys: np.arange(len(keys[0]))
+    )
+    a = np.array([1, 1, 0])
+    b = np.array([5, 3, 2])
+    with pytest.raises(GraphIndexError, match="sorted-by-neighbor"):
+        GraphIndex._sorted_csr(a, b, 6)
+
+
+# ---------------------------------------------------------------------------
+# bench rung: wcoj_vs_binary emits both legs and they agree
+# ---------------------------------------------------------------------------
+
+
+def test_bench_wcoj_vs_binary_rung():
+    import sys
+
+    sys.path.insert(
+        0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    )
+    import bench
+
+    # bench's queries match (:Person)-[:KNOWS]-> — build a graph in that
+    # vocabulary (the generic _loopy_create fixture would match nothing
+    # and pass vacuously)
+    rng = np.random.default_rng(11)
+    n, e = 12, 50
+    src = rng.integers(0, n, e)
+    dst = (src + 1 + rng.integers(0, n - 1, e)) % n
+    parts = [f"(p{i}:Person)" for i in range(n)]
+    parts += [f"(p{s})-[:KNOWS]->(p{d})" for s, d in zip(src, dst)]
+    g = CypherSession.tpu().create_graph_from_create_query(
+        "CREATE " + ", ".join(parts)
+    )
+    out = bench._wcoj_vs_binary(g, feasible_binary=True)
+    for leg in ("triangle", "clique4"):
+        entry = out[leg]
+        assert entry["counts_match"] is True, entry
+        assert entry["wcoj_seconds"] > 0 and entry["binary_seconds"] > 0
+        assert "wcoj_speedup" in entry
+        # each leg replans (the plan cache keys on TPU_CYPHER_WCOJ): the
+        # force leg answers from a wcoj tier, the off leg never touches one
+        assert "wcoj" in entry["wcoj_tier"], entry
+        assert "wcoj" not in entry["binary_tier"], entry
+    skipped = bench._wcoj_vs_binary(g, feasible_binary=False)
+    assert skipped["triangle"]["binary_skipped"]
+    assert skipped["triangle"]["count"] == out["triangle"]["count"]

@@ -1991,6 +1991,26 @@ def plan_filter_fastpath(planner, op, child) -> Optional[RelationalOperator]:
             return child  # duplicate predicate: already enforced below
         return rewrap(node._with_pair(key, op.predicate))
 
+    from .wcoj import MultiwayIntersectOp
+
+    if isinstance(node, MultiwayIntersectOp):
+        # the multiway op enforces pairs by comparing GLOBAL element ids
+        # (canonical rel scans / input id columns), so unlike the in-op
+        # paths below it needs no same-type-set restriction
+        rel_ends = node._rel_ends()
+        if rel_ends is None:
+            return None
+        key = tuple(sorted(pair))
+        if not set(key) <= set(rel_ends):
+            return None
+        if key in node.enforced_pairs:
+            return child  # duplicate predicate: already enforced below
+        if _rel_uniqueness_redundant(
+            rel_ends, key[0], key[1], node._graph_obj, node.context
+        ):
+            return child
+        return rewrap(node._with_pair(key, op.predicate))
+
     if isinstance(node, CsrExpandIntoOp) and not node.undirected:
         in_op = node.children[0]
         while isinstance(in_op, CacheOp):

@@ -14,7 +14,8 @@ of the engines' cached/partitioned relationship tables):
 * per label set: the canonical node scan plus ``row_map`` taking a compact
   id to its row in that scan (-1 = node lacks the labels — the fused label
   filter)
-* per types: sorted ``edge_keys`` (src*N + dst) for ExpandInto probes
+* per (types, orientation): sorted ``edge_keys`` (src*N + dst forward,
+  dst*N + src reverse) for ExpandInto and WCOJ intersection probes
 
 Scans are cached under canonical variable names; operators re-key their
 header expressions onto the canonical var (structural equality ignores
@@ -78,6 +79,13 @@ def rekey_element_expr(e: E.Expr, canon: E.Var) -> Optional[E.Expr]:
 class GraphIndex:
     """CSR + canonical-scan cache for one RelationalCypherGraph."""
 
+    # sorted-adjacency contract: every CSR row's col_idx is NONDECREASING
+    # (``np.lexsort((b, a))`` orders edges by (row, neighbor); the build
+    # asserts it rather than trusts it). The WCOJ sorted-intersection
+    # executor (``wcoj.py``) and the ``pallas/intersect.py`` range-count
+    # kernel binary-search row slices and are only correct against it.
+    csr_sorted: bool = True
+
     @staticmethod
     def of(graph) -> "GraphIndex":
         gi = getattr(graph, "_tpu_graph_index", None)
@@ -105,8 +113,10 @@ class GraphIndex:
         # (types_key, reverse) -> host max out-degree (Pallas eligibility
         # probe — computed once at build, never synced per query)
         self._csr_max_deg: Dict[Tuple[Tuple[str, ...], bool], int] = {}
-        # types_key -> sorted edge keys (src*N + dst), device int64
-        self._edge_keys: Dict[Tuple[str, ...], Any] = {}
+        # (types_key, reverse) -> sorted edge keys, device int64: forward
+        # keys are (src*N + dst), reverse keys (dst*N + src) — each sorted
+        # because its CSR orientation lexsorts by that pair
+        self._edge_keys: Dict[Tuple[Tuple[str, ...], bool], Any] = {}
         # types_key -> int64[num_rels] (src*N + dst) key per canonical
         # rel-scan row (relationship-uniqueness probe subtraction)
         self._keys_by_orig: Dict[Tuple[str, ...], Any] = {}
@@ -273,10 +283,21 @@ class GraphIndex:
         """Lexsort edges by (a, b) and build the row_ptr — the shared back
         half of every CSR build. Returns host (row_ptr, order, a_sorted);
         callers gather their per-edge payloads (col ids, edge origins)
-        through ``order``."""
+        through ``order``. Asserts the ``csr_sorted`` contract: within
+        every row the neighbor column is nondecreasing."""
         order = np.lexsort((b, a))
-        row_ptr = np.searchsorted(a[order], np.arange(n + 1)).astype(np.int32)
-        return row_ptr, order, a[order]
+        a_sorted = a[order]
+        if len(order) > 1:
+            b_sorted = b[order]
+            in_row_order = (b_sorted[1:] >= b_sorted[:-1]) | (
+                a_sorted[1:] != a_sorted[:-1]
+            )
+            if not in_row_order.all():
+                raise GraphIndexError(
+                    "CSR build violated the sorted-by-neighbor contract"
+                )
+        row_ptr = np.searchsorted(a_sorted, np.arange(n + 1)).astype(np.int32)
+        return row_ptr, order, a_sorted
 
     def csr(self, types_key: Tuple[str, ...], reverse: bool, ctx):
         """(row_ptr, col_idx, edge_orig) int32/int32/int64 device arrays for
@@ -302,12 +323,15 @@ class GraphIndex:
             device_padded(order.astype(np.int64), 0)[0],
         )
         self._csr[(types_key, reverse)] = out
-        if not reverse and types_key not in self._edge_keys:
-            # forward CSR order is lexsorted by (src, dst) => keys sorted;
-            # the pad sentinel sorts past every real (src*N + dst) key so
-            # binary-search probes are unaffected
+        if (types_key, reverse) not in self._edge_keys:
+            # this CSR orientation is lexsorted by (a, b) => a*N + b keys
+            # sorted (forward: src*N + dst; reverse: dst*N + src); the pad
+            # sentinel sorts past every real key so binary-search probes
+            # are unaffected
             keys = a_sorted.astype(np.int64) * n + b[order].astype(np.int64)
-            self._edge_keys[types_key] = device_padded(keys, (1 << 62))[0]
+            self._edge_keys[(types_key, reverse)] = device_padded(
+                keys, (1 << 62)
+            )[0]
         if not reverse and types_key not in self._loop_count:
             loops = s[s == d]
             self._loop_count[types_key] = jnp.asarray(
@@ -351,11 +375,15 @@ class GraphIndex:
             self.csr(types_key, False, ctx)
         return self._loop_count[types_key]
 
-    def edge_keys(self, types_key: Tuple[str, ...], ctx):
-        """Sorted (src*N + dst) int64 device keys for ExpandInto probes."""
-        if types_key not in self._edge_keys:
-            self.csr(types_key, False, ctx)
-        return self._edge_keys[types_key]
+    def edge_keys(
+        self, types_key: Tuple[str, ...], ctx, reverse: bool = False
+    ):
+        """Sorted int64 device keys for ExpandInto/WCOJ range probes:
+        (src*N + dst) forward, (dst*N + src) with ``reverse=True`` (close
+        constraints against INCOMING adjacency probe the reverse keys)."""
+        if (types_key, reverse) not in self._edge_keys:
+            self.csr(types_key, reverse, ctx)
+        return self._edge_keys[(types_key, reverse)]
 
     def edge_keys_by_orig(self, types_key: Tuple[str, ...], ctx):
         """int64[num_rels] device array: the (src*N + dst) probe key of each

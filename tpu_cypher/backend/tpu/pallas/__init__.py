@@ -13,12 +13,14 @@ Kernels:
 * ``join.join_probe_bucketed``         — hash-join probe (open addressing)
 * ``expand.expand_materialize_counted`` — CSR expand row-search materialize
 * ``aggregate.segment_aggregate``       — masked grouped segment reduce
+* ``intersect.intersect_range_count``   — WCOJ sorted-key range count
 """
 
 from . import dispatch  # noqa: F401
 from .aggregate import segment_aggregate  # noqa: F401
 from .expand import expand_materialize_counted  # noqa: F401
 from .frontier import csr_frontier_degree_sum  # noqa: F401
+from .intersect import intersect_range_count  # noqa: F401
 from .join import join_probe_bucketed  # noqa: F401
 
 HAVE_PALLAS = dispatch.HAVE_PALLAS

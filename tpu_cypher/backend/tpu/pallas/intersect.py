@@ -1,0 +1,179 @@
+"""Pallas sorted-range-count kernel: the WCOJ leapfrog search step.
+
+The multiway intersection executor (``backend/tpu/wcoj.py``) reduces
+every "is candidate ``c`` adjacent to anchor ``a``, and how many parallel
+edges" probe to a RANGE COUNT over the graph's sorted edge keys
+``anchor*N + candidate`` (``GraphIndex.edge_keys`` — the sorted-by-
+neighbor CSR contract makes each anchor's candidates one contiguous,
+ascending key run). The jnp formulation is a searchsorted left/right
+pair: 2·log2(E) dependent HBM gathers per query lane.
+
+The hand-scheduled replacement keeps the WHOLE key list resident in VMEM
+as two int32 bitcast planes (lo/hi halves — Mosaic's native lane width;
+int64 compare is lexicographic on (hi signed, lo unsigned-via-sign-flip))
+and streams the query side through (8, 128) tiles. Both bounds advance
+branchlessly through the same log2(npow) uniform binary-search rounds
+(Knuth 6.2.1): with the list padded to a power of two by the ``+inf``
+sentinel, ``pos += s  if key[pos+s-1] < q`` lands on the left insertion
+point, the ``<=`` twin on the right one, and the tile's gathers stay in
+lockstep — every lane reads the same two table vectors per round.
+
+Output contract matches the counted-output discipline of
+``join_probe_bucketed``: per query lane the first matching sorted
+position and the match count, invalid lanes (pads, absent anchors)
+counting zero, plus the traced total.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from . import dispatch
+from .. import bucketing
+
+if dispatch.HAVE_PALLAS:
+    from jax.experimental import pallas as pl
+
+_ROWS = 8
+_LANES = 128
+_BLOCK = _ROWS * _LANES
+
+# VMEM-residency cap on the POW2-PADDED key list: two int32 planes at the
+# cap are 8 MiB, comfortably inside the ~16 MiB/core budget next to the
+# streamed query tiles
+MAX_KEYS = 1 << 20
+
+# all real edge keys are anchor*N + candidate < 2**60 (the executor
+# requires num_nodes < 2**30), so the pad sentinel sorts strictly last
+_SENTINEL = 1 << 62
+
+
+def _split64(x):
+    """int64 -> exact (lo32, hi32) int32 halves via bitcast."""
+    both = jax.lax.bitcast_convert_type(x, jnp.int32)
+    return both[..., 0], both[..., 1]
+
+
+def _range_count_kernel(klo_ref, khi_ref, qlo_ref, qhi_ref, lo_ref, cnt_ref):
+    n = klo_ref.shape[0]  # static power of two
+    qlo = qlo_ref[...]
+    qhi = qhi_ref[...]
+    bias = jnp.int32(-2147483648)
+    uql = qlo ^ bias  # unsigned order for the low halves
+    lo = jnp.zeros((_ROWS, _LANES), jnp.int32)
+    hi = jnp.zeros((_ROWS, _LANES), jnp.int32)
+    s = n >> 1
+    while s:  # static unroll: log2(n) uniform rounds, no branches
+        il = lo + (s - 1)
+        kl = klo_ref[il]
+        kh = khi_ref[il]
+        lt = (kh < qhi) | ((kh == qhi) & ((kl ^ bias) < uql))
+        lo = jnp.where(lt, lo + s, lo)
+        ih = hi + (s - 1)
+        k2l = klo_ref[ih]
+        k2h = khi_ref[ih]
+        le = (k2h < qhi) | ((k2h == qhi) & ((k2l ^ bias) <= uql))
+        hi = jnp.where(le, hi + s, hi)
+        s >>= 1
+    # completion half-step: the rounds advance by at most n/2+...+1 = n-1,
+    # but the insertion point ranges over [0, n] — one more compare at the
+    # landing position reaches n (bites exactly when the key list is a
+    # sentinel-free power of two and a query sorts at/past the max key)
+    kl = klo_ref[lo]
+    kh = khi_ref[lo]
+    lt = (kh < qhi) | ((kh == qhi) & ((kl ^ bias) < uql))
+    lo = jnp.where(lt, lo + 1, lo)
+    k2l = klo_ref[hi]
+    k2h = khi_ref[hi]
+    le = (k2h < qhi) | ((k2h == qhi) & ((k2l ^ bias) <= uql))
+    hi = jnp.where(le, hi + 1, hi)
+    lo_ref[...] = lo
+    cnt_ref[...] = hi - lo
+
+
+@partial(jax.jit, static_argnames=("npow", "interpret"))
+def _range_count_pallas(keys, q, qvalid, npow: int, interpret: bool):
+    """Range-count every query against the VMEM-resident sorted keys.
+    Returns (lo, counts, total): left insertion point, run length zeroed
+    on invalid lanes, traced total."""
+    nk = keys.shape[0]
+    if nk < npow:
+        keys = jnp.concatenate(
+            [keys, jnp.full(npow - nk, _SENTINEL, keys.dtype)]
+        )
+    klo, khi = _split64(keys)
+    n = q.shape[0]
+    npad = ((n + _BLOCK - 1) // _BLOCK) * _BLOCK
+    qlo, qhi = _split64(q)
+    pad = npad - n
+    if pad:
+        qlo = jnp.concatenate([qlo, jnp.zeros(pad, jnp.int32)])
+        qhi = jnp.concatenate([qhi, jnp.zeros(pad, jnp.int32)])
+    shape2d = (npad // _LANES, _LANES)
+    grid = (npad // _BLOCK,)
+    lo2d, cnt2d = pl.pallas_call(
+        _range_count_kernel,
+        out_shape=(
+            jax.ShapeDtypeStruct(shape2d, jnp.int32),
+            jax.ShapeDtypeStruct(shape2d, jnp.int32),
+        ),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((npow,), lambda i: (0,)),
+            pl.BlockSpec((npow,), lambda i: (0,)),
+            pl.BlockSpec((_ROWS, _LANES), lambda i: (i, 0)),
+            pl.BlockSpec((_ROWS, _LANES), lambda i: (i, 0)),
+        ],
+        out_specs=(
+            pl.BlockSpec((_ROWS, _LANES), lambda i: (i, 0)),
+            pl.BlockSpec((_ROWS, _LANES), lambda i: (i, 0)),
+        ),
+        interpret=interpret,
+    )(klo, khi, qlo.reshape(shape2d), qhi.reshape(shape2d))
+    lo = lo2d.reshape(-1)[:n].astype(jnp.int64)
+    counts = jnp.where(qvalid, cnt2d.reshape(-1)[:n], 0).astype(jnp.int64)
+    return lo, counts, jnp.sum(counts)
+
+
+@jax.jit
+def _range_count_jnp(keys, q, qvalid):
+    """The exact jnp formulation (and the kernel's differential oracle):
+    searchsorted left/right over the sorted keys. Pad sentinels sort past
+    every real query, so they never enter a counted range."""
+    lo = jnp.searchsorted(keys, q, side="left")
+    hi = jnp.searchsorted(keys, q, side="right")
+    counts = jnp.where(qvalid, hi - lo, 0).astype(jnp.int64)
+    return lo.astype(jnp.int64), counts, jnp.sum(counts)
+
+
+dispatch.register(
+    "intersect", "kernel_intersect", impls=("_range_count_pallas",)
+)
+
+
+def intersect_range_count(keys, q, qvalid):
+    """Dispatching range count: per query lane the first sorted key
+    position matching ``q`` and the match count (0 where ``qvalid`` is
+    False), plus the traced total. ``keys`` must be ascending int64 with
+    any pad lanes at ``1 << 62``."""
+    nk = int(keys.shape[0])
+    npow = bucketing.round_up_pow2(nk) if nk else 0
+    kernel_ok = (
+        0 < nk
+        and npow <= MAX_KEYS
+        and int(q.shape[0]) > 0
+        and keys.dtype == jnp.int64
+    )
+
+    def pallas_fn(interpret: bool):
+        return _range_count_pallas(keys, q, qvalid, npow=npow, interpret=interpret)
+
+    return dispatch.launch(
+        "intersect",
+        pallas_fn,
+        lambda: _range_count_jnp(keys, q, qvalid),
+        eligible=kernel_ok,
+    )

@@ -1384,6 +1384,12 @@ class TpuTable(Table):
         return plan_optional_expand_fastpath(planner, op, lhs, rhs, classic)
 
     @staticmethod
+    def plan_multiway_intersect_fastpath(planner, op, in_plan, classic):
+        from .wcoj import plan_multiway_intersect_fastpath
+
+        return plan_multiway_intersect_fastpath(planner, op, in_plan, classic)
+
+    @staticmethod
     def plan_filter_fastpath(planner, op, child):
         from .expand_op import plan_filter_fastpath
 

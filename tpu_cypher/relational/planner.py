@@ -394,14 +394,56 @@ class RelationalPlanner:
 
     def _plan_ExpandInto(self, op: L.ExpandInto) -> RelationalOperator:
         """Reference ``RelationalPlanner.scala:167-189``: single join on both
-        endpoints — or the fused CSR edge-key probe when available."""
+        endpoints — or the fused CSR edge-key probe when available. When the
+        ExpandInto CLOSES A CYCLE in the solved pattern graph it is first
+        offered to the backend's multiway-intersect hook (worst-case-optimal
+        join routing with EmptyHeaded-style degree-stats eligibility); the
+        hook declines acyclic or small patterns and the binary plan stands."""
         classic = self._plan_expand_into_classic(op)
+        in_plan = self.process(op.in_op)
+        if self._closes_pattern_cycle(op):
+            wcoj = getattr(
+                self.ctx.table_cls, "plan_multiway_intersect_fastpath", None
+            )
+            if wcoj is not None:
+                out = wcoj(self, op, in_plan, classic)
+                if out is not None:
+                    return out
         fast = getattr(self.ctx.table_cls, "plan_expand_into_fastpath", None)
         if fast is not None:
-            out = fast(self, op, self.process(op.in_op), classic)
+            out = fast(self, op, in_plan, classic)
             if out is not None:
                 return out
         return classic
+
+    @staticmethod
+    def _closes_pattern_cycle(op: L.ExpandInto) -> bool:
+        """Join-variable cycle detection: this ExpandInto closes a cycle iff
+        its endpoints are already CONNECTED in the pattern graph of the
+        solved subtree — union-find over the endpoint pair of every
+        relationship-shaped logical node below (Expand / ExpandInto /
+        var-length all carry ``source``/``target``). Both endpoints merely
+        being bound is not enough: a cartesian product binds both sides of
+        a disconnected pattern, and a multiway intersection buys nothing
+        there."""
+        parent: Dict[str, str] = {}
+
+        def find(x: str) -> str:
+            parent.setdefault(x, x)
+            while parent[x] != x:
+                parent[x] = parent[parent[x]]
+                x = parent[x]
+            return x
+
+        stack: List[L.LogicalOperator] = [op.in_op]
+        while stack:
+            node = stack.pop()
+            src = getattr(node, "source", None)
+            tgt = getattr(node, "target", None)
+            if isinstance(src, str) and isinstance(tgt, str):
+                parent[find(src)] = find(tgt)
+            stack.extend(node.children)
+        return find(op.source) == find(op.target)
 
     def _plan_expand_into_classic(self, op: L.ExpandInto) -> RelationalOperator:
         in_plan = self.process(op.in_op)

@@ -96,6 +96,7 @@ def _local_mentions(op: O.RelationalOperator) -> Set[E.Expr]:
         CsrOptionalExpandOp,
         CsrVarExpandOp,
     )
+    from ..backend.tpu.wcoj import MultiwayIntersectOp
 
     m: Set[E.Expr] = set()
     if isinstance(op, O.FilterOp):
@@ -163,6 +164,16 @@ def _local_mentions(op: O.RelationalOperator) -> Set[E.Expr]:
     elif isinstance(op, CsrExpandIntoOp):
         h = op.children[0].header
         for f in (op.source_fld, op.target_fld):
+            try:
+                m.add(h.id_expr(h.var(f)))
+            except Exception:  # fault-ok: plan-time header probe, host-only
+                m.update(h.expressions)
+        _mention_enforced_pairs(m, op, h)
+    elif isinstance(op, MultiwayIntersectOp):
+        h = op.children[0].header
+        for f in (op.pivot.frontier_fld,) + tuple(
+            c.anchor_fld for c in op.closes
+        ):
             try:
                 m.add(h.id_expr(h.var(f)))
             except Exception:  # fault-ok: plan-time header probe, host-only
@@ -271,6 +282,29 @@ def prune_fused_columns(root: O.RelationalOperator) -> O.RelationalOperator:
     req = flow_requirements(root)
     for f in fused:
         f.required_exprs = frozenset(req[id(f)])
+    # a fused op sitting at the ROOT of another fused op's shadow subtree
+    # answers for the same parent, so it owes exactly the same columns:
+    # seed it with the shadow-parent's requirement set (and recurse — a
+    # shadow plan can itself carry a fused shadow). Without this a tier
+    # decline lands on a WIDE classic plan: e.g. the multiway intersect's
+    # count hand-back would pay a full materializing expand-into instead
+    # of the same fused count tiers ``off`` mode plans. Interior fused
+    # ops of a shadow cascade stay unseeded (their requirements are not
+    # the parent's); the fused count tiers peel them without executing.
+    spine = {id(f) for f in fused}
+    pending = list(fused)
+    while pending:
+        f = pending.pop()
+        if len(f.children) < 2:
+            continue
+        s = f.children[1]
+        while isinstance(s, O.CacheOp):
+            s = s.children[0]
+        if isinstance(s, _FusedExpandBase) and id(s) not in spine:
+            spine.add(id(s))
+            s.required_exprs = frozenset(req[id(f)])
+            req[id(s)] = req[id(f)]
+            pending.append(s)
     # invalidate cached headers/tables so narrowed headers propagate lazily.
     # The walk here includes the classic SHADOW subtrees (children[1] of
     # fused ops, excluded from requirement flow): a shadow cascade shares

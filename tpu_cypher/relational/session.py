@@ -881,7 +881,15 @@ class CypherSession:
             if v is not None and not isinstance(v, (bool, int, float, str)):
                 return None
             psig.append((k, type(v).__name__))
-        return (query, id(graph._graph), tuple(psig))
+        # plan-SHAPE config is part of the key: WCOJ routing happens at
+        # plan time, so flipping TPU_CYPHER_WCOJ between calls (the bench's
+        # wcoj-vs-binary legs, serve-tier overrides) must not replay a
+        # stale cached plan
+        plan_cfg = (
+            _config.WCOJ_MODE.get().strip().lower(),
+            int(_config.WCOJ_MIN_ROWS.get()),
+        )
+        return (query, id(graph._graph), tuple(psig), plan_cfg)
 
     @staticmethod
     def _clone_plan(root, parameters):

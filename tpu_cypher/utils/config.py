@@ -182,6 +182,22 @@ MXU_TILED_MAX = declare(
     help="node-count ceiling for the tiled MXU close-count tier",
 )
 
+# worst-case-optimal multiway join (backend/tpu/wcoj.py)
+WCOJ_MODE = declare(
+    "TPU_CYPHER_WCOJ",
+    "auto",
+    str,
+    help="cyclic-pattern multiway intersection: auto (EmptyHeaded-style "
+    "eligibility from degree stats) | force | off",
+)
+WCOJ_MIN_ROWS = declare(
+    "TPU_CYPHER_WCOJ_MIN_ROWS",
+    4096,
+    int,
+    help="auto mode routes a cyclic pattern to WCOJ only when the "
+    "estimated binary-join intermediate exceeds this many rows",
+)
+
 # sharded shuffle (parallel/shuffle.py)
 BROADCAST_LIMIT = declare(
     "TPU_CYPHER_BROADCAST_LIMIT",
