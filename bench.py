@@ -289,7 +289,7 @@ def _serve_soak() -> dict:
         import soak_serve
 
         rep = soak_serve.main(budget_s=4.0, clients=24, chaos=False)
-        return {
+        out = {
             "qps": rep["qps"],
             "p99_ms": rep["p99_ms"],
             "recompiles_after_warmup": rep["recompiles_after_warmup"],
@@ -298,6 +298,29 @@ def _serve_soak() -> dict:
         }
     except Exception as exc:  # fault-ok: telemetry only
         return {"error": str(exc)[:200]}
+    # cluster legs: the same soak through the multi-process router at 1
+    # and 2 workers, under SIGKILL chaos at 2 — tracks whether replica
+    # fan-out scales (scaling_efficiency = qps_2 / (2 * qps_1)) and
+    # whether worker death stays invisible (failures must be 0)
+    try:
+        r1 = soak_serve.main(budget_s=4.0, clients=24, workers=1)
+        r2 = soak_serve.main(budget_s=6.0, clients=24, workers=2,
+                             kill_workers=True)
+        out["cluster"] = {
+            "qps_1w": r1["qps"],
+            "qps_2w": r2["qps"],
+            "scaling_efficiency": round(
+                r2["qps"] / max(2 * r1["qps"], 1e-9), 3
+            ),
+            "workers": 2,
+            "worker_kills": r2["worker_kills"],
+            "worker_restarts": r2["worker_restarts"],
+            "replica_retries": r2["replica_retries"],
+            "failures": r1["failures"] + r2["failures"],
+        }
+    except Exception as exc:  # fault-ok: telemetry only
+        out["cluster"] = {"error": str(exc)[:200]}
+    return out
 
 
 def _time_query(g, query, params=None, repeats=3):

@@ -1,0 +1,20 @@
+"""known-bad: a loop-owned mutator handed to a process-spawn lane.
+
+``multiprocessing.Process(target=self.bump)`` drags ``self`` across the
+spawn boundary exactly like ``Thread(target=..)`` does — the bound method
+runs OFF the event loop, so a sync mutator of a ``shared-by: loop`` class
+reached this way is a race."""
+import multiprocessing
+
+
+class SpawnOwned:  # shared-by: loop
+    def __init__(self):
+        self.restarts = 0
+
+    def bump(self):
+        self.restarts += 1  # sync mutator, and a spawn lane runs it (below)
+
+    def relaunch(self):
+        p = multiprocessing.Process(target=self.bump)
+        p.start()
+        return p

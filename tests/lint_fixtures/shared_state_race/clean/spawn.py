@@ -1,0 +1,20 @@
+"""known-clean: process spawns that respect the ownership discipline."""
+import multiprocessing
+
+
+def child_entry():
+    print("worker process body; touches no shared object")
+
+
+class SpawnSupervisor:  # shared-by: loop
+    def __init__(self):
+        self.restarts = 0
+
+    async def note_restart(self):
+        self.restarts += 1  # async: always on the loop, single-threaded
+
+    def relaunch(self):
+        # module-level target: nothing of self crosses the spawn boundary
+        p = multiprocessing.Process(target=child_entry)
+        p.start()
+        return p

@@ -29,6 +29,17 @@ line prefixed ``SERVE_SOAK``:
   ``TPU_CYPHER_FAULTS``-grammar spec, scoped to that client's query only
   (``faults.scoped_spec`` via the server); results must STILL match the
   serial goldens and p99 stays bounded while neighbors degrade.
+* ``--workers N`` — multi-process mode: the same soak drives a
+  ``ClusterServer`` router over N supervised engine-worker processes
+  (``serve/cluster.py``). ``recompiles_after_warmup`` is ``None`` here
+  (workers compile in their own processes; the front end cannot see the
+  delta) and the report gains ``workers``/``worker_restarts``/
+  ``worker_kills``/``replica_retries``.
+* ``--kill-workers`` — process-chaos mode (implies ``--workers``): a
+  killer task SIGKILLs a random live worker every ~2 s (always leaving
+  at least one alive). The invariants stay absolute: ZERO client-visible
+  failures and every row set byte-identical to serial execution — dead
+  workers are the router's problem, not the clients'.
 
 ``bench.py`` imports ``main()`` for its ``serve_soak`` summary field.
 """
@@ -63,11 +74,15 @@ FAULT_SITES = ("join", "expand", "filter", "compact", "agg")
 FAULT_KINDS = ("oom", "compile", "lost")
 
 
-def _build_graph(session, n=48):
+def _create_query(n=48) -> str:
     parts = [f"(n{i}:P {{id: {i}}})" for i in range(n)]
     parts += [f"(n{i})-[:K]->(n{(i + 1) % n})" for i in range(n)]
     parts += [f"(n{i})-[:K]->(n{(i + 11) % n})" for i in range(n)]
-    return session.create_graph_from_create_query("CREATE " + ", ".join(parts))
+    return "CREATE " + ", ".join(parts)
+
+
+def _build_graph(session, n=48):
+    return session.create_graph_from_create_query(_create_query(n))
 
 
 def _combos():
@@ -142,23 +157,60 @@ def _pkey(params):
     return tuple(sorted(params.items()))
 
 
+async def _worker_killer(supervisor, t_end, kills, period_s=2.0):
+    """SIGKILL a random ready worker every ``period_s``, always leaving at
+    least one alive — the router must hide every death from the clients."""
+    import numpy as np
+
+    rng = np.random.default_rng(1234)
+    while time.monotonic() < t_end - 1.0:
+        await asyncio.sleep(period_s * (0.75 + 0.5 * rng.random()))
+        ready = [
+            w for w in supervisor.ready_workers
+            if w.transport is not None and w.transport.poll() is None
+        ]
+        if len(ready) < 2:
+            continue  # never orphan the fleet
+        victim = ready[int(rng.integers(0, len(ready)))]
+        os.kill(victim.transport.pid, 9)  # SIGKILL: no goodbye, no unwind
+        kills.append(victim.worker_id)
+
+
 def main(budget_s: float = 20.0, clients: int = 100, chaos: bool = False,
          seed: int = 0, batch_window_ms: float = 5.0,
-         max_concurrent: int = 8) -> dict:
+         max_concurrent: int = 8, workers: int = 0,
+         kill_workers: bool = False) -> dict:
     import numpy as np
 
     from tpu_cypher.backend.tpu import bucketing
     from tpu_cypher.relational.session import CypherSession
-    from tpu_cypher.serve import QueryServer
+    from tpu_cypher.serve import ClusterServer, QueryServer
     from tpu_cypher.serve.batching import DISPATCHES
+    from tpu_cypher.serve.router import REPLICA_RETRIES
     from tpu_cypher.serve.server import _encode_rows
 
-    session = CypherSession.tpu()
-    graph = _build_graph(session)
     combos = _combos()
+    if workers > 0:
+        server = ClusterServer(
+            workers=workers, port=0, max_concurrent=max_concurrent * workers,
+            batch_window_ms=batch_window_ms,
+        )
+        server.register_graph("soak", _create_query())
+        # worker-side warmup: the unparameterized corpus shapes (readiness
+        # is gated on it); parameterized shapes compile on first use
+        server.warmup([q for q, space in CORPUS if not space], "soak")
+        session, graph = server.session, server._graphs["soak"]
+    else:
+        session = CypherSession.tpu()
+        graph = _build_graph(session)
+        server = QueryServer(
+            session, port=0, max_concurrent=max_concurrent,
+            batch_window_ms=batch_window_ms,
+        )
+        server.register_graph("soak", graph)
 
     # serial goldens double as warmup: every corpus shape compiles here,
-    # so the soak itself must add zero compiles (non-chaos)
+    # so the soak itself must add zero compiles (non-chaos, in-process)
     goldens = {}
     for q, params in combos:
         records = graph.cypher(q, params).records
@@ -167,26 +219,30 @@ def main(budget_s: float = 20.0, clients: int = 100, chaos: bool = False,
         )
 
     async def run():
-        server = QueryServer(
-            session, port=0, max_concurrent=max_concurrent,
-            batch_window_ms=batch_window_ms,
-        )
-        server.register_graph("soak", graph)
         stats = {"queries": 0, "failures": 0, "batched_queries": 0,
                  "latencies": [], "errors": []}
+        kills = []
         disp_before = {
             lbl["batched"]: int(v) for lbl, v in DISPATCHES.items()
         }
+        retries_before = sum(int(v) for _, v in REPLICA_RETRIES.items())
         compiles_before = bucketing.compile_snapshot()
-        t0 = time.monotonic()
         async with server:
-            await asyncio.gather(*[
+            # clock starts AFTER the server (and, in cluster mode, every
+            # worker boot + warmup) is up — qps measures serving, not boot
+            t0 = time.monotonic()
+            tasks = [
                 _client(i, server.host, server.port, t0 + budget_s, combos,
                         goldens, np.random.default_rng(seed + i), chaos,
                         stats)
                 for i in range(clients)
-            ])
-        elapsed = time.monotonic() - t0
+            ]
+            if kill_workers and workers > 0:
+                tasks.append(
+                    _worker_killer(server.supervisor, t0 + budget_s, kills)
+                )
+            await asyncio.gather(*tasks)
+            elapsed = time.monotonic() - t0
         disp_after = {lbl["batched"]: int(v) for lbl, v in DISPATCHES.items()}
         disp = {
             k: disp_after.get(k, 0) - disp_before.get(k, 0)
@@ -194,37 +250,71 @@ def main(budget_s: float = 20.0, clients: int = 100, chaos: bool = False,
         }
         total_disp = max(disp["true"] + disp["false"], 1)
         lat_ms = np.asarray(stats["latencies"]) * 1000.0
-        return {
+        report = {
             "queries": stats["queries"],
             "failures": stats["failures"],
             "clients": clients,
             "qps": round(stats["queries"] / max(elapsed, 1e-9), 1),
             "p50_ms": round(float(np.percentile(lat_ms, 50)), 2) if len(lat_ms) else None,
             "p99_ms": round(float(np.percentile(lat_ms, 99)), 2) if len(lat_ms) else None,
-            "recompiles_after_warmup": int(
-                bucketing.compile_delta(compiles_before)["compiles"]
+            # workers compile in their own processes: the front end cannot
+            # observe their delta, so the field is None in cluster mode
+            "recompiles_after_warmup": (
+                None if workers > 0 else int(
+                    bucketing.compile_delta(compiles_before)["compiles"]
+                )
             ),
             "batched_dispatch_ratio": round(disp["true"] / total_disp, 4),
             "batched_queries": stats["batched_queries"],
             "chaos": chaos,
+            "workers": workers,
             "errors": stats["errors"][:10],
         }
+        if workers > 0:
+            report.update(
+                worker_kills=len(kills),
+                worker_restarts=server.supervisor.total_restarts,
+                replica_retries=(
+                    sum(int(v) for _, v in REPLICA_RETRIES.items())
+                    - retries_before
+                ),
+            )
+        return report
 
     return asyncio.run(run())
 
 
 if __name__ == "__main__":
-    args = [a for a in sys.argv[1:] if a != "--faults"]
-    chaos = "--faults" in sys.argv[1:]
+    argv = sys.argv[1:]
+    chaos, kill_workers, workers, args = False, False, 0, []
+    i = 0
+    while i < len(argv):
+        a = argv[i]
+        if a == "--faults":
+            chaos = True
+        elif a == "--kill-workers":
+            kill_workers = True
+        elif a == "--workers":
+            i += 1
+            workers = int(argv[i])
+        elif a.startswith("--workers="):
+            workers = int(a.split("=", 1)[1])
+        else:
+            args.append(a)
+        i += 1
+    if kill_workers and workers == 0:
+        workers = 2
     budget = float(args[0]) if len(args) > 0 else 20.0
     clients = int(args[1]) if len(args) > 1 else 100
-    report = main(budget, clients, chaos=chaos)
+    report = main(budget, clients, chaos=chaos, workers=workers,
+                  kill_workers=kill_workers)
     errors = report.pop("errors")
     print("SERVE_SOAK " + json.dumps(report))
     for e in errors:
         print("  " + e)
     bad = report["failures"] > 0
-    if not chaos and report["recompiles_after_warmup"] > 0:
+    if (not chaos and report["recompiles_after_warmup"] is not None
+            and report["recompiles_after_warmup"] > 0):
         print("FAIL: recompiles after warmup in a non-chaos soak")
         bad = True
     sys.exit(1 if bad else 0)

@@ -564,3 +564,24 @@ def test_engine_lint_summary_reports_per_rule_counts():
     assert s["clean"] is True
     assert s["findings_by_rule"] == {}
     assert s["files_checked"] > 80 and s["suppressed"] >= 1
+
+
+# ---------------------------------------------------------------------------
+# cross-process spawn lane (PR 11): multiprocessing.Process targets are
+# lane roots just like Thread targets
+# ---------------------------------------------------------------------------
+
+
+def test_shared_state_race_process_spawn_lane():
+    """A bound method handed to ``multiprocessing.Process(target=..)``
+    drags ``self`` across the spawn boundary: a sync mutator of a
+    ``shared-by: loop`` class reached that way must fire the rule
+    (bad/spawn.py), while a module-level target and an async mutator
+    stay silent (clean/spawn.py — covered by the generic clean test)."""
+    report = _run_fixture("shared-state-race", "bad")
+    spawn_hits = [
+        f for f in report.blocking
+        if f.rule == "shared-state-race" and f.path.endswith("spawn.py")
+    ]
+    assert spawn_hits, "Process(target=self.bump) did not register as a lane"
+    assert any("bump" in f.message for f in spawn_hits), spawn_hits

@@ -471,9 +471,13 @@ class CallGraph:
     def lane_roots(self) -> Set[ast.AST]:
         """Function nodes handed to a worker lane by reference: arguments
         of ``run_in_executor`` / ``to_thread`` / ``submit`` /
-        ``Context().run`` / ``Thread(target=..)`` sinks, plus the call
-        targets inside lambdas passed to those sinks (the lambda body runs
-        ON the lane). Cached — the graph is immutable once built."""
+        ``Context().run`` / ``Thread(target=..)`` / ``Process(target=..)``
+        sinks, plus the call targets inside lambdas passed to those sinks
+        (the lambda body runs ON the lane). A ``multiprocessing.Process``
+        target is a lane like any other for race purposes: bound-method
+        targets drag ``self`` across the spawn boundary, so loop-affine
+        state reached from one is just as suspect as from a thread.
+        Cached — the graph is immutable once built."""
         cached = getattr(self, "_lane_roots", None)
         if cached is not None:
             return cached
@@ -488,7 +492,7 @@ class CallGraph:
                     cand_args = list(call.args) + [
                         kw.value for kw in call.keywords
                     ]
-                elif leaf == "Thread":
+                elif leaf in ("Thread", "Process"):
                     cand_args = [
                         kw.value for kw in call.keywords if kw.arg == "target"
                     ]

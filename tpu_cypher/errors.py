@@ -67,6 +67,21 @@ class DeviceLost(DeviceError):
     """The device or its transport disappeared mid-query."""
 
 
+class WorkerLost(DeviceLost):
+    """An engine-worker PROCESS (serve/worker.py) died or its socket
+    disconnected mid-query — the multi-process analogue of ``DeviceLost``.
+    Reads are idempotent, so the router retries them transparently on a
+    surviving replica (stamped ``RUNG_REPLICA`` in the execution log)
+    instead of degrading down the in-process ladder.
+
+    ``worker``: the worker id the router observed failing, when known."""
+
+    def __init__(self, message: str, *, site: Optional[str] = None,
+                 worker: Optional[str] = None, cause=None):
+        super().__init__(message, site=site, cause=cause)
+        self.worker = worker
+
+
 class QueryTimeout(ExecutionFault):
     """The per-query wall-clock deadline expired. Terminal: the ladder does
     not retry (a degraded re-execution would only run further past the
@@ -149,6 +164,17 @@ def classify(
         if site is not None and exc.site is None:
             exc.site = site
         return exc
+    # worker-socket disconnect/EOF: the peer engine-worker process died
+    # mid-conversation (serve/router.py observes exactly this when a child
+    # takes a native libtpu abort). ConnectionError covers reset/refused/
+    # broken-pipe/aborted; EOFError covers asyncio.IncompleteReadError.
+    if isinstance(exc, (ConnectionError, EOFError)):
+        return WorkerLost(
+            f"{f'[site={site}] ' if site else ''}worker connection lost: "
+            f"{type(exc).__name__}: {exc}",
+            site=site,
+            cause=exc,
+        )
     if not _is_raw_device_exc(exc):
         return None
     if site is None:

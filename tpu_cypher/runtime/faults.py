@@ -7,7 +7,7 @@ specs):
 
     kind@site[:occurrence]
 
-* ``kind``  — ``oom`` | ``compile`` | ``lost`` | ``timeout``
+* ``kind``  — ``oom`` | ``compile`` | ``lost`` | ``timeout`` | ``crash``
 * ``site``  — a named fault site (``join``, ``expand``, ``var_expand``,
   ``filter``, ``compact``, ``shuffle``, ``agg``, plus the Pallas kernel-tier sites
   ``kernel_join``/``kernel_expand``/``kernel_agg``/``kernel_frontier``
@@ -35,11 +35,21 @@ Injected exceptions are RAW (``InjectedFault``, message carrying the same
 status markers jaxlib uses) so they flow through ``tpu_cypher.errors
 .classify`` exactly like real faults. ``timeout`` injects a typed
 ``QueryTimeout`` directly (deadline expiry is not a raw device error).
+
+``crash`` is the process-death kind: inside an ARMED engine-worker process
+(``serve/worker.py`` calls ``enable_crash()``), the covered invocation
+``os._exit``\\ s the whole process — the deterministic stand-in for a
+native libtpu abort, driving the supervisor/router recovery path
+(restart, breaker, replica retry) without a real TPU death. In any
+process that has NOT armed it (tests, the router front end, plain
+sessions) the kind degrades to a raised lost-style ``InjectedFault``, so
+a stray ``crash@...`` spec can never kill the test runner.
 """
 
 from __future__ import annotations
 
 import contextvars
+import os
 import threading
 from typing import Dict, List, Optional, Tuple
 
@@ -79,9 +89,28 @@ _KIND_MESSAGES = {
     "compile": "INTERNAL: injected XLA compilation failure while compiling "
     "fused computation",
     "lost": "UNAVAILABLE: injected device lost (TPU driver tunnel closed)",
+    "crash": "UNAVAILABLE: injected worker crash (disarmed outside an "
+    "engine-worker process)",
 }
 
 _INF = 1 << 62
+
+# the ``crash`` kind is only ever allowed to take down a dedicated
+# engine-worker process — serve/worker.py arms it at startup; everywhere
+# else a crash spec degrades to a raised lost-style fault
+_CRASH_EXIT_CODE = 137
+_crash_armed = False
+
+
+def enable_crash(enabled: bool = True) -> None:
+    """Arm (or disarm) the ``crash`` fault kind for THIS process. Only an
+    expendable engine-worker process may arm it; the default is disarmed."""
+    global _crash_armed
+    _crash_armed = bool(enabled)
+
+
+def crash_armed() -> bool:
+    return _crash_armed
 
 _lock = threading.Lock()
 # parsed spec cache, keyed by the raw env/override string
@@ -109,7 +138,7 @@ def parse_spec(text: str) -> Dict[str, List[Tuple[str, int, int]]]:
             raise FaultSpecError(f"fault spec {part!r}: expected kind@site[:n]")
         kind, _, rest = part.partition("@")
         kind = kind.strip().lower()
-        if kind not in ("oom", "compile", "lost", "timeout"):
+        if kind not in ("oom", "compile", "lost", "timeout", "crash"):
             raise FaultSpecError(f"fault spec {part!r}: unknown kind {kind!r}")
         site, _, occ = rest.partition(":")
         site = site.strip()
@@ -241,6 +270,11 @@ def fault_point(site: str) -> None:
                     f"(invocation {n})",
                     site=site,
                 )
+            if kind == "crash" and _crash_armed:
+                # the worker-process analogue of a native libtpu abort:
+                # no unwinding, no atexit — the supervisor sees a dead
+                # child, the router sees a socket EOF
+                os._exit(_CRASH_EXIT_CODE)
             raise InjectedFault(
                 f"{_KIND_MESSAGES[kind]} [injected: {kind}@{site} "
                 f"invocation {n}]",

@@ -38,6 +38,13 @@ RUNG_HOST = "host-oracle"  # full local-backend re-execution
 
 LADDER = (RUNG_DEVICE, RUNG_BUCKET_EXACT, RUNG_CHUNKED, RUNG_HOST)
 
+# serving-layer rung, OUTSIDE the in-process LADDER: a read query whose
+# engine-worker process died mid-flight was re-dispatched to a surviving
+# replica by the router (serve/router.py). Stamped per failed attempt in
+# ``execution_log`` just like the in-process rungs, so a client's ``done``
+# message shows exactly which attempts a transparent retry cost.
+RUNG_REPLICA = "replica"
+
 # LADDER_MODE ("on": degrade-and-retry; "off": first-rung errors raise),
 # CHUNK_ROWS (rows per gather slice at the chunked rung), and DEADLINE_S
 # (0 = none; session option overrides the env) are declared in the typed

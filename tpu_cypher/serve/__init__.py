@@ -8,7 +8,8 @@ The subsystem (``docs/serving.md``) in one line per layer:
   ``contextvars.Context`` so engine state never leaks between clients.
 * ``scheduler`` — admission by padded-memory cost (``bucketing.admit``
   pre-flight, then cost-ordered tenant-fair slot grants) with queued
-  deadline expiry raising the engine's typed ``QueryTimeout``.
+  deadline expiry raising the engine's typed ``QueryTimeout``, plus
+  queue-depth overload shedding and graceful drain.
 * ``batching`` — same-plan/same-params/same-bucket queries arriving
   within ``TPU_CYPHER_SERVE_BATCH_WINDOW_MS`` coalesce into ONE device
   dispatch, demuxed per client.
@@ -16,7 +17,22 @@ The subsystem (``docs/serving.md``) in one line per layer:
   plus ``GET /metrics`` (``session.metrics_text()`` verbatim) and
   ``GET /queries/<id>`` (per-query profile JSON) on the same port.
 
-Run one with ``python -m tpu_cypher.serve`` (demo graph) or embed::
+And the fault-isolated multi-process tier layered on top (PR 11):
+
+* ``wire`` — the worker wire protocol + the shared execute-payload
+  builder (single-process and multi-process results cannot drift).
+* ``worker`` — the engine-worker process: one warm session per OS
+  process, expendable by design, readiness gated on warmup.
+* ``supervisor`` — spawn/health-check/restart with exponential backoff
+  and a per-worker circuit breaker probed by canary queries.
+* ``router`` — tenant-affine routing, transparent replica retry of reads
+  after ``WorkerLost`` (rung ``"replica"``), optional hedged dispatch.
+* ``cluster`` — ``ClusterServer``: ``QueryServer``'s whole front half
+  (protocol, admission, batching, obs) over N supervised workers sharing
+  one persistent compile cache.
+
+Run one with ``python -m tpu_cypher.serve`` (demo graph; set
+``TPU_CYPHER_SERVE_WORKERS=4`` for the multi-process tier) or embed::
 
     server = QueryServer(session, port=0)
     server.register_graph("social", graph)
@@ -25,17 +41,31 @@ Run one with ``python -m tpu_cypher.serve`` (demo graph) or embed::
 """
 
 from .batching import BatchWindow, batch_key, bucket_signature
+from .cluster import ClusterServer
+from .router import Router
 from .scheduler import AdmissionScheduler, estimate_cost_bytes, preflight_admit
 from .server import PAGE_ROWS, PROTOCOL_VERSION, QueryServer
 from .session_pool import SessionPool
+from .supervisor import (
+    CircuitBreaker,
+    SubprocessLauncher,
+    Supervisor,
+    WorkerHandle,
+)
 
 __all__ = [
     "AdmissionScheduler",
     "BatchWindow",
+    "CircuitBreaker",
+    "ClusterServer",
     "PAGE_ROWS",
     "PROTOCOL_VERSION",
     "QueryServer",
+    "Router",
     "SessionPool",
+    "SubprocessLauncher",
+    "Supervisor",
+    "WorkerHandle",
     "batch_key",
     "bucket_signature",
     "estimate_cost_bytes",

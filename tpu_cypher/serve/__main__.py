@@ -10,16 +10,25 @@ smoke target::
     printf '%s\n' '{"op":"submit","graph":"demo","query":"MATCH (a:P) RETURN count(a) AS n"}' | nc localhost 7687
     curl -s localhost:7687/metrics | head
 
-Real deployments embed ``QueryServer`` and mount their own catalog
-graphs; see docs/serving.md.
+With ``TPU_CYPHER_SERVE_WORKERS=N`` (N > 0) the same entry point runs the
+fault-isolated multi-process tier instead: a ``ClusterServer`` router in
+this process fanning out to N supervised engine-worker processes
+(``serve/cluster.py``). SIGTERM drains gracefully in either mode:
+in-flight queries finish, new submits are rejected typed, workers exit.
+
+Real deployments embed ``QueryServer``/``ClusterServer`` and mount their
+own catalog graphs; see docs/serving.md.
 """
 
 from __future__ import annotations
 
 import asyncio
+import signal
 import sys
 
 from ..relational.session import CypherSession
+from ..utils.config import SERVE_WORKERS
+from .cluster import ClusterServer
 from .server import QueryServer
 
 DEMO_WARMUP = (
@@ -29,25 +38,44 @@ DEMO_WARMUP = (
 )
 
 
-def _demo_graph(session: CypherSession, n: int = 32):
+def _demo_create_query(n: int = 32) -> str:
     parts = [f"(n{i}:P {{id: {i}}})" for i in range(n)]
     parts += [f"(n{i})-[:K]->(n{(i + 1) % n})" for i in range(n)]
     parts += [f"(n{i})-[:K]->(n{(i + 7) % n})" for i in range(n)]
-    return session.create_graph_from_create_query("CREATE " + ", ".join(parts))
+    return "CREATE " + ", ".join(parts)
 
 
 async def _serve(server: QueryServer, stats) -> int:
     await server.start()
+    mode = (
+        f"{server.n_workers} workers"
+        if isinstance(server, ClusterServer)
+        else "single-process"
+    )
     print(
         f"tpu-cypher query server on {server.host}:{server.port} "
-        f"(graphs: demo; warmup compiles: {stats.get('compiles', '?')})",
+        f"({mode}; graphs: demo; warmup compiles: "
+        f"{stats.get('compiles', '?')})",
         flush=True,
     )
+    loop = asyncio.get_running_loop()
+    stop = asyncio.Event()
+    # SIGTERM = graceful drain (k8s preStop semantics): finish in-flight,
+    # reject new submits typed, then exit
+    loop.add_signal_handler(signal.SIGTERM, stop.set)
+    serve_task = asyncio.ensure_future(server.serve_forever())
+    stop_task = asyncio.ensure_future(stop.wait())
     try:
-        await server.serve_forever()
+        await asyncio.wait(
+            {serve_task, stop_task}, return_when=asyncio.FIRST_COMPLETED
+        )
+        if stop.is_set():
+            await server.drain()
     except (KeyboardInterrupt, asyncio.CancelledError):
         pass
     finally:
+        serve_task.cancel()
+        stop_task.cancel()
         await server.stop()
     return 0
 
@@ -56,9 +84,16 @@ def _main() -> int:
     # the blocking setup — session bring-up, demo graph, warmup compiles —
     # happens BEFORE the event loop exists; the loop only ever runs
     # non-blocking serving code (the async-blocking lint pins this)
+    if int(SERVE_WORKERS.get()) > 0:
+        server = ClusterServer()
+        server.register_graph("demo", _demo_create_query())
+        stats = server.warmup(DEMO_WARMUP, "demo")
+        return asyncio.run(_serve(server, stats))
     session = CypherSession.tpu()
     server = QueryServer(session)
-    server.register_graph("demo", _demo_graph(session))
+    server.register_graph(
+        "demo", session.create_graph_from_create_query(_demo_create_query())
+    )
     stats = server.warmup(DEMO_WARMUP, "demo")
     return asyncio.run(_serve(server, stats))
 

@@ -1,0 +1,165 @@
+"""Fault-isolated multi-process serving: ``ClusterServer``.
+
+``QueryServer`` (PR 6) multiplexes tenants onto one warm engine in ONE
+process — one native device abort (libtpu takes the process down, no
+Python unwinding) and every tenant is gone. ``ClusterServer`` keeps the
+entire front half of that server — protocol, admission scheduling,
+micro-batching, HTTP observability — and swaps exactly one method:
+``_execute_payload`` routes to a supervised engine-worker PROCESS
+(``serve/worker.py``) through the router instead of running in-process.
+
+The blast radius of a crash becomes one worker's in-flight queries, and
+even those are transparently retried on a surviving replica
+(``serve/router.py``; rung ``"replica"`` in the execution log). What
+stays shared across workers is exactly what is safe to share: the
+persistent XLA compile cache on disk — N processes, one set of compile
+artifacts, so worker N's warmup (and every crash restart) loads instead
+of recompiling.
+
+Graphs are REPLICATED, not shared: ``register_graph`` takes the CREATE
+query text and every worker builds its own copy (device buffers cannot
+cross process boundaries; the text is the portable form, and the local
+replica built from the same text keeps cost estimation and the
+``/metrics`` surface identical to single-process serving). The same
+deferral applies to ``warmup``: the corpus is recorded and each worker
+runs it at boot — readiness is warmup-gated per worker.
+
+Sizing: each worker is its own engine with ``lanes`` execution lanes, so
+the cluster's admission ceiling defaults to ``max_concurrent x workers``
+— the scheduler admits what the fleet can actually run.
+"""
+
+from __future__ import annotations
+
+import tempfile
+import time
+from typing import Any, Dict, List, Optional
+
+from ..utils.config import (
+    COMPILE_CACHE_DIR,
+    SERVE_DRAIN_TIMEOUT_S,
+    SERVE_MAX_CONCURRENT,
+    SERVE_WORKERS,
+)
+from .router import Router
+from .server import QueryServer, _Ticket
+from .supervisor import SubprocessLauncher, Supervisor
+
+
+class ClusterServer(QueryServer):  # shared-by: loop
+    """The router front end over N supervised engine-worker processes."""
+
+    def __init__(
+        self,
+        workers: Optional[int] = None,
+        host: str = "127.0.0.1",
+        port: Optional[int] = None,
+        max_concurrent: Optional[int] = None,
+        batch_window_ms: Optional[float] = None,
+        tenant_quota: Optional[int] = None,
+        persistent_cache_dir: Optional[str] = None,
+        launcher=None,
+        retry_max: Optional[int] = None,
+        hedge_ms: Optional[float] = None,
+        lanes: int = 4,
+    ):
+        self.n_workers = max(
+            int(workers if workers is not None else SERVE_WORKERS.get()), 1
+        )
+        if max_concurrent is None:
+            # the fleet runs n_workers engines; admit what it can execute
+            max_concurrent = int(SERVE_MAX_CONCURRENT.get()) * self.n_workers
+        super().__init__(
+            host=host, port=port, max_concurrent=max_concurrent,
+            batch_window_ms=batch_window_ms, tenant_quota=tenant_quota,
+        )
+        # one compile-cache dir shared by every worker: restart warmups
+        # load artifacts from here instead of recompiling
+        self.persistent_cache_dir = (
+            persistent_cache_dir
+            or COMPILE_CACHE_DIR.get()
+            or tempfile.mkdtemp(prefix="tpu-cypher-cluster-cache-")
+        )
+        self.lanes = int(lanes)
+        self._graph_specs: Dict[str, str] = {}
+        self._warmup_specs: Dict[str, List[str]] = {}
+        self._launcher = launcher
+        self._retry_max = retry_max
+        self._hedge_ms = hedge_ms
+        self.supervisor: Optional[Supervisor] = None
+        self.router: Optional[Router] = None
+
+    # -- graphs: replicated by CREATE text -------------------------------
+
+    def register_graph(self, name: str, create_query: str) -> None:  # type: ignore[override]
+        """Mount a graph cluster-wide from its CREATE query text. The
+        front end builds a LOCAL replica too (cost estimation, batching
+        keys, and the single-process protocol surface all need a real
+        graph object); workers each build theirs at boot."""
+        self._graph_specs[name] = create_query
+        graph = self.session.create_graph_from_create_query(create_query)
+        super().register_graph(name, graph)
+
+    def warmup(self, queries, graph_name: str,
+               parameters: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:  # type: ignore[override]
+        """Record the warmup corpus for the workers (each runs it at boot,
+        gating its own readiness). The front end does NOT execute it — the
+        router never executes queries locally."""
+        qs = list(queries)
+        self._warmup_specs.setdefault(graph_name, []).extend(qs)
+        return {"queries": len(qs), "deferred": True}
+
+    # -- lifecycle -------------------------------------------------------
+
+    async def start(self) -> None:
+        if self._launcher is None:
+            self._launcher = SubprocessLauncher(
+                self._graph_specs, self._warmup_specs,
+                persistent_cache_dir=self.persistent_cache_dir,
+                host=self.host, lanes=self.lanes,
+            )
+        canary = None
+        if self._graph_specs:
+            # a cheap known-good read on the first mounted graph: what the
+            # supervisor executes to PROVE a worker ready (breaker close,
+            # restart completion)
+            first = sorted(self._graph_specs)[0]
+            canary = (first, "MATCH (n) RETURN count(n) AS n")
+        self.supervisor = Supervisor(
+            self._launcher, self.n_workers, canary=canary
+        )
+        self.router = Router(
+            self.supervisor, retry_max=self._retry_max,
+            hedge_ms=self._hedge_ms,
+        )
+        await self.supervisor.start()
+        await super().start()
+
+    async def stop(self) -> None:
+        await super().stop()
+        if self.supervisor is not None:
+            await self.supervisor.stop()
+
+    async def drain(self, timeout: Optional[float] = None) -> None:
+        """Graceful cluster drain: stop admitting (typed rejections), let
+        in-flight queries finish, then ask every worker to exit."""
+        budget = float(
+            timeout if timeout is not None else SERVE_DRAIN_TIMEOUT_S.get()
+        )
+        await super().drain(budget)
+        if self.supervisor is not None:
+            await self.supervisor.drain(budget)
+
+    # -- the execution hook ----------------------------------------------
+
+    async def _execute_payload(self, t: _Ticket, graph) -> Dict[str, Any]:
+        remaining = None
+        if t.deadline_s:
+            remaining = max(
+                t.deadline_s - (time.monotonic() - t.submitted_at), 1e-6
+            )
+        return await self.router.submit(
+            graph=t.graph_name, query=t.query, parameters=t.parameters,
+            tenant=t.tenant, deadline_s=remaining, faults=t.faults,
+            qid=t.qid,
+        )

@@ -35,7 +35,7 @@ import time
 from typing import Dict, List, Optional
 
 from ..backend.tpu import bucketing
-from ..errors import QueryTimeout
+from ..errors import AdmissionRejected, QueryTimeout
 from ..obs.metrics import REGISTRY as _REGISTRY
 
 # serving-layer scheduler telemetry (docs/serving.md lists the names)
@@ -104,13 +104,23 @@ class _Waiter:
 class AdmissionScheduler:  # shared-by: loop
     """Bounded concurrency with cost-ordered, tenant-fair slot grants."""
 
-    def __init__(self, max_concurrent: int, tenant_quota: int = 0):
+    def __init__(
+        self,
+        max_concurrent: int,
+        tenant_quota: int = 0,
+        queue_high: int = 0,
+    ):
         self.max_concurrent = max(int(max_concurrent), 1)
         self.tenant_quota = max(int(tenant_quota), 0)
+        # overload shed watermark (TPU_CYPHER_SERVE_QUEUE_HIGH): a queue
+        # already this deep rejects new arrivals typed BEFORE they queue —
+        # bounded queues fail fast instead of accumulating doomed waiters
+        self.queue_high = max(int(queue_high), 0)
         self._running = 0
         self._inflight: Dict[str, int] = {}
         self._waiters: List[_Waiter] = []
         self._seq = itertools.count()
+        self._draining = False
 
     # -- introspection ---------------------------------------------------
 
@@ -124,6 +134,28 @@ class AdmissionScheduler:  # shared-by: loop
 
     def inflight(self, tenant: str) -> int:
         return self._inflight.get(tenant, 0)
+
+    @property
+    def draining(self) -> bool:
+        return self._draining
+
+    # -- drain -----------------------------------------------------------
+
+    def begin_drain(self) -> None:
+        """Flip to drain mode: every future ``acquire`` rejects typed
+        (``AdmissionRejected`` reason=draining); queries already queued or
+        running are unaffected and finish normally."""
+        self._draining = True
+
+    async def quiesce(self, timeout: float) -> None:
+        """Wait (bounded) until nothing is running or queued. Polling is
+        fine here: drain is a once-per-process-lifetime event and the poll
+        period only bounds shutdown latency, not throughput."""
+        deadline = time.monotonic() + max(float(timeout), 0.0)
+        while self._running > 0 or self._waiters:
+            if time.monotonic() >= deadline:
+                return
+            await asyncio.sleep(0.02)
 
     # -- the queue -------------------------------------------------------
 
@@ -164,6 +196,21 @@ class AdmissionScheduler:  # shared-by: loop
         the query's deadline expires while still queued (the query never
         ran — no slot was consumed)."""
         t0 = time.monotonic()
+        if self._draining:
+            REJECTED.inc(reason="draining")
+            raise AdmissionRejected(
+                "server is draining: not accepting new queries",
+                site="serve-admission",
+            )
+        if self.queue_high and len(self._waiters) >= self.queue_high:
+            # overload shed: reject while the queue is at the watermark —
+            # a fast typed failure beats a slow deadline expiry in queue
+            REJECTED.inc(reason="shed")
+            raise AdmissionRejected(
+                f"admission queue at high watermark "
+                f"({len(self._waiters)} >= {self.queue_high})",
+                site="serve-admission",
+            )
         if deadline_at is not None and t0 >= deadline_at:
             # already dead on arrival: never consumes a slot (the guard
             # could only catch this at the query's first sync site — a

@@ -46,17 +46,17 @@ from collections import OrderedDict
 from typing import Any, Dict, List, Optional, Tuple
 
 from .. import errors as ERR
-from ..api import values as V
 from ..obs.metrics import REGISTRY as _REGISTRY
 from ..relational.session import CypherSession, PropertyGraph
-from ..runtime import faults as F
-from ..runtime import guard as G
 from ..utils.config import (
     SERVE_BATCH_WINDOW_MS,
+    SERVE_DRAIN_TIMEOUT_S,
     SERVE_MAX_CONCURRENT,
     SERVE_PORT,
+    SERVE_QUEUE_HIGH,
     SERVE_TENANT_QUOTA,
 )
+from . import wire
 from .batching import BatchWindow, batch_key
 from .scheduler import AdmissionScheduler, preflight_admit
 from .session_pool import SessionPool
@@ -75,21 +75,10 @@ QUERY_SECONDS = _REGISTRY.histogram(
     "wall seconds from submit to done, per client query",
 )
 
-
-def _json_value(v: Any) -> Any:
-    """JSON-safe wire form of a Cypher value. Scalars pass through;
-    structured and temporal values ride their deterministic Cypher text
-    (``api.values.to_cypher_string`` — the TCK formatting), which is what
-    makes 'byte-identical to serial execution' a checkable property."""
-    if v is None or isinstance(v, (bool, int, str)):
-        return v
-    if isinstance(v, float):
-        return v
-    return V.to_cypher_string(v)
-
-
-def _encode_rows(rows, columns) -> List[Dict[str, Any]]:
-    return [{c: _json_value(r.get(c)) for c in columns} for r in rows]
+# the wire module owns value/row encoding now (router and worker processes
+# need the identical forms); these aliases keep existing importers working
+_json_value = wire.json_value
+_encode_rows = wire.encode_rows
 
 
 class _Ticket:
@@ -166,7 +155,9 @@ class QueryServer:  # shared-by: loop
         )
         self.pool = SessionPool(session, workers=max_c)
         self.session = self.pool.session
-        self.scheduler = AdmissionScheduler(max_c, tenant_quota=quota)
+        self.scheduler = AdmissionScheduler(
+            max_c, tenant_quota=quota, queue_high=int(SERVE_QUEUE_HIGH.get())
+        )
         self.batcher = BatchWindow(window)
         self._graphs: Dict[str, PropertyGraph] = {}
         self._tickets: Dict[str, _Ticket] = {}
@@ -204,6 +195,19 @@ class QueryServer:  # shared-by: loop
             self._server.close()
             await self._server.wait_closed()
         self.pool.close()
+
+    async def drain(self, timeout: Optional[float] = None) -> None:
+        """Graceful drain (SIGTERM semantics): new submits are rejected
+        typed (``AdmissionRejected``) from this moment; queries already
+        admitted or queued run to completion (bounded by ``timeout``,
+        default ``TPU_CYPHER_SERVE_DRAIN_TIMEOUT_S``). The listener stays
+        up through the drain so in-flight clients receive their rows;
+        ``stop()`` afterwards tears it down."""
+        budget = float(
+            timeout if timeout is not None else SERVE_DRAIN_TIMEOUT_S.get()
+        )
+        self.scheduler.begin_drain()
+        await self.scheduler.quiesce(budget)
 
     async def serve_forever(self) -> None:
         if self._server is None:
@@ -360,9 +364,7 @@ class QueryServer:  # shared-by: loop
             await self.scheduler.acquire(cost, t.tenant, deadline_at)
             t.status = "running"
             try:
-                payload = await self.pool.run(
-                    lambda: self._execute(graph, t)
-                )
+                payload = await self._execute_payload(t, graph)
             finally:
                 self.scheduler.release(t.tenant)
             self.batcher.publish(batch, result=payload)
@@ -371,36 +373,27 @@ class QueryServer:  # shared-by: loop
         except Exception as exc:  # fault-ok: published to every member as a typed error
             self.batcher.publish(batch, error=exc)
 
+    async def _execute_payload(self, t: _Ticket, graph) -> Dict[str, Any]:
+        """THE execution hook: everything above it (protocol, batching,
+        admission) is shared with the multi-process tier, which overrides
+        this one method to route to an engine-worker process instead
+        (``serve/cluster.py``)."""
+        return await self.pool.run(lambda: self._execute(graph, t))
+
     def _execute(self, graph, t: _Ticket) -> Dict[str, Any]:
         """One engine execution — runs on a pool worker thread inside a
         FRESH contextvars.Context; everything scoped here dies with the
         query."""
-        t0 = time.perf_counter()
-        with contextlib.ExitStack() as stack:
-            if t.deadline_s:
-                # remaining budget: queue wait already consumed part of it
-                remaining = max(
-                    t.deadline_s - (time.monotonic() - t.submitted_at), 1e-6
-                )
-                stack.enter_context(G.request_deadline(remaining))
-            if t.faults is not None:
-                stack.enter_context(F.scoped_spec(t.faults))
-            result = self.session.cypher(t.query, t.parameters, graph=graph)
-            records = result.records
-            rows = records.collect() if records is not None else []
-            columns = list(records.columns) if records is not None else []
-        log = list(result.execution_log)
-        rungs = [e["rung"] for e in log]
-        return {
-            "rows": _encode_rows(rows, columns),
-            "columns": columns,
-            "seconds": round(time.perf_counter() - t0, 6),
-            "execution_log": log,
-            "rungs": rungs,
-            "degraded": bool(rungs and rungs[-1] != G.RUNG_DEVICE),
-            "compile_stats": result.compile_stats,
-            "profile": result.profile(execute=False).to_dict(),
-        }
+        remaining = None
+        if t.deadline_s:
+            # remaining budget: queue wait already consumed part of it
+            remaining = max(
+                t.deadline_s - (time.monotonic() - t.submitted_at), 1e-6
+            )
+        return wire.execute_payload(
+            self.session, graph, t.query, t.parameters,
+            deadline_s=remaining, faults=t.faults,
+        )
 
     async def _finish(self, t: _Ticket, batch) -> None:
         if batch.error is not None:

@@ -1,0 +1,149 @@
+"""The engine-worker wire protocol, shared by router and worker.
+
+One module owns three things the multi-process tier must agree on, so the
+front end (``serve/router.py``), the worker processes
+(``serve/worker.py``), and the single-process server (``serve/server.py``)
+cannot drift:
+
+* **framing** — newline-delimited JSON over a stream pair
+  (``send_msg``/``read_msg``), plus ``request`` for the one-shot
+  connect/ask/close round trip the router, supervisor pings, and canary
+  probes all use. EOF mid-read surfaces as ``asyncio.IncompleteReadError``
+  (an ``EOFError``) so ``errors.classify`` maps it to ``WorkerLost``.
+
+* **the execute payload** — ``execute_payload`` runs ONE query on a warm
+  session inside the caller's already-fresh context (request deadline and
+  chaos schedule scoped in) and returns the JSON-safe result dict
+  {rows, columns, seconds, execution_log, rungs, degraded, compile_stats,
+  profile}. ``QueryServer._execute`` and the worker's execute op are both
+  one-line wrappers over it — 'byte-identical rows across serving modes'
+  stays a checkable property.
+
+* **typed errors on the wire** — a worker failure travels as
+  ``{"ok": false, "error": <type name>, "message": ...}``;
+  ``raise_wire_error`` reconstructs the engine's typed exception on the
+  router side so retry/shed/deadline decisions see real types, not
+  strings.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import contextlib
+import json
+import time
+from typing import Any, Dict, List, Optional
+
+from .. import errors as ERR
+from ..api import values as V
+from ..runtime import faults as F
+from ..runtime import guard as G
+
+
+def json_value(v: Any) -> Any:
+    """JSON-safe wire form of a Cypher value. Scalars pass through;
+    structured and temporal values ride their deterministic Cypher text
+    (``api.values.to_cypher_string`` — the TCK formatting), which is what
+    makes 'byte-identical to serial execution' a checkable property."""
+    if v is None or isinstance(v, (bool, int, str)):
+        return v
+    if isinstance(v, float):
+        return v
+    return V.to_cypher_string(v)
+
+
+def encode_rows(rows, columns) -> List[Dict[str, Any]]:
+    return [{c: json_value(r.get(c)) for c in columns} for r in rows]
+
+
+def execute_payload(
+    session,
+    graph,
+    query: str,
+    parameters: Optional[Dict[str, Any]] = None,
+    *,
+    deadline_s: Optional[float] = None,
+    faults: Optional[str] = None,
+) -> Dict[str, Any]:
+    """One engine execution -> the wire payload. Runs BLOCKING engine work;
+    callers put it on a worker lane (``SessionPool.run``) inside a fresh
+    ``contextvars.Context``. ``deadline_s`` is the REMAINING budget (queue
+    wait already deducted); ``faults`` is a client-scoped chaos schedule."""
+    t0 = time.perf_counter()
+    with contextlib.ExitStack() as stack:
+        if deadline_s:
+            stack.enter_context(G.request_deadline(deadline_s))
+        if faults is not None:
+            stack.enter_context(F.scoped_spec(faults))
+        result = session.cypher(query, parameters or {}, graph=graph)
+        records = result.records
+        rows = records.collect() if records is not None else []
+        columns = list(records.columns) if records is not None else []
+    log = list(result.execution_log)
+    rungs = [e["rung"] for e in log]
+    return {
+        "rows": encode_rows(rows, columns),
+        "columns": columns,
+        "seconds": round(time.perf_counter() - t0, 6),
+        "execution_log": log,
+        "rungs": rungs,
+        "degraded": bool(rungs and rungs[-1] != G.RUNG_DEVICE),
+        "compile_stats": result.compile_stats,
+        "profile": result.profile(execute=False).to_dict(),
+    }
+
+
+# ---------------------------------------------------------------------------
+# framing
+# ---------------------------------------------------------------------------
+
+
+async def send_msg(writer: asyncio.StreamWriter, obj: Dict[str, Any]) -> None:
+    writer.write((json.dumps(obj) + "\n").encode())
+    await writer.drain()
+
+
+async def read_msg(
+    reader: asyncio.StreamReader, timeout: Optional[float] = None
+) -> Dict[str, Any]:
+    """Read one framed message. EOF raises ``asyncio.IncompleteReadError``
+    (an ``EOFError`` — ``errors.classify`` maps it to ``WorkerLost``);
+    a hung peer raises ``TimeoutError`` when ``timeout`` is given."""
+    if timeout is not None:
+        line = await asyncio.wait_for(reader.readline(), timeout)
+    else:
+        line = await reader.readline()
+    if not line:
+        raise asyncio.IncompleteReadError(partial=b"", expected=1)
+    return json.loads(line)
+
+
+async def request(
+    host: str,
+    port: int,
+    msg: Dict[str, Any],
+    timeout: Optional[float] = None,
+) -> Dict[str, Any]:
+    """One connect/ask/read/close round trip against a worker. Transport
+    failures propagate raw (``OSError``/``EOFError``/``TimeoutError``) —
+    the caller decides whether that means ``WorkerLost`` (router) or just
+    an unhealthy probe (supervisor)."""
+    reader, writer = await asyncio.open_connection(host, port)
+    try:
+        await send_msg(writer, msg)
+        return await read_msg(reader, timeout=timeout)
+    finally:
+        writer.close()
+        with contextlib.suppress(Exception):  # fault-ok: teardown only
+            await writer.wait_closed()
+
+
+def raise_wire_error(name: str, message: str) -> None:
+    """Re-raise a worker's ``{"ok": false}`` reply as the engine's typed
+    exception (by taxonomy class name), so the router and clients see the
+    same types a single-process server raises. Unknown names — a planner
+    bug's ValueError, say — surface as ``RuntimeError`` carrying both."""
+    cls = getattr(ERR, name, None)
+    if isinstance(cls, type) and issubclass(cls, ERR.TpuCypherError):
+        raise cls(message)
+    raise RuntimeError(f"{name}: {message}")

@@ -251,6 +251,75 @@ SERVE_TENANT_QUOTA = declare(
     help="max in-flight queries per tenant; 0 = no quota (fair-share only)",
 )
 
+# fault-isolated multi-process serving (serve/cluster.py): a router fans
+# requests out to N supervised engine-worker processes so one libtpu abort
+# never takes down every tenant
+SERVE_WORKERS = declare(
+    "TPU_CYPHER_SERVE_WORKERS",
+    0,
+    int,
+    help="supervised engine-worker processes behind the router; "
+    "0 = single-process in-session serving (PR 6 mode)",
+)
+SERVE_BREAKER_THRESHOLD = declare(
+    "TPU_CYPHER_SERVE_BREAKER_THRESHOLD",
+    3,
+    int,
+    help="consecutive worker failures that open its circuit breaker",
+)
+SERVE_BREAKER_COOLDOWN_S = declare(
+    "TPU_CYPHER_SERVE_BREAKER_COOLDOWN_S",
+    1.0,
+    float,
+    help="seconds an open breaker waits before half-open canary probing",
+)
+SERVE_RESTART_BACKOFF_S = declare(
+    "TPU_CYPHER_SERVE_RESTART_BACKOFF_S",
+    0.25,
+    float,
+    help="initial supervisor restart delay for a crashed worker; doubles "
+    "per consecutive failure",
+)
+SERVE_RESTART_BACKOFF_MAX_S = declare(
+    "TPU_CYPHER_SERVE_RESTART_BACKOFF_MAX_S",
+    5.0,
+    float,
+    help="exponential restart backoff cap (seconds)",
+)
+SERVE_HEALTH_INTERVAL_S = declare(
+    "TPU_CYPHER_SERVE_HEALTH_INTERVAL_S",
+    0.5,
+    float,
+    help="supervisor liveness/readiness probe period (seconds)",
+)
+SERVE_DRAIN_TIMEOUT_S = declare(
+    "TPU_CYPHER_SERVE_DRAIN_TIMEOUT_S",
+    30.0,
+    float,
+    help="graceful-drain budget: in-flight queries finish, new submits "
+    "are rejected typed, workers exit",
+)
+SERVE_HEDGE_MS = declare(
+    "TPU_CYPHER_SERVE_HEDGE_MS",
+    0.0,
+    float,
+    help="hedged-dispatch delay: a read still unanswered after this many "
+    "ms is duplicated to a second replica (first reply wins); 0 = off",
+)
+SERVE_QUEUE_HIGH = declare(
+    "TPU_CYPHER_SERVE_QUEUE_HIGH",
+    0,
+    int,
+    help="admission queue-depth shed watermark: deeper queues reject new "
+    "queries typed before queueing; 0 = off",
+)
+SERVE_RETRY_MAX = declare(
+    "TPU_CYPHER_SERVE_RETRY_MAX",
+    2,
+    int,
+    help="max replica retries of an idempotent read after WorkerLost",
+)
+
 # observability (obs/metrics.py, utils/profiling.py, obs/trace.py)
 METRICS_FILE = declare(
     "TPU_CYPHER_METRICS_FILE",
