@@ -271,6 +271,27 @@ def _lint_clean() -> dict:
         return {"clean": False, "findings_by_rule": {}, "error": str(exc)[:200]}
 
 
+def _shape_facts() -> dict:
+    """The abstract shape interpreter's engine-wide summary
+    (``analysis.shapes``): how many padded-shape facts the sweep emitted
+    and how many sites remain data-dependent (each one a declared
+    exact-size boundary) vs lattice-bounded. A drop in ``bucketed_sites``
+    or a rise in ``data_dependent_sites`` flags a shape-discipline
+    regression in the same JSON line as the perf delta it will cause.
+    Never raises (mirrors ``lint_clean``)."""
+    try:
+        from tpu_cypher.analysis.shapes import engine_shape_summary
+
+        return engine_shape_summary()
+    except Exception as exc:  # fault-ok: telemetry only
+        return {
+            "facts_emitted": 0,
+            "data_dependent_sites": -1,
+            "bucketed_sites": -1,
+            "error": str(exc)[:200],
+        }
+
+
 def _serve_soak() -> dict:
     """Serving-layer health for the trajectory: a short non-chaos soak of
     the multi-tenant query server (tests/soak_serve.py — concurrent
@@ -368,19 +389,38 @@ def _roofline(n: int, e: int, paths: int, dt: float, on_tpu: bool) -> dict:
     return entry
 
 
-def _wcoj_vs_binary(g, feasible_binary: bool) -> dict:
+def _wcoj_vs_binary(
+    g, feasible_binary: bool, est_rows: dict, budget_rows: int
+) -> dict:
     """Triangle + 4-clique counting under the multiway-intersect plan vs
     the binary-join plan, in the same process on the same warm graph. The
     mode override works at PLAN time (``plan_multiway_intersect_fastpath``
     reads ``TPU_CYPHER_WCOJ`` per query), so each leg replans; counts must
-    match bit-identically whenever both legs run."""
+    match bit-identically whenever both legs run.
+
+    Each shape is gated by a host-side transient estimate — the same
+    degrade-to-a-skip-note contract as the distinct rung, because an
+    over-scaled leg OOM-kills the whole JSON line. Triangle's transient
+    is the count-tier expanded-lane total (sum of min end degrees, lean
+    ~40B lanes, so it gets the distinct gate's x8 slack); clique4's is
+    the 3-walk count, because a multi-close count degrades to the
+    acyclic shadow whose intermediate IS that row set with fat
+    sort-buffered rows (measured ~0.7KB/row), so it gets no slack."""
     from tpu_cypher.utils.config import WCOJ_MODE
 
     entry = {}
-    for label, query, key in (
-        ("triangle", TRIANGLE, "triangles"),
-        ("clique4", CLIQUE4, "cliques"),
+    for label, query, key, cap_mult in (
+        ("triangle", TRIANGLE, "triangles", 8),
+        ("clique4", CLIQUE4, "cliques", 1),
     ):
+        est = int(est_rows[label])
+        if est > budget_rows * cap_mult:
+            entry[label] = {
+                "wcoj_seconds": None,
+                "binary_seconds": None,
+                "skipped": f"transient rows {est} over budget",
+            }
+            continue
         WCOJ_MODE.set("force")
         try:
             dtw, outw, tierw = _time_query(g, query, repeats=1)
@@ -456,22 +496,29 @@ def run_config(
     rung["triangles"] = int(out[0]["triangles"])
     rung["tier_triangle"] = tier
 
+    # host walk estimates w_k[v] = number of k-walks from v, by iterated
+    # degree-weighted SpMV: sized both the WCOJ gate and the var-length
+    # source window below
+    w1 = outdeg.astype(np.float64)
+    w2 = np.bincount(s, weights=w1[d], minlength=n) if e else np.zeros(n)
+    w3 = np.bincount(s, weights=w2[d], minlength=n) if e else np.zeros(n)
+
     # WCOJ-vs-binary differential rung: the same cyclic shapes timed under
     # both plans in the same run (the ISSUE-10 / ROADMAP-2 acceptance
-    # measurement). The binary leg is skipped when its transient arrays
-    # would blow the budget — exactly the regime WCOJ exists for.
+    # measurement). Each leg is skipped when its transient arrays would
+    # blow the budget — exactly the regime WCOJ exists for.
+    min_deg_sum = int(np.minimum(outdeg[s], outdeg[d]).sum()) if e else 0
     rung["wcoj_vs_binary"] = _wcoj_vs_binary(
-        g, feasible_binary=two_hop_paths <= budget_rows * 8
+        g,
+        feasible_binary=two_hop_paths <= budget_rows * 8,
+        est_rows={"triangle": min_deg_sum, "clique4": int(w3.sum())},
+        budget_rows=budget_rows,
     )
 
     # var-length: pick a mid-range source-id window (away from the zipf
     # hubs at low ids) sized so the projected <=3-hop walk count stays
     # within budget (walks are genuinely materialized rows — Cypher
-    # edge-uniqueness needs per-path state). Host walk estimate: w_k[v] =
-    # number of k-walks from v, by iterated degree-weighted SpMV.
-    w1 = outdeg.astype(np.float64)
-    w2 = np.bincount(s, weights=w1[d], minlength=n) if e else np.zeros(n)
-    w3 = np.bincount(s, weights=w2[d], minlength=n) if e else np.zeros(n)
+    # edge-uniqueness needs per-path state).
     est = w1 + w2 + w3
     start = n // 2
     cum = np.cumsum(est[start:])
@@ -708,6 +755,9 @@ def main():
         # analyzer health rides the trajectory: False here means a rung ran
         # with unsuppressed invariant violations (tpu_cypher.analysis)
         "lint_clean": _lint_clean(),
+        # the shape interpreter's fact counts: data_dependent_sites up or
+        # bucketed_sites down means a compile-cache-stability regression
+        "shape_facts": _shape_facts(),
         # serving-layer health (multi-tenant query server): qps/p99 of a
         # short concurrent soak + the two regression tripwires
         # (recompiles_after_warmup, batched_dispatch_ratio)

@@ -1,0 +1,27 @@
+"""known-bad: data-dependent static args -> unbounded compile cache."""
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+
+@partial(jax.jit, static_argnames=("size",))
+def sized_gather(mask, size: int):
+    return jnp.nonzero(mask, size=size)[0]
+
+
+@partial(jax.jit, static_argnames=("total",))
+def sized_repeat(vals, counts, total: int):
+    return jnp.repeat(vals, counts, total_repeat_length=total)
+
+
+def unbounded_signatures(mask):
+    n = int(jnp.sum(mask))
+    # an unrounded count as a compile-cache key: unbounded signatures
+    return sized_gather(mask, size=n)
+
+
+def unbounded_positional(vals, counts):
+    t = int(jnp.sum(counts))
+    # same hazard through the positional static arg
+    return sized_repeat(vals, counts, t)

@@ -1,0 +1,23 @@
+"""known-clean: every static arg routes the lattice (bounded signatures)."""
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from backend.tpu import bucketing
+
+
+@partial(jax.jit, static_argnames=("size",))
+def sized_gather(mask, size: int):
+    return jnp.nonzero(mask, size=size)[0]
+
+
+def bounded_signatures(mask, count_dev):
+    # at most one signature per lattice rung
+    n = bucketing.round_size(int(count_dev))
+    return sized_gather(mask, size=n)
+
+
+def literal_signature(mask):
+    # exactly one signature
+    return sized_gather(mask, size=128)

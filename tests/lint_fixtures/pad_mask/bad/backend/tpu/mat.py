@@ -1,0 +1,25 @@
+"""known-bad: bucket-padded arrays reaching pad-sensitive ops unmasked."""
+import jax.numpy as jnp
+
+from backend.tpu import bucketing
+
+
+def unmasked_sum(mask, count_dev):
+    size = bucketing.round_size(int(count_dev))
+    vals = jnp.nonzero(mask, size=size)[0]
+    # pad lanes past the true count pollute the total
+    return jnp.sum(vals)
+
+
+def unmasked_sort(keys_dev, count_dev):
+    size = bucketing.round_size(int(count_dev))
+    padded = jnp.nonzero(keys_dev, size=size)[0]
+    # garbage keys interleave with live rows
+    return jnp.sort(padded)
+
+
+def unmasked_searchsorted(table_dev, probes, count_dev):
+    size = bucketing.round_size(int(count_dev))
+    tbl = jnp.nonzero(table_dev, size=size)[0]
+    # padded keys shift every rank
+    return jnp.searchsorted(tbl, probes)

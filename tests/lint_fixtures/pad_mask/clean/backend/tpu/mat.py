@@ -1,0 +1,33 @@
+"""known-clean: every padded lane is masked before its consumer."""
+import jax.numpy as jnp
+
+from backend.tpu import bucketing
+
+ID_SENTINEL = 1 << 62
+
+
+def masked_sum(mask, count_dev):
+    n = int(count_dev)
+    size = bucketing.round_size(n)
+    vals = jnp.nonzero(mask, size=size)[0]
+    live = jnp.arange(size) < n
+    # the liveness-mask idiom: pads selected to the neutral element
+    return jnp.sum(jnp.where(live, vals, 0))
+
+
+def where_kwarg_sum(mask, count_dev):
+    n = int(count_dev)
+    size = bucketing.round_size(n)
+    vals = jnp.nonzero(mask, size=size)[0]
+    live = jnp.arange(size) < n
+    # the sanctioned in-place form
+    return jnp.sum(vals, where=live)
+
+
+def sentinel_sort(keys_dev, count_dev):
+    n = int(count_dev)
+    size = bucketing.round_size(n)
+    keys = jnp.nonzero(keys_dev, size=size)[0]
+    live = jnp.arange(size) < n
+    # the sorted-pads-last discipline: pads forced to the sentinel
+    return jnp.sort(jnp.where(live, keys, ID_SENTINEL))

@@ -1,0 +1,35 @@
+"""known-bad: data-dependent shapes reaching compile boundaries."""
+import jax
+import jax.numpy as jnp
+
+from backend.tpu import dispatch
+
+
+def data_dependent_size_kwarg(mask):
+    n = int(jnp.sum(mask))
+    # a synced data-dependent count baked into the traced shape: one
+    # compiled program per distinct n
+    return jnp.nonzero(mask, size=n)[0]
+
+
+@jax.jit
+def unsized_nonzero_under_jit(mask):
+    # value-dependent output extent inside jit: cannot trace
+    return jnp.nonzero(mask)[0]
+
+
+@jax.jit
+def _consume(x):
+    return jnp.sum(x)
+
+
+def data_array_into_jit(mask):
+    idx = jnp.nonzero(mask)[0]
+    # data-dependent leading dim traced into a jit boundary
+    return _consume(idx)
+
+
+def data_array_into_launch(mask):
+    idx = jnp.nonzero(mask)[0]
+    # data-dependent leading dim crossing the kernel dispatch boundary
+    return dispatch.launch("intersect", idx)

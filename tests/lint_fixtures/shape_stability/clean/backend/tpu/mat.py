@@ -1,0 +1,33 @@
+"""known-clean: bucketed or static extents at every compile boundary."""
+import jax
+import jax.numpy as jnp
+
+from backend.tpu import bucketing
+from backend.tpu import dispatch
+
+
+def bucketed_size_kwarg(mask, count_dev):
+    n = bucketing.round_size(int(count_dev))
+    return jnp.nonzero(mask, size=n)[0]
+
+
+def unsized_outside_jit(mask):
+    # host-side exact compaction: legal outside a jit boundary
+    return jnp.nonzero(mask)[0]
+
+
+@jax.jit
+def _consume(x):
+    return jnp.sum(x)
+
+
+def bucketed_array_into_jit(mask, count_dev):
+    size = bucketing.round_size(int(count_dev))
+    idx = jnp.nonzero(mask, size=size)[0]
+    return _consume(idx)
+
+
+def bucketed_array_into_launch(mask, count_dev):
+    size = bucketing.round_size(int(count_dev))
+    idx = jnp.nonzero(mask, size=size)[0]
+    return dispatch.launch("intersect", idx)

@@ -37,7 +37,12 @@ RULE_FIXTURES = {
     "async-blocking": "async_blocking",
     "contextvar-discipline": "contextvar_discipline",
     "shared-state-race": "shared_state_race",
+    "shape-stability": "shape_stability",
+    "pad-mask-discipline": "pad_mask",
+    "bucket-cardinality": "bucket_cardinality",
 }
+
+SHAPE_RULES = ("shape-stability", "pad-mask-discipline", "bucket-cardinality")
 
 
 def _run_fixture(rule_id: str, which: str):
@@ -585,3 +590,83 @@ def test_shared_state_race_process_spawn_lane():
     ]
     assert spawn_hits, "Process(target=self.bump) did not register as a lane"
     assert any("bump" in f.message for f in spawn_hits), spawn_hits
+
+
+# ---------------------------------------------------------------------------
+# the shape pack (PR 12): tier-1 shape-clean gate, perf bound, cache
+# surface, and the pre-commit hook
+# ---------------------------------------------------------------------------
+
+
+def test_engine_is_shape_clean():
+    """the 3 shape rules alone find nothing in the engine with the
+    committed (empty) baseline — compile-cache stability and pad-mask
+    discipline are proven, not aspirational"""
+    report = analysis.check_engine(rules=list(SHAPE_RULES))
+    assert report.clean, report.render_text()
+    # ...and none of that cleanliness is bought with suppressions: every
+    # shape-rule true positive was fixed structurally
+    for entry in report.suppression_entries:
+        assert not set(entry["rules"]) & set(SHAPE_RULES), (
+            f"shape rule suppressed at {entry['path']}:{entry['line']} — "
+            "fix the site structurally instead"
+        )
+
+
+def test_full_engine_run_under_time_bound():
+    """perf regression gate: all 12 rules (9 legacy + 3 shape, with the
+    interprocedural shape fixpoint) cold over the whole engine. The
+    standalone budget is 5s (measured ~4.1s; the analyzer CLI and bench
+    hold that); inside the full tier-1 suite the same run measures
+    ~1.7x slower from process load, so the gate asserts 10s — loose
+    enough to ignore scheduler noise, tight enough to catch the
+    quadratic-blowup class of regression (a missing memo/cache shows up
+    as 10s+ immediately at 128 files x 1900 functions)."""
+    import time
+
+    from tpu_cypher.analysis import runner, shapes
+
+    runner._PARSE_CACHE.clear()
+    shapes._SUMMARY_CACHE.clear()
+    t0 = time.monotonic()
+    report = analysis.check_engine()
+    elapsed = time.monotonic() - t0
+    assert report.clean
+    assert elapsed < 10.0, f"cold 12-rule engine run took {elapsed:.2f}s"
+
+
+def test_report_surfaces_cache_stats():
+    """parse-cache and shape-summary-cache hit counts ride on the report;
+    a warm in-process rerun is all hits"""
+    r1 = analysis.check_engine()
+    assert set(r1.cache_stats) == {
+        "parse_hits", "parse_misses", "summary_hits", "summary_misses",
+    }
+    r2 = analysis.check_engine()
+    stats = r2.cache_stats
+    assert stats["parse_misses"] == 0 and stats["parse_hits"] > 80
+    assert stats["summary_hits"] == 1 and stats["summary_misses"] == 0
+
+
+def test_json_output_carries_cache_stats():
+    proc = _cli("--format", "json")
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    payload = json.loads(proc.stdout)
+    caches = payload["caches"]
+    assert set(caches) == {
+        "parse_hits", "parse_misses", "summary_hits", "summary_misses",
+    }
+    # a fresh process starts cold: everything is a miss
+    assert caches["parse_misses"] > 80 and caches["parse_hits"] == 0
+    assert caches["summary_misses"] == 1 and caches["summary_hits"] == 0
+
+
+def test_precommit_hook_runs_changed_only_lint():
+    """scripts/precommit-lint exists, is executable, and drives the
+    analyzer in --changed-only mode (the cheap pre-commit path)"""
+    hook = os.path.join(REPO, "scripts", "precommit-lint")
+    assert os.path.isfile(hook), "scripts/precommit-lint is missing"
+    assert os.access(hook, os.X_OK), "scripts/precommit-lint not executable"
+    with open(hook) as f:
+        body = f.read()
+    assert "tpu_cypher.analysis" in body and "--changed-only" in body

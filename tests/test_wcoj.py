@@ -464,7 +464,10 @@ def test_bench_wcoj_vs_binary_rung():
     g = CypherSession.tpu().create_graph_from_create_query(
         "CREATE " + ", ".join(parts)
     )
-    out = bench._wcoj_vs_binary(g, feasible_binary=True)
+    tiny = {"triangle": e, "clique4": e}
+    out = bench._wcoj_vs_binary(
+        g, feasible_binary=True, est_rows=tiny, budget_rows=1_000_000
+    )
     for leg in ("triangle", "clique4"):
         entry = out[leg]
         assert entry["counts_match"] is True, entry
@@ -474,6 +477,30 @@ def test_bench_wcoj_vs_binary_rung():
         # force leg answers from a wcoj tier, the off leg never touches one
         assert "wcoj" in entry["wcoj_tier"], entry
         assert "wcoj" not in entry["binary_tier"], entry
-    skipped = bench._wcoj_vs_binary(g, feasible_binary=False)
+    skipped = bench._wcoj_vs_binary(
+        g, feasible_binary=False, est_rows=tiny, budget_rows=1_000_000
+    )
     assert skipped["triangle"]["binary_skipped"]
     assert skipped["triangle"]["count"] == out["triangle"]["count"]
+    # the per-shape transient gate: an over-budget estimate degrades the
+    # whole leg to a skip note (the clique4 force leg was the OOM that
+    # killed every bench round since r04)
+    gated = bench._wcoj_vs_binary(
+        g,
+        feasible_binary=True,
+        est_rows={"triangle": e, "clique4": 10_000_001},
+        budget_rows=1_000_000,
+    )
+    assert gated["triangle"]["counts_match"] is True
+    assert gated["clique4"]["wcoj_seconds"] is None
+    assert gated["clique4"]["binary_seconds"] is None
+    assert "over budget" in gated["clique4"]["skipped"]
+    # triangle's lean count-tier lanes get x8 slack; clique4 gets none
+    near = bench._wcoj_vs_binary(
+        g,
+        feasible_binary=True,
+        est_rows={"triangle": 3_000_000, "clique4": 3_000_000},
+        budget_rows=1_000_000,
+    )
+    assert near["triangle"]["wcoj_seconds"] > 0
+    assert near["clique4"]["wcoj_seconds"] is None

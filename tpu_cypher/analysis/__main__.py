@@ -63,6 +63,14 @@ def build_parser() -> argparse.ArgumentParser:
         help="print the rule table and exit",
     )
     p.add_argument(
+        "--facts-out",
+        default="",
+        metavar="PATH",
+        help="write the shape interpreter's facts (schema-versioned "
+        "per-operator padded-shape formulas plus every classified size "
+        "site) as JSON to PATH — the cost-model feedstock",
+    )
+    p.add_argument(
         "--changed-only",
         action="store_true",
         help="check only files git reports modified/untracked; the whole "
@@ -149,6 +157,16 @@ def main(argv: Optional[List[str]] = None) -> int:
             f"wrote {len(report.blocking)} finding(s) to {baseline}"
         )
         return 0
+
+    if args.facts_out:
+        import json as _json
+
+        from .shapes import collect_facts
+
+        facts = collect_facts(report.project)
+        with open(args.facts_out, "w") as f:
+            _json.dump(facts, f, indent=2, sort_keys=True)
+            f.write("\n")
 
     print(format_report(report, args.format))
     return 0 if report.clean else 1

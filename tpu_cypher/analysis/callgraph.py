@@ -318,7 +318,22 @@ class CallGraph:
         self, ctx: FileContext, call: ast.Call
     ) -> Tuple[FunctionInfo, ...]:
         """Every project function this call site can enter (empty = UNKNOWN,
-        never 'safe')."""
+        never 'safe'). Memoized per call node — the graph is immutable once
+        built and several passes (taint, blocking, shapes) resolve the same
+        sites."""
+        memo = getattr(self, "_resolve_memo", None)
+        if memo is None:
+            memo = self._resolve_memo = {}
+        hit = memo.get(call)
+        if hit is not None:
+            return hit
+        out = self._resolve_call_uncached(ctx, call)
+        memo[call] = out
+        return out
+
+    def _resolve_call_uncached(
+        self, ctx: FileContext, call: ast.Call
+    ) -> Tuple[FunctionInfo, ...]:
         mod = self.modules.get(module_path(ctx.relpath))
         if mod is None:
             return ()

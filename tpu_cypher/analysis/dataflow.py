@@ -93,6 +93,26 @@ class DeviceTaint:
         self.graph = graph
         self.returns: Dict[ast.AST, Summary] = {}
         self.params: Dict[Tuple[ast.AST, str], str] = {}
+        # per-round inputs precomputed once: re-walking every function AST
+        # and re-resolving every call site each fixpoint round dominated
+        # the analyzer's runtime before being hoisted here
+        self._returns_of: Dict[ast.AST, List[ast.AST]] = {}
+        self._callee_sites: Dict[ast.AST, list] = {}
+        for info in graph.infos.values():
+            self._returns_of[info.node] = [
+                n.value
+                for n in ast.walk(info.node)
+                if isinstance(n, ast.Return)
+                and n.value is not None
+                and info.ctx.enclosing_function(n) is info.node
+            ]
+            sites = [
+                (site, targets)
+                for site, targets in graph.callees(info)
+                if targets
+            ]
+            if sites:
+                self._callee_sites[info.node] = sites
         self._solve()
 
     # -- public -------------------------------------------------------------
@@ -128,12 +148,8 @@ class DeviceTaint:
         ctx, fn = info.ctx, info.node
         verdicts: List[str] = []
         passthrough: Set[str] = set()
-        for node in ast.walk(fn):
-            if not isinstance(node, ast.Return) or node.value is None:
-                continue
-            if ctx.enclosing_function(node) is not fn:
-                continue  # a nested def's return is not ours
-            v = self._classify(ctx, fn, node.value, 0, symbolic=True)
+        for ret in self._returns_of.get(fn, ()):
+            v = self._classify(ctx, fn, ret, 0, symbolic=True)
             if isinstance(v, tuple) and v[0] == "param":
                 passthrough.add(v[1])
             else:
@@ -155,9 +171,7 @@ class DeviceTaint:
         call site. A parameter nobody is seen calling stays UNKNOWN."""
         incoming: Dict[Tuple[ast.AST, str], List[str]] = {}
         for info in infos:
-            for site, targets in self.graph.callees(info):
-                if not targets:
-                    continue
+            for site, targets in self._callee_sites.get(info.node, ()):
                 arg_taints = [
                     self._arg_taint(site.ctx, info.node, a)
                     for a in site.call.args

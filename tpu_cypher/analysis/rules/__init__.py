@@ -1,4 +1,4 @@
-"""The rule registry: nine engine-grounded invariants, one shared pass.
+"""The rule registry: twelve engine-grounded invariants, one shared pass.
 
 Adding a rule = subclass ``core.Rule``, give it a kebab-case ``id``, and
 list an instance here. Rules are documented (id, rationale, fixture pair)
@@ -7,8 +7,11 @@ known-clean fixture under ``tests/lint_fixtures/``.
 
 Six rules are per-file; ``host-sync`` and the concurrency pack
 (``async-blocking``, ``contextvar-discipline``, ``shared-state-race``)
-additionally consume the interprocedural substrate (``callgraph.py`` /
-``dataflow.py``) the ``ProjectContext`` builds lazily on first use.
+consume the interprocedural substrate (``callgraph.py`` / ``dataflow.py``)
+the ``ProjectContext`` builds lazily on first use; the shape pack
+(``shape-stability``, ``pad-mask-discipline``, ``bucket-cardinality``)
+rides the abstract shape interpreter (``shapes.py``) on the same call
+graph — the semantic generation above the lexical pad/recompile rules.
 """
 
 from __future__ import annotations
@@ -17,13 +20,16 @@ from typing import Dict, List
 
 from ..core import Rule
 from .async_blocking import AsyncBlockingRule
+from .bucket_cardinality import BucketCardinalityRule
 from .contextvar_discipline import ContextvarDisciplineRule
 from .env_registry import EnvVarRegistryRule
 from .exception_hygiene import ExceptionHygieneRule
 from .host_sync import HostSyncRule
 from .obs_emission import ObsEmissionRule
 from .pad_invariant import PadInvariantRule
+from .pad_mask import PadMaskRule
 from .recompile import RecompileHazardRule
+from .shape_stability import ShapeStabilityRule
 from .shared_state_race import SharedStateRaceRule
 
 ALL_RULES: List[Rule] = [
@@ -36,6 +42,9 @@ ALL_RULES: List[Rule] = [
     AsyncBlockingRule(),
     ContextvarDisciplineRule(),
     SharedStateRaceRule(),
+    ShapeStabilityRule(),
+    PadMaskRule(),
+    BucketCardinalityRule(),
 ]
 
 RULES_BY_ID: Dict[str, Rule] = {r.id: r for r in ALL_RULES}

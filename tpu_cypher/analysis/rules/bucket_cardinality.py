@@ -1,0 +1,77 @@
+"""bucket-cardinality: every jitted call site needs a static bound on
+its distinct bucket signatures.
+
+Each distinct value of a ``static_argnames`` parameter at a jitted call
+site is one entry in the compile cache. The abstract shape interpreter
+gives every size expression a cardinality bound: STATIC takes exactly one
+value, BUCKETED takes at most the lattice's rung count
+(``shapes.BUCKET_BOUNDS``), DATA_DEPENDENT is unbounded — an unrounded
+count threaded into a static argument grows the compile cache without
+limit, which is this rule's finding. UNKNOWN makes no claim and never
+fires.
+
+The per-site bounds (including the bounded ones) are exported through
+``--facts-out`` as the compile-cache-growth facts the cost model
+consumes. Lines carrying an ``allow[pad-invariant]`` suppression are
+declared exact-size sites and stay out of scope here too.
+"""
+
+from __future__ import annotations
+
+from typing import Iterator
+
+from ..core import FileContext, Finding, Rule, dotted_name
+from .. import shapes as S
+
+
+class BucketCardinalityRule(Rule):
+    id = "bucket-cardinality"
+    title = "unbounded bucket signatures at a jitted call site"
+    rationale = (
+        "A static_argnames parameter keys the compile cache: a "
+        "data-dependent (unrounded) value there admits unboundedly many "
+        "signatures — compile-cache growth proportional to distinct "
+        "runtime counts. Round through the bucket lattice to cap it at "
+        "the lattice's rung count."
+    )
+
+    def check(self, ctx: FileContext, project) -> Iterator[Finding]:
+        if not S.in_scope(ctx.relpath):
+            return
+        ana = project.shapes
+        graph = project.callgraph
+        for call in ctx.calls:
+            line = getattr(call, "lineno", 0)
+            if ctx.allowed(line, "pad-invariant") is not None:
+                continue  # declared exact-size site
+            fn = ctx.enclosing_function(call)
+            for tgt in graph.resolve_call(ctx, call):
+                if not tgt.ctx.is_jitted(tgt.node):
+                    continue
+                statics = S.jit_static_argnames(tgt.node)
+                if not statics:
+                    continue
+                names = tgt.ctx.param_names(tgt.node)
+                if names and names[0] == "self":
+                    names = names[1:]
+                bound_exprs = []
+                for i, arg in enumerate(call.args):
+                    if i < len(names) and names[i] in statics:
+                        bound_exprs.append((names[i], arg))
+                for kw in call.keywords:
+                    if kw.arg in statics:
+                        bound_exprs.append((kw.arg, kw.value))
+                for pname, expr in bound_exprs:
+                    v = ana.classify_size(ctx, fn, expr)
+                    if v.kind == S.DATA_KIND:
+                        yield ctx.finding(
+                            self.id,
+                            expr,
+                            f"static arg {pname}= of jitted "
+                            f"{tgt.qualname}() is data-dependent "
+                            f"({v.render()}): unbounded bucket signatures "
+                            f"at this call site. Round via "
+                            f"bucketing.round_size to bound the compile "
+                            f"cache.",
+                        )
+                break  # one jitted target's signature view per call

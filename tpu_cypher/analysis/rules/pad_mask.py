@@ -1,0 +1,112 @@
+"""pad-mask-discipline: bucketed arrays must be masked before
+pad-sensitive consumers.
+
+The semantic version of docs/pad-invariants.md: once an extent rounds the
+bucket lattice, lanes past the true count hold garbage, and any
+*pad-sensitive* consumer — a reduction (pads pollute the total), a sort
+(pads interleave with live keys unless forced last via the ID_SENTINEL
+discipline), or a ``searchsorted`` over the padded table (pads shift
+every rank) — must see the array only after a mask against the true
+count. The interpreter tracks that proof as the ``masked`` bit on the
+BUCKETED lattice point: a 3-arg ``jnp.where`` selection, a comparison
+against an ``arange`` iota (the ``_live_lanes`` idiom), or multiplication
+/ conjunction with an already-masked mask all establish it; ``jnp.pad``,
+``cumsum``, gathers, and boolean negation forfeit it.
+
+A ``where=`` (or ``initial=``) kwarg on the reduction itself is the
+sanctioned in-place form. Lines carrying an ``allow[pad-invariant]``
+suppression are declared exact-size sites — nothing there is padded.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from ..core import FileContext, Finding, Rule, dotted_name
+from .. import shapes as S
+
+_SCOPE = ("backend/tpu/", "parallel/")
+
+# reductions whose result a single garbage lane corrupts
+_REDUCERS = S._REDUCERS
+_SORTS = S._SORTS
+
+
+class PadMaskRule(Rule):
+    id = "pad-mask-discipline"
+    title = "bucketed array reaches a pad-sensitive op unmasked"
+    rationale = (
+        "Past the true count, a bucket-padded array holds garbage lanes. "
+        "A reduction, sort, or searchsorted that consumes it without a "
+        "mask against the true count (jnp.where against a liveness mask, "
+        "an arange-vs-count comparison, or the where= kwarg) computes "
+        "over that garbage."
+    )
+
+    def check(self, ctx: FileContext, project) -> Iterator[Finding]:
+        if not any(d in ctx.relpath for d in _SCOPE):
+            return
+        if not S.in_scope(ctx.relpath):
+            return
+        ana = project.shapes
+        for call in ctx.calls:
+            line = getattr(call, "lineno", 0)
+            if ctx.allowed(line, "pad-invariant") is not None:
+                continue  # declared exact-size site
+            name = dotted_name(call.func)
+            if not name.startswith(S._DEVICE_PREFIXES):
+                continue
+            leaf = name.split(".")[-1]
+            fn = ctx.enclosing_function(call)
+
+            if leaf in _REDUCERS:
+                if any(kw.arg in ("where", "initial") for kw in call.keywords):
+                    continue  # sanctioned in-place mask
+                if not call.args:
+                    continue
+                v = ana.classify_array(ctx, fn, call.args[0])
+                if v.kind == S.BUCKETED_KIND and not v.masked:
+                    yield ctx.finding(
+                        self.id,
+                        call,
+                        f"{name} reduces a bucket-padded array "
+                        f"({v.render()}) with no mask against its true "
+                        f"count: pad lanes pollute the result. Mask via "
+                        f"jnp.where(live, x, neutral) or pass where=.",
+                    )
+            elif leaf in _SORTS:
+                ops = (
+                    call.args[0].elts
+                    if (
+                        leaf == "lexsort"
+                        and call.args
+                        and isinstance(call.args[0], (ast.Tuple, ast.List))
+                    )
+                    else call.args[:1]
+                )
+                for op_expr in ops:
+                    v = ana.classify_array(ctx, fn, op_expr)
+                    if v.kind == S.BUCKETED_KIND and not v.masked:
+                        yield ctx.finding(
+                            self.id,
+                            call,
+                            f"{name} sorts a bucket-padded array "
+                            f"({v.render()}) whose pad lanes are not "
+                            f"forced last: garbage keys interleave with "
+                            f"live rows. Apply the ID_SENTINEL discipline "
+                            f"(where(live, keys, sentinel)) first.",
+                        )
+                        break
+            elif leaf == "searchsorted" and call.args:
+                v = ana.classify_array(ctx, fn, call.args[0])
+                if v.kind == S.BUCKETED_KIND and not v.masked:
+                    yield ctx.finding(
+                        self.id,
+                        call,
+                        f"{name} searches a bucket-padded table "
+                        f"({v.render()}) whose pad lanes were never "
+                        f"masked to the sentinel: padded keys shift every "
+                        f"rank. Mask pads to ID_SENTINEL before building "
+                        f"the sorted table.",
+                    )

@@ -1,0 +1,136 @@
+"""shape-stability: no DATA_DEPENDENT shape may reach a compile boundary.
+
+The semantic upgrade of the lexical ``pad-invariant``/``recompile-hazard``
+pair: the abstract shape interpreter (``analysis.shapes``) classifies
+every size expression, and this rule fires where a provably
+data-dependent extent reaches a point that bakes it into an XLA program —
+a sized-materialize kwarg (``size=``, ``total_repeat_length=``,
+``num_segments=``), an unsized value-dependent materialize inside a
+jitted function (a guaranteed trace error or silent full-length fallback),
+or an array whose leading dim is data-dependent flowing into a
+``pl.pallas_call`` / ``dispatch.launch`` boundary. Each such site means
+one fresh compile per distinct runtime count: the recompile storm the
+bucket lattice exists to prevent.
+
+Lines carrying an existing ``allow[pad-invariant]`` suppression are
+declared exact-size sites (the compact primitive itself, the ladder's
+bucket-exact rung); the semantic rules honor those declarations rather
+than re-litigating them.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from ..core import FileContext, Finding, Rule, dotted_name
+from .. import shapes as S
+
+_UNSIZED_VALUE_DEP = ("nonzero", "unique")
+_BOUNDARY_LEAVES = ("pallas_call",)
+_BOUNDARY_DOTTED = ("dispatch.launch",)
+
+
+def _declared_exact(ctx: FileContext, line: int) -> bool:
+    return ctx.allowed(line, "pad-invariant") is not None
+
+
+class ShapeStabilityRule(Rule):
+    id = "shape-stability"
+    title = "data-dependent shape reaches a compile boundary"
+    rationale = (
+        "An extent the interpreter proves data-dependent (a synced "
+        "reduction, an unsized nonzero) that reaches a jit boundary, a "
+        "sized-materialize kwarg, or a pallas_call compiles one program "
+        "per distinct runtime value. Route it through bucketing.round_size "
+        "so the compile cache stays warm."
+    )
+
+    def check(self, ctx: FileContext, project) -> Iterator[Finding]:
+        if not S.in_scope(ctx.relpath):
+            return
+        ana = project.shapes
+        graph = project.callgraph
+        for call in ctx.calls:
+            line = getattr(call, "lineno", 0)
+            if _declared_exact(ctx, line):
+                continue
+            fn = ctx.enclosing_function(call)
+            name = dotted_name(call.func)
+            leaf = name.split(".")[-1] if name else ""
+            device = name.startswith(S._DEVICE_PREFIXES)
+
+            # (a) a sized-materialize kwarg fed a data-dependent count
+            if device:
+                for kw in call.keywords:
+                    if kw.arg not in S.SIZE_KWARGS:
+                        continue
+                    v = ana.classify_size(ctx, fn, kw.value)
+                    if v.kind == S.DATA_KIND:
+                        yield ctx.finding(
+                            self.id,
+                            kw.value,
+                            f"{name}({kw.arg}=...) receives a "
+                            f"data-dependent count ({v.render()}): one "
+                            f"compile per distinct value. Round it via "
+                            f"bucketing.round_size first.",
+                        )
+
+                # (b) an unsized value-dependent materialize under jit
+                if (
+                    leaf in _UNSIZED_VALUE_DEP
+                    and not any(kw.arg in S.SIZE_KWARGS for kw in call.keywords)
+                    and fn is not None
+                    and ctx.is_jitted(fn)
+                ):
+                    yield ctx.finding(
+                        self.id,
+                        call,
+                        f"unsized {name} inside a jitted function: the "
+                        f"result extent is data-dependent, which cannot "
+                        f"trace. Pass size= (bucketed) or hoist out of jit.",
+                    )
+
+            # (c) a data-dependent array shape crossing a kernel boundary
+            if leaf in _BOUNDARY_LEAVES or any(
+                name.endswith(d) for d in _BOUNDARY_DOTTED
+            ):
+                for arg in call.args:
+                    v = ana.classify_array(ctx, fn, arg)
+                    if v.kind == S.DATA_KIND:
+                        yield ctx.finding(
+                            self.id,
+                            arg,
+                            f"array with data-dependent leading dim "
+                            f"({v.render()}) crosses the {name} boundary: "
+                            f"every distinct extent compiles a fresh "
+                            f"kernel. Pad to the bucket lattice first.",
+                        )
+                continue
+
+            # (c') a data-dependent array traced into a project jit boundary
+            targets = graph.resolve_call(ctx, call)
+            jitted = [t for t in targets if t.ctx.is_jitted(t.node)]
+            if not jitted:
+                continue
+            for tgt in jitted:
+                statics = S.jit_static_argnames(tgt.node)
+                names = tgt.ctx.param_names(tgt.node)
+                if names and names[0] == "self":
+                    names = names[1:]
+                for i, arg in enumerate(call.args):
+                    pname = names[i] if i < len(names) else ""
+                    if pname in statics:
+                        continue  # static args are bucket-cardinality's beat
+                    v = ana.classify_array(ctx, fn, arg)
+                    if v.kind == S.DATA_KIND:
+                        yield ctx.finding(
+                            self.id,
+                            arg,
+                            f"array with data-dependent leading dim "
+                            f"({v.render()}) traced into jitted "
+                            f"{tgt.qualname}(): one compile per distinct "
+                            f"extent. Pad to the bucket lattice before "
+                            f"the boundary.",
+                        )
+                break  # one target's param view is enough per call

@@ -53,6 +53,11 @@ class Report:
     suppression_entries: List[Dict[str, object]] = field(default_factory=list)
     files_checked: int = 0
     rules_run: int = 0
+    # per-run cache traffic: parse cache + shape summary cache hit/miss
+    cache_stats: Dict[str, int] = field(default_factory=dict)
+    # the ProjectContext the run was checked against (not serialized):
+    # what --facts-out hands to shapes.collect_facts
+    project: Optional[object] = None
 
     @property
     def clean(self) -> bool:
@@ -80,6 +85,7 @@ class Report:
                 "schema_version": SUPPRESSION_SCHEMA_VERSION,
                 "entries": self.suppression_entries,
             },
+            "caches": dict(self.cache_stats),
         }
 
     def render_text(self) -> str:
@@ -128,10 +134,12 @@ def _relpath(path: str) -> str:
     return chosen.replace(os.path.sep, "/")
 
 
-def _load_context(path: str, rel: str) -> FileContext:
+def _load_context(path: str, rel: str) -> Tuple[FileContext, bool]:
     """Parse ``path`` into a FileContext, reusing the process-wide cache
     when (mtime_ns, size, relpath) are unchanged. FileContext is immutable
-    after construction, so sharing one across runs is safe."""
+    after construction, so sharing one across runs is safe. Returns
+    ``(ctx, cache_hit)`` so the caller can report per-run cache traffic
+    without module-level counters."""
     a = os.path.abspath(path)
     try:
         st = os.stat(a)
@@ -141,13 +149,13 @@ def _load_context(path: str, rel: str) -> FileContext:
     if key is not None:
         hit = _PARSE_CACHE.get(a)
         if hit is not None and hit[0] == key:
-            return hit[1]
+            return hit[1], True
     with open(path, "r") as f:
         source = f.read()
     ctx = FileContext(path, rel, source)
     if key is not None:
         _PARSE_CACHE[a] = (key, ctx)
-    return ctx
+    return ctx, False
 
 
 def run_paths(
@@ -169,6 +177,7 @@ def run_paths(
     )
     active_ids = {r.id for r in active}
     report = Report(rules_run=len(active))
+    parse_hits = parse_misses = 0
 
     restrict = (
         None
@@ -181,7 +190,9 @@ def run_paths(
         rel = _relpath(path)
         in_scope = restrict is None or os.path.abspath(path) in restrict
         try:
-            ctx = _load_context(path, rel)
+            ctx, was_hit = _load_context(path, rel)
+            parse_hits += 1 if was_hit else 0
+            parse_misses += 0 if was_hit else 1
         except (SyntaxError, UnicodeDecodeError, OSError) as exc:
             if in_scope:
                 report.blocking.append(
@@ -274,6 +285,17 @@ def run_paths(
     report.blocking.sort(
         key=lambda f: (f.path, f.line, f.col, f.rule, f.message)
     )
+    # shape-summary cache traffic: at most one lookup per run (the lazy
+    # ProjectContext.shapes property records whether it hit)
+    built = project._shapes is not None
+    hit = bool(getattr(project, "shape_summary_cache_hit", False))
+    report.cache_stats = {
+        "parse_hits": parse_hits,
+        "parse_misses": parse_misses,
+        "summary_hits": 1 if (built and hit) else 0,
+        "summary_misses": 1 if (built and not hit) else 0,
+    }
+    report.project = project
     return report
 
 

@@ -406,13 +406,14 @@ class MultiwayIntersectOp(_FusedExpandBase):
         bucketing.admit(
             n_out, 32 + 9 * max(len(self.header.expressions), 1), "intersect"
         )
-        if bucketed:
-            size2 = bucketing.round_size(n_out)
-            lane, orig_c, _ = J.into_materialize_counted(
-                close.eo, lo, m, out_dev, size=size2
-            )
-        else:
-            lane, orig_c = J.into_materialize(close.eo, lo, m, total=n_out)
+        # one materialize for both modes: with bucketing off, round_size is
+        # the identity and the live mask degenerates to all-True, so the
+        # counted path IS the exact path — and the size always routes
+        # through the lattice
+        size2 = bucketing.round_size(n_out)
+        lane, orig_c, _ = J.into_materialize_counted(
+            close.eo, lo, m, out_dev, size=size2
+        )
         in_row, cand2, orig_p2 = J.tree_take((row, cand, orig_p), lane)
         if self.enforced_pairs and n_out:
             # same compaction discipline as _apply_enforced_pairs (two own

@@ -75,12 +75,26 @@ class Span:
     def count(self, key: str, amount: int = 1) -> None:
         self.attrs[key] = self.attrs.get(key, 0) + amount
 
+    # per-span cap on retained (true, padded) pairs: enough for every
+    # rounding a single operator performs, bounded against pathological
+    # loops so a span never grows without limit
+    ROWS_PAIRS_CAP = 64
+
     def add_rows(self, true_rows: int, padded_rows: int) -> None:
-        """Accumulate a padded-vs-true row count from the bucket lattice."""
+        """Accumulate a padded-vs-true row count from the bucket lattice.
+
+        Besides the running sums, the individual ``(true, padded)`` pairs
+        are retained (bounded) so static shape predictions
+        (``analysis.shapes.predict_padded``) can be checked against what
+        the lattice actually produced, per rounding, not just in
+        aggregate."""
         self.attrs["rows_true"] = self.attrs.get("rows_true", 0) + int(true_rows)
         self.attrs["rows_padded"] = (
             self.attrs.get("rows_padded", 0) + int(padded_rows)
         )
+        pairs = self.attrs.setdefault("rows_pairs", [])
+        if len(pairs) < self.ROWS_PAIRS_CAP:
+            pairs.append([int(true_rows), int(padded_rows)])
 
     def to_dict(self) -> Dict[str, Any]:
         out: Dict[str, Any] = {
