@@ -1,0 +1,42 @@
+"""known-bad: shape disciplines violated INSIDE shard_map kernel bodies.
+
+The per-shard program a ``shard_map`` factory closes over is a compile
+boundary like any other: the rules must look through the nesting and
+judge the kernel body itself, not just top-level functions.
+"""
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from backend.tpu import bucketing
+
+
+def unmasked_partial_sum(mesh, shard_map, count_dev):
+    def kernel(mask):
+        size = bucketing.round_size(int(count_dev))
+        vals = jnp.nonzero(mask, size=size)[0]
+        # pad lanes of the local shard ride the collective combine
+        local = jnp.sum(vals)
+        return lax.psum(local, "rows")
+
+    return jax.jit(shard_map(kernel, mesh))
+
+
+def unmasked_shard_sort(mesh, shard_map, count_dev):
+    def kernel(keys_dev):
+        size = bucketing.round_size(int(count_dev))
+        keys = jnp.nonzero(keys_dev, size=size)[0]
+        # garbage lanes interleave with live rows before the exchange
+        return jnp.sort(keys)
+
+    return jax.jit(shard_map(kernel, mesh))
+
+
+def data_dependent_local_extent(mesh, shard_map):
+    def kernel(mask):
+        n = int(jnp.sum(mask))
+        # a synced per-shard count baked into the traced shape: one
+        # compiled collective program per distinct local cardinality
+        return jnp.nonzero(mask, size=n)[0]
+
+    return jax.jit(shard_map(kernel, mesh))

@@ -1,0 +1,48 @@
+"""known-clean: shard_map kernel bodies holding the shape disciplines.
+
+Mirrors the real per-shard programs (``parallel/agg.py`` partials,
+``parallel/shuffle.py`` exchanges): per-shard extents round the bucket
+lattice, pad lanes are masked to the combine identity before any
+reduction, and sort keys force pads last via the sentinel discipline.
+"""
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from backend.tpu import bucketing
+
+ID_SENTINEL = 1 << 62
+
+
+def masked_partial_sum(mesh, shard_map, count_dev):
+    def kernel(mask):
+        n = int(count_dev)
+        size = bucketing.round_size(n)
+        vals = jnp.nonzero(mask, size=size)[0]
+        live = jnp.arange(size) < n
+        # pads contribute the combine identity to the psum
+        local = jnp.sum(jnp.where(live, vals, 0))
+        return lax.psum(local, "rows")
+
+    return jax.jit(shard_map(kernel, mesh))
+
+
+def sentinel_shard_sort(mesh, shard_map, count_dev):
+    def kernel(keys_dev):
+        n = int(count_dev)
+        size = bucketing.round_size(n)
+        keys = jnp.nonzero(keys_dev, size=size)[0]
+        live = jnp.arange(size) < n
+        # sorted-pads-last before the all_to_all exchange
+        return jnp.sort(jnp.where(live, keys, ID_SENTINEL))
+
+    return jax.jit(shard_map(kernel, mesh))
+
+
+def bucketed_local_extent(mesh, shard_map, count_dev):
+    def kernel(mask):
+        size = bucketing.round_size(int(count_dev))
+        # the per-shard extent is a lattice point: one program total
+        return jnp.nonzero(mask, size=size)[0]
+
+    return jax.jit(shard_map(kernel, mesh))

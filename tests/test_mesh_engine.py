@@ -271,6 +271,166 @@ def test_sharded_programs_emit_xla_collectives(meshed):
     assert any(k in txt2 for k in _COLLECTIVES), "no collective in join HLO"
 
 
+# ---------------------------------------------------------------------------
+# ISSUE 13 tiers: per-shard partial aggregates, hash-repartition DISTINCT,
+# and the sharded WCOJ count — each proven to RUN (its counter advances)
+# and to match the single-device / oracle result bit-identically.
+# ---------------------------------------------------------------------------
+
+from tpu_cypher.obs.metrics import REGISTRY as _OBS
+from tpu_cypher.utils.config import WCOJ_MODE
+
+
+def _counter(name):
+    return _OBS.counter(name).value()
+
+
+def test_sharded_agg_tier_runs_and_matches(meshed_odd):
+    """Grouped INTEGER aggregates under the mesh run as per-shard
+    ``segment_*`` partials tree-combined with psum/pmin/pmax
+    (``tpu_cypher_mesh_agg_total`` advances) and stay bit-identical to the
+    local oracle — count, sum, min, max, and the int-sum/int-count avg."""
+    mesh, g_local, g_tpu = meshed_odd
+    q = (
+        "MATCH (a:Person)-[:KNOWS]->(b) RETURN b.age AS k, count(*) AS c, "
+        "sum(a.age) AS s, min(a.age) AS lo, max(a.age) AS hi, "
+        "avg(a.age) AS m ORDER BY k LIMIT 7"
+    )
+    expected = g_local.cypher(q).records.to_bag()
+    before = _counter("tpu_cypher_mesh_agg_total")
+    with use_mesh(mesh):
+        got = g_tpu.cypher(q).records.to_bag()
+    assert got == expected, f"\ntpu: {got!r}\nlocal: {expected!r}"
+    assert _counter("tpu_cypher_mesh_agg_total") > before
+
+
+def test_sharded_distinct_count_tier():
+    """Table-level DISTINCT count under the mesh hash-repartitions the
+    packed equivalence keys across shards (``tpu_cypher_mesh_distinct_total``
+    advances) and matches the single-device packed-sort answer."""
+    import jax
+
+    from tpu_cypher.backend.tpu.column import Column
+
+    rng = np.random.default_rng(5)
+    vals = rng.integers(0, 97, 1001).astype(np.int64)
+    t = TpuTable({"x": Column.from_numpy(vals)})
+    single = t.distinct_count(["x"])
+    assert single == len(np.unique(vals))
+    before = _counter("tpu_cypher_mesh_distinct_total")
+    with use_mesh(make_row_mesh(jax.devices()[:8])):
+        t8 = TpuTable({"x": Column.from_numpy(vals)})
+        sharded = t8.distinct_count(["x"])
+    assert sharded == single
+    assert _counter("tpu_cypher_mesh_distinct_total") > before
+
+
+def test_sharded_wcoj_triangle(meshed_odd):
+    """The WCOJ count tier under the mesh leapfrog-intersects each shard's
+    LOCAL slice of the sorted adjacency and psum-combines the counts
+    (``tpu_cypher_mesh_wcoj_total`` advances); the triangle count stays
+    bit-identical to the local oracle."""
+    mesh, g_local, g_tpu = meshed_odd
+    q = (
+        "MATCH (a:Person)-[:KNOWS]->(b)-[:KNOWS]->(c)-[:KNOWS]->(a) "
+        "RETURN count(*) AS t"
+    )
+    expected = g_local.cypher(q).records.to_bag()
+    before = _counter("tpu_cypher_mesh_wcoj_total")
+    WCOJ_MODE.set("force")
+    try:
+        with use_mesh(mesh):
+            got = g_tpu.cypher(q).records.to_bag()
+    finally:
+        WCOJ_MODE.reset()
+    assert got == expected, f"\ntpu: {got!r}\nlocal: {expected!r}"
+    assert _counter("tpu_cypher_mesh_wcoj_total") > before
+
+
+def test_mesh_gates_disable_tiers(meshed_odd):
+    """``TPU_CYPHER_MESH_AGG=off`` / ``TPU_CYPHER_MESH_WCOJ=off`` keep the
+    global single-program paths — correct answers, counters frozen."""
+    from tpu_cypher.utils.config import MESH_AGG, MESH_WCOJ
+
+    mesh, g_local, g_tpu = meshed_odd
+    q = (
+        "MATCH (a:Person)-[:KNOWS]->(b) RETURN b.age AS k, count(*) AS c "
+        "ORDER BY k LIMIT 5"
+    )
+    tq = (
+        "MATCH (a:Person)-[:KNOWS]->(b)-[:KNOWS]->(c)-[:KNOWS]->(a) "
+        "RETURN count(*) AS t"
+    )
+    MESH_AGG.set("off")
+    MESH_WCOJ.set("off")
+    WCOJ_MODE.set("force")
+    a0 = _counter("tpu_cypher_mesh_agg_total")
+    w0 = _counter("tpu_cypher_mesh_wcoj_total")
+    try:
+        with use_mesh(mesh):
+            assert g_tpu.cypher(q).records.to_bag() == g_local.cypher(q).records.to_bag()
+            assert g_tpu.cypher(tq).records.to_bag() == g_local.cypher(tq).records.to_bag()
+    finally:
+        MESH_AGG.reset()
+        MESH_WCOJ.reset()
+        WCOJ_MODE.reset()
+    assert _counter("tpu_cypher_mesh_agg_total") == a0
+    assert _counter("tpu_cypher_mesh_wcoj_total") == w0
+
+
+def test_per_shard_bucket_lattice():
+    """Under a mesh the bucket lattice rounds the PER-SHARD extent: the
+    global padded size is ``lattice(ceil(n / nsh)) * nsh`` — always
+    shard-divisible, and the per-shard shape is a plain lattice point, so
+    changing the shard count never mints new local shapes (the
+    compile-cache-stability invariant)."""
+    import jax
+
+    from tpu_cypher.backend.tpu import bucketing
+
+    sizes = (1, 5, 31, 100, 1000, 12345)
+    with bucketing.force_mode("pow2"):
+        plain = {n: bucketing.round_size(n) for n in range(1, 5000)}
+        lattice_points = set(plain.values())
+        for nsh in (4, 8):
+            mesh = make_row_mesh(jax.devices()[:nsh])
+            with use_mesh(mesh):
+                for n in sizes:
+                    out = bucketing.round_size(n)
+                    local_true = -(-n // nsh)
+                    assert out % nsh == 0
+                    assert out == plain[local_true] * nsh
+                    assert out // nsh in lattice_points
+
+
+def test_per_shard_admission_budget(meshed):
+    """``bucketing.admit`` under a mesh judges each shard's 1/nsh slice of
+    the padded bytes against its 1/nsh slice of the whole-mesh budget: the
+    rejection names the per-shard scope while the typed exception keeps the
+    GLOBAL estimate/budget for the ladder's telemetry."""
+    from tpu_cypher.backend.tpu import bucketing
+    from tpu_cypher.errors import AdmissionRejected
+    from tpu_cypher.utils.config import MEM_BUDGET
+
+    mesh, _, _ = meshed
+    MEM_BUDGET.set(8 * 1024 * 1024)
+    rows, bpr = 2 * 1024 * 1024, 16  # ~32 MiB padded: over budget anywhere
+    try:
+        with bucketing.force_mode("pow2"):
+            with pytest.raises(AdmissionRejected) as e1:
+                bucketing.admit(rows, bpr, "test-site")
+            assert "per shard" not in str(e1.value)
+            with use_mesh(mesh):
+                with pytest.raises(AdmissionRejected) as e2:
+                    bucketing.admit(rows, bpr, "test-site")
+                bucketing.admit(64, 16, "test-site")  # small: admitted
+            assert "per shard (x8)" in str(e2.value)
+            assert e2.value.budget_bytes == 8 * 1024 * 1024
+            assert e2.value.estimated_bytes > e2.value.budget_bytes
+    finally:
+        MEM_BUDGET.reset()
+
+
 def test_mesh_context_restores():
     assert current_mesh() is None
     import jax

@@ -46,6 +46,58 @@ def test_dryrun_multihost_engine_query():
     assert report["two_hop"] > 0
 
 
+def test_virtual_mesh_closes_cpu_skip_gap():
+    """The two-process leg below must skip on CPU (jax's CPU backend has no
+    cross-process collective runtime) — this leg closes the coverage gap it
+    used to leave in tier-1: the SAME sharded engine paths (CSR expand +
+    join, grouped integer aggregates, WCOJ multiway intersect, DISTINCT)
+    run on the 8-virtual-device global mesh inside one process,
+    differential bit-identical against the single-device run, with the
+    mesh tier counters proving the sharded tiers actually answered."""
+    from tpu_cypher import CypherSession
+    from tpu_cypher.obs.metrics import REGISTRY as OBS
+    from tpu_cypher.parallel.mesh import use_mesh
+    from tpu_cypher.utils.config import WCOJ_MODE
+
+    rng = np.random.default_rng(9)
+    n, e = 61, 240
+    src = rng.integers(0, n, e)
+    dst = rng.integers(0, n, e)
+    keep = src != dst
+    src, dst = src[keep], dst[keep]
+    parts = [f"(n{i}:Person {{id:{i * 3 + 1}, age:{i % 50 + 18}}})" for i in range(n)]
+    parts += [f"(n{s})-[:KNOWS]->(n{d})" for s, d in zip(src, dst)]
+    create = "CREATE " + ", ".join(parts)
+    queries = [
+        "MATCH (a:Person)-[:KNOWS]->(b)-[:KNOWS]->(c) RETURN count(*) AS c",
+        "MATCH (a:Person)-[:KNOWS]->(b) RETURN b.age AS k, count(*) AS c, "
+        "sum(a.age) AS s, avg(a.age) AS m ORDER BY k LIMIT 5",
+        "MATCH (a:Person)-[:KNOWS]->(b)-[:KNOWS]->(c)-[:KNOWS]->(a) "
+        "RETURN count(*) AS t",
+        "MATCH (a:Person)-[:KNOWS]->(b) WITH DISTINCT a, b "
+        "RETURN count(*) AS pairs",
+    ]
+
+    g1 = CypherSession.tpu().create_graph_from_create_query(create)
+    single = [g1.cypher(q).records.to_bag() for q in queries]
+
+    mesh = MH.global_row_mesh()
+    assert int(np.prod(list(mesh.shape.values()))) == 8
+    agg0 = OBS.counter("tpu_cypher_mesh_agg_total").value()
+    wcoj0 = OBS.counter("tpu_cypher_mesh_wcoj_total").value()
+    WCOJ_MODE.set("force")
+    try:
+        with use_mesh(mesh):
+            g8 = CypherSession.tpu().create_graph_from_create_query(create)
+            sharded = [g8.cypher(q).records.to_bag() for q in queries]
+    finally:
+        WCOJ_MODE.reset()
+    for q, a, b in zip(queries, single, sharded):
+        assert a == b, f"\nquery: {q}\nsingle: {a!r}\nsharded: {b!r}"
+    assert OBS.counter("tpu_cypher_mesh_agg_total").value() > agg0
+    assert OBS.counter("tpu_cypher_mesh_wcoj_total").value() > wcoj0
+
+
 def test_two_process_distributed_engine_query():
     """GENUINE multi-process run: spawn two workers, localhost coordinator,
     4 virtual CPU devices each -> one 8-device global mesh. Both processes

@@ -124,6 +124,45 @@ def test_rows_pairs_sum_to_rows_totals():
                 assert sum(p[1] for p in pairs) == span.attrs["rows_padded"]
 
 
+def test_sharded_span_pairs_match_local_prediction():
+    """ISSUE 13: while a mesh is active, spans stamp the PER-SHARD
+    (true, padded) pair alongside the global sums, and every local padded
+    extent must equal the static prediction of the LOCAL true extent —
+    the per-shard lattice invariant the zero-warm-recompile guarantee
+    rests on (the same programs compile at any shard count)."""
+    import jax
+    import test_bucketing as TB
+    from tpu_cypher import CypherSession
+    from tpu_cypher.parallel.mesh import make_row_mesh, use_mesh
+
+    mode = "pow2"
+    nsh = 8
+    with bucketing.force_mode(mode):
+        mesh = make_row_mesh(jax.devices()[:nsh])
+        with use_mesh(mesh):
+            g = CypherSession.tpu().create_graph_from_create_query(
+                TB._create_query()
+            )
+            checked = 0
+            for q in TB.CORPUS:
+                result = g.cypher(q)
+                result.records.collect()
+                for span in _spans_with_pairs(result):
+                    pairs = span.attrs.get("shard_rows_pairs")
+                    if not pairs:
+                        continue
+                    assert span.attrs["shards"] == nsh
+                    for local_true, local_padded in pairs:
+                        assert predict_padded(local_true, mode) == local_padded, (
+                            f"span={span.name} local_true={local_true} "
+                            f"local_padded={local_padded} "
+                            f"predicted={predict_padded(local_true, mode)}\n"
+                            f"query: {q}"
+                        )
+                        checked += 1
+        assert checked >= 10, f"only {checked} sharded pairs observed"
+
+
 # ---------------------------------------------------------------------------
 # the facts artifact: --facts-out emits the schema the cost model consumes
 # ---------------------------------------------------------------------------
@@ -214,6 +253,10 @@ EXPECTED_LINES = {
     ("shape_stability", "shape-stability"): [12, 18, 29, 35],
     ("pad_mask", "pad-mask-discipline"): [11, 18, 25],
     ("bucket_cardinality", "bucket-cardinality"): [21, 27],
+    # ISSUE 13: the rules must look THROUGH shard_map factories and judge
+    # the per-shard kernel bodies (the sharded tiers' compile boundary)
+    ("shard_map", "pad-mask-discipline"): [19, 30],
+    ("shard_map", "shape-stability"): [40],
 }
 
 

@@ -129,21 +129,55 @@ _ROWS_PADDED = _REGISTRY.counter(
 )
 
 
+def _active_shards() -> int:
+    """Shard count of the active engine mesh (1 when single-device).
+    Imported lazily — parallel.shuffle imports this module for the shared
+    lattice helpers, so a top-level import would cycle."""
+    from ...parallel import mesh as _mesh
+
+    return _mesh.mesh_size()
+
+
+def _lattice(n: int, m: str) -> int:
+    return _round_125(n) if m == "1.25" else round_up_pow2(n, _BUCKET_FLOOR)
+
+
 def round_size(n: int) -> int:
     """Bucketed size for a data-dependent count ``n`` (0 stays 0 — the
     empty case keeps its own trivially-cheap program). Identity when
     bucketing is off. Each call records the padded-vs-true pair on the
-    enclosing trace span and the registry counters."""
+    enclosing trace span and the registry counters.
+
+    While a mesh is active the lattice rounds PER SHARD: the local extent
+    ``ceil(n / num_shards)`` rounds up the lattice and the global size is
+    that local bucket times the shard count. Every per-shard shape a
+    compiled program can see is therefore a plain lattice value regardless
+    of the shard count — changing mesh sizes never mints new local shapes —
+    and the global size stays shard-divisible so ``NamedSharding`` over the
+    row axis is always legal. Spans record the per-shard (true, padded)
+    pair alongside the global one."""
     n = int(n)
     if n <= 0:
         return 0
     m = mode()
     if m == "off":
         out = n
-    elif m == "1.25":
-        out = _round_125(n)
-    else:
-        out = round_up_pow2(n, _BUCKET_FLOOR)
+        _ROWS_TRUE.inc(n)
+        _ROWS_PADDED.inc(out)
+        _obs_trace.note_rows(n, out)
+        return out
+    nsh = _active_shards()
+    if nsh > 1:
+        local_true = -(-n // nsh)
+        local_padded = _lattice(local_true, m)
+        out = local_padded * nsh
+        _ROWS_TRUE.inc(n)
+        _ROWS_PADDED.inc(out)
+        _obs_trace.note_rows(
+            n, out, shards=nsh, local_true=local_true, local_padded=local_padded
+        )
+        return out
+    out = _lattice(n, m)
     _ROWS_TRUE.inc(n)
     _ROWS_PADDED.inc(out)
     _obs_trace.note_rows(n, out)
@@ -202,13 +236,24 @@ def admit(rows: int, bytes_per_row: int, site: str) -> None:
     chunk = G.chunk_rows()
     eff_rows = min(int(rows), chunk) if chunk is not None else int(rows)
     est = estimate_materialize_bytes(eff_rows, bytes_per_row)
-    if est > budget:
+    nsh = _active_shards() if enabled() else 1
+    if nsh > 1:
+        # row-sharded materialize: each device holds 1/nsh of the padded
+        # rows (round_size made the global size shard-divisible), judged
+        # against its 1/nsh slice of the whole-mesh budget
+        est_judged = est // nsh
+        budget_judged = budget // nsh
+        scope = f" per shard (x{nsh})"
+    else:
+        est_judged, budget_judged, scope = est, budget, ""
+    if est_judged > budget_judged:
         from ...errors import AdmissionRejected
 
         raise AdmissionRejected(
-            f"materialize at site {site!r} needs ~{est} bytes padded "
-            f"({rows} rows x {bytes_per_row} B/row on the "
-            f"{mode()!r} lattice), over the {budget}-byte HBM budget",
+            f"materialize at site {site!r} needs ~{est_judged} bytes "
+            f"padded{scope} ({rows} rows x {bytes_per_row} B/row on the "
+            f"{mode()!r} lattice), over the {budget_judged}-byte HBM "
+            f"budget{scope}",
             site=site,
             estimated_bytes=est,
             budget_bytes=budget,

@@ -92,6 +92,14 @@ def device_padded(host_arr, fill):
 
     arr, bpad = bucket_pad_host(np.asarray(host_arr), fill)
     dev, mpad = padded_to_mesh(arr, fill)
+    # per-shard lattice invariant: with bucketing on under a mesh,
+    # round_size already returned a shard-divisible size (it rounds the
+    # LOCAL extent and scales back up), so the mesh pass only lays out —
+    # the two pads are mutually exclusive
+    assert not (bpad and mpad), (
+        f"per-shard lattice failed to absorb the shard pad "
+        f"(bucket pad {bpad}, mesh pad {mpad})"
+    )
     return dev, bpad + mpad
 
 

@@ -29,6 +29,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ...ir import expr as E
+from ...obs import trace as _obs_trace
 from ...parallel.mesh import current_mesh, mesh_size
 from ...runtime.faults import fault_point
 from ...relational.header import RecordHeader
@@ -719,6 +720,7 @@ class CsrExpandOp(_FusedExpandBase):
             )
             if divisible and size > 1:
                 chain = J.path_count_chain_on_mesh(mesh, axis)
+                _obs_trace.note("expand_shards", size)
         return int(
             chain(
                 dev_ids,

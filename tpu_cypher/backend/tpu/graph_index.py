@@ -327,7 +327,12 @@ class GraphIndex:
             # this CSR orientation is lexsorted by (a, b) => a*N + b keys
             # sorted (forward: src*N + dst; reverse: dst*N + src); the pad
             # sentinel sorts past every real key so binary-search probes
-            # are unaffected
+            # are unaffected. Under a mesh, device_padded leaves the length
+            # shard-divisible and row-sharded, so each shard holds a
+            # CONTIGUOUS sorted run — the sharded WCOJ count tier
+            # (mesh.sharded_range_count) rests on range counts being
+            # additive over exactly such partitions, with sentinel lanes
+            # never entering a counted range
             keys = a_sorted.astype(np.int64) * n + b[order].astype(np.int64)
             self._edge_keys[(types_key, reverse)] = device_padded(
                 keys, (1 << 62)

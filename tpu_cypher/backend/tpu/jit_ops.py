@@ -945,6 +945,17 @@ def distinct_count_packed(datas, valids, extra_keys, kinds, pack):
     return jnp.sum((s[1:] != s[:-1]).astype(jnp.int64)) + 1
 
 
+@partial(jax.jit, static_argnames=("kinds", "pack"))
+def equivalence_pack_keys(datas, valids, extra_keys, kinds, pack):
+    """The per-row packed equivalence key of ``distinct_count_packed``
+    WITHOUT the sort: row equality == Cypher equivalence. The sharded
+    DISTINCT tier hash-repartitions these keys over the mesh so equal
+    values meet on one shard (``parallel.shuffle.sharded_distinct_count``)
+    instead of paying a global sort."""
+    keys = list(extra_keys) + _equivalence_keys_traced(datas, valids, kinds)
+    return _pack_fold(keys, pack)
+
+
 # ---------------------------------------------------------------------------
 # equivalence sort (distinct / group factorization)
 # ---------------------------------------------------------------------------

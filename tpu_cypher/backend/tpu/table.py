@@ -985,11 +985,34 @@ class TpuTable(Table):
         kinds = tuple(self._cols[c].kind for c in on)
         pack = self._equiv_pack(datas, valids, kinds, (), min_keys=1)
         if pack is not None:
+            sharded = self._sharded_distinct_count(datas, valids, kinds, pack)
+            if sharded is not None:
+                return sharded
             return int(J.distinct_count_packed(datas, valids, (), kinds, pack))
         # unpackable keys: sort unpacked directly — re-probing min/max via
         # _first_occurrence_index would repeat the device round trip
         _, _, cnt = J.equivalence_sort(datas, valids, (), kinds, pack=None)
         return int(cnt)
+
+    def _sharded_distinct_count(self, datas, valids, kinds, pack):
+        """Mesh tier of the distinct-count pushdown: hash-repartition the
+        packed equivalence keys so equal values meet on one shard, count
+        run boundaries per shard, ``psum`` the partials. None when no
+        multi-device mesh is active, the ``TPU_CYPHER_MESH_AGG`` gate is
+        off, or the shuffle declines (skew overflow / non-addressable
+        rows) — the global values-only sort stays the fallback."""
+        from ...parallel import mesh as PM
+
+        if PM.mesh_size() <= 1:
+            return None
+        from ...utils.config import MESH_AGG
+
+        if MESH_AGG.get().strip().lower() != "auto":
+            return None
+        from ...parallel.shuffle import sharded_distinct_count
+
+        keys = J.equivalence_pack_keys(datas, valids, (), kinds, pack)
+        return sharded_distinct_count(keys)
 
     def distinct(self, cols: Optional[Sequence[str]] = None) -> "TpuTable":
         t = self._depad()
@@ -1178,6 +1201,26 @@ class TpuTable(Table):
             raise TpuUnsupportedExpr(f"{name} over {kind}")
         if name in ("percentilecont", "percentiledisc"):
             return self._segment_percentile(name, agg, seg_j, col, n, k, parameters)
+        # mesh tier: integer aggregates as per-shard partials tree-combined
+        # with psum/pmin/pmax — integer combines are exact, so the sharded
+        # result is bit-identical to single-device (floats keep the global
+        # path; see parallel/agg.py)
+        if (
+            kind in (I64, BOOL)
+            and col.int_flag is None
+            and (kind == I64 or name in ("count", "min", "max"))
+        ):
+            from ...parallel.agg import sharded_segment_agg
+
+            mesh_out = sharded_segment_agg(
+                data, col.valid, seg_j, name, kind == BOOL, k
+            )
+            if mesh_out is not None:
+                out_data, out_valid = mesh_out
+                if name == "count":
+                    return Column(I64, out_data, None)
+                out_kind = F64 if name == "avg" else kind
+                return Column(out_kind, out_data, out_valid, vocab)
         # kernel tier: the Pallas masked segment reduce when eligible
         # (dispatch falls back to the jax.ops scatter formulation; see
         # backend/tpu/pallas/aggregate.py)

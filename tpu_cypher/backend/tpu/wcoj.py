@@ -89,6 +89,40 @@ WCOJ_TIER_COUNTS = CounterView(
     ("count", "materialize", "shadow"),
 )
 
+_MESH_WCOJ_TOTAL = _OBS_REGISTRY.counter(
+    "tpu_cypher_mesh_wcoj_total",
+    "WCOJ count executions whose range probes ran on the sharded "
+    "(per-shard local searchsorted + psum) intersect tier",
+)
+
+
+def _mesh_range_counter(lists):
+    """The sharded range-count program for the WCOJ count tier, or None.
+
+    Eligible when a multi-device mesh is active, ``TPU_CYPHER_MESH_WCOJ``
+    is ``auto``, and every intersection list's sorted ``edge_keys`` length
+    is shard-divisible (free whenever the graph was ingested under the
+    mesh: ``padded_to_mesh`` pads edge keys to a shard multiple with the
+    above-everything sentinel, which can never match a probe). Each shard
+    then leapfrog-intersects its LOCAL adjacency slice — two binary
+    searches over the local keys — and the per-query counts tree-combine
+    with ``psum`` (see ``parallel.mesh.sharded_range_count``)."""
+    from ...parallel import mesh as PM
+
+    mesh = PM.current_mesh()
+    nsh = PM.mesh_size()
+    if mesh is None or nsh <= 1:
+        return None
+    from ...utils.config import MESH_WCOJ
+
+    if MESH_WCOJ.get().strip().lower() != "auto":
+        return None
+    for lst in lists:
+        n_keys = int(lst.keys.shape[0])
+        if n_keys == 0 or n_keys % nsh != 0:
+            return None
+    return PM.sharded_range_count(mesh), nsh
+
 
 class PivotSpec(NamedTuple):
     """The peeled top expand supplying candidate+multiplicity by CSR row."""
@@ -335,6 +369,11 @@ class MultiwayIntersectOp(_FusedExpandBase):
         arm = _argmin_arm(tuple(degs), valid)
         bucketed = bucketing.enabled()
         n = gi.num_nodes
+        mesh_tier = _mesh_range_counter(lists)
+        if mesh_tier is not None:
+            mesh_count, nsh = mesh_tier
+            _MESH_WCOJ_TOTAL.inc()
+            _obs_trace.note("wcoj_shards", nsh)
         total = 0
         for a, lst in enumerate(lists):
             deg_a, t_dev = _arm_degrees(degs[a], arm, a, valid)
@@ -360,7 +399,10 @@ class MultiwayIntersectOp(_FusedExpandBase):
                 q, qok = _probe_queries(
                     other.pos, other.ok, row, cand, live, n=n
                 )
-                _, cnt, _ = P.intersect_range_count(other.keys, q, qok)
+                if mesh_tier is not None:
+                    cnt = mesh_count(other.keys, q, qok)
+                else:
+                    _, cnt, _ = P.intersect_range_count(other.keys, q, qok)
                 m = cnt if m is None else _mul(m, cnt)
             if mask is not None:
                 m = _apply_label_mask(m, mask, cand)

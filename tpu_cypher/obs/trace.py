@@ -80,14 +80,24 @@ class Span:
     # loops so a span never grows without limit
     ROWS_PAIRS_CAP = 64
 
-    def add_rows(self, true_rows: int, padded_rows: int) -> None:
+    def add_rows(
+        self,
+        true_rows: int,
+        padded_rows: int,
+        shards: int = 1,
+        local_true: Optional[int] = None,
+        local_padded: Optional[int] = None,
+    ) -> None:
         """Accumulate a padded-vs-true row count from the bucket lattice.
 
         Besides the running sums, the individual ``(true, padded)`` pairs
         are retained (bounded) so static shape predictions
         (``analysis.shapes.predict_padded``) can be checked against what
         the lattice actually produced, per rounding, not just in
-        aggregate."""
+        aggregate. Under a mesh the lattice rounds per shard: the span
+        additionally records the shard count and the per-shard
+        ``(local true extent, local padded)`` pairs, the sharded analog
+        of the same static-vs-runtime agreement gate."""
         self.attrs["rows_true"] = self.attrs.get("rows_true", 0) + int(true_rows)
         self.attrs["rows_padded"] = (
             self.attrs.get("rows_padded", 0) + int(padded_rows)
@@ -95,6 +105,11 @@ class Span:
         pairs = self.attrs.setdefault("rows_pairs", [])
         if len(pairs) < self.ROWS_PAIRS_CAP:
             pairs.append([int(true_rows), int(padded_rows)])
+        if shards > 1 and local_true is not None and local_padded is not None:
+            self.attrs["shards"] = int(shards)
+            spairs = self.attrs.setdefault("shard_rows_pairs", [])
+            if len(spairs) < self.ROWS_PAIRS_CAP:
+                spairs.append([int(local_true), int(local_padded)])
 
     def to_dict(self) -> Dict[str, Any]:
         out: Dict[str, Any] = {
@@ -123,7 +138,8 @@ class _NullSpan:
     def count(self, key, amount=1):
         pass
 
-    def add_rows(self, true_rows, padded_rows):
+    def add_rows(self, true_rows, padded_rows, shards=1, local_true=None,
+                 local_padded=None):
         pass
 
 
@@ -202,11 +218,21 @@ def note(key: str, value: Any) -> None:
         sp.attrs[key] = value
 
 
-def note_rows(true_rows: int, padded_rows: int) -> None:
-    """Record a bucket-lattice materialize on the innermost open span."""
+def note_rows(
+    true_rows: int,
+    padded_rows: int,
+    shards: int = 1,
+    local_true: Optional[int] = None,
+    local_padded: Optional[int] = None,
+) -> None:
+    """Record a bucket-lattice materialize on the innermost open span
+    (plus the per-shard extent pair while a mesh is active)."""
     sp = _SPAN.get()
     if sp is not None:
-        sp.add_rows(true_rows, padded_rows)
+        sp.add_rows(
+            true_rows, padded_rows,
+            shards=shards, local_true=local_true, local_padded=local_padded,
+        )
 
 
 def note_site(site: str) -> None:

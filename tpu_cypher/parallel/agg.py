@@ -1,0 +1,145 @@
+"""Sharded segment aggregates: per-shard partials tree-combined over ICI.
+
+The reference delegates grouped aggregation to the engines' shuffle-reduce
+(partial aggregates per partition, combined at the exchange — SURVEY §2.3);
+the mesh analog computes each shard's ``segment_*`` partial over its local
+row block and combines the k-sized partials with ``psum``/``pmin``/``pmax``
+inside one ``shard_map`` program, so no shard ever holds the full row set.
+
+Eligibility is deliberately narrow: INTEGER data (I64/BOOL) and the
+aggregates whose combine is exact over the integers (count/sum/min/max,
+plus avg as an integer-sum over integer-count divide). Float addition is
+not associative, so a float psum could differ from the single-device result
+in the last ulp — the differential suite pins sharded results BIT-IDENTICAL
+to single-device, and the float kinds keep the global path. Gate:
+``TPU_CYPHER_MESH_AGG=off`` disables the tier entirely.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from ..obs import trace as _obs_trace
+from ..obs.metrics import REGISTRY as _REGISTRY
+from .mesh import current_mesh, mesh_size, shard_map
+from .shuffle import _pad_sharded
+
+_MESH_AGG_TOTAL = _REGISTRY.counter(
+    "tpu_cypher_mesh_agg_total",
+    "grouped aggregates executed on the sharded (per-shard partial + "
+    "tree combine) tier",
+)
+
+# aggregate names whose per-shard combine is exact over the integers
+_INT_NAMES = ("count", "sum", "min", "max", "avg")
+
+# jitted shard_map programs, memoized per (mesh, aggregate, dtype, k) —
+# fresh factories per call would recompile the collective every query
+# (the recompile-hazard lint rule)
+_AGG_CACHE: Dict[Any, Any] = {}
+
+
+def _agg_fn(mesh, axis: str, name: str, is_bool: bool, k: int):
+    key = (mesh, axis, name, is_bool, k)
+    got = _AGG_CACHE.get(key)
+    if got is not None:
+        return got
+
+    def local(data, valid, seg):
+        # pad rows staged valid=False: they contribute the combine identity
+        cnt = jax.ops.segment_sum(
+            valid.astype(jnp.int64), seg, num_segments=k
+        )
+        cnt = lax.psum(cnt, axis)
+        if name == "count":
+            return cnt, cnt
+        if name in ("sum", "avg"):
+            ssum = jax.ops.segment_sum(
+                jnp.where(valid, data, jnp.zeros((), data.dtype)),
+                seg,
+                num_segments=k,
+            )
+            return lax.psum(ssum, axis), cnt
+        # min / max: same sentinels as the global segment_aggregate so
+        # empty-group payloads (masked invalid anyway) stay bit-identical
+        d = data.astype(jnp.int8) if is_bool else data
+        big = jnp.asarray(jnp.iinfo(d.dtype).max, d.dtype)
+        if name == "min":
+            agged = jax.ops.segment_min(
+                jnp.where(valid, d, big), seg, num_segments=k
+            )
+            agged = lax.pmin(agged, axis)
+        else:
+            agged = jax.ops.segment_max(
+                jnp.where(valid, d, -big), seg, num_segments=k
+            )
+            agged = lax.pmax(agged, axis)
+        return agged, cnt
+
+    spec = P(axis)
+    fn = jax.jit(
+        shard_map(
+            local, mesh, in_specs=(spec, spec, spec), out_specs=(P(), P())
+        )
+    )
+    _AGG_CACHE[key] = fn
+    return fn
+
+
+def _gate_open() -> bool:
+    from ..utils.config import MESH_AGG
+
+    return MESH_AGG.get().strip().lower() == "auto"
+
+
+def sharded_segment_agg(
+    data, valid, seg_j, name: str, is_bool: bool, k: int
+) -> Optional[Tuple[Any, Any]]:
+    """One grouped aggregate as per-shard partials + tree combine.
+
+    ``data``/``seg_j`` device (or host) arrays over the same row extent,
+    ``valid`` an optional mask. Returns ``(out_data, out_valid_or_None)``
+    in the global ``segment_aggregate`` contract, or None when the tier is
+    ineligible (no multi-device mesh, a non-integer-exact aggregate, the
+    ``TPU_CYPHER_MESH_AGG=off`` gate, or rows this process cannot stage) —
+    the caller keeps the global path."""
+    mesh = current_mesh()
+    nsh = mesh_size()
+    if mesh is None or nsh <= 1 or name not in _INT_NAMES or k <= 0:
+        return None
+    if not _gate_open():
+        return None
+    for arr in (data, valid, seg_j):
+        if arr is not None and not getattr(arr, "is_fully_addressable", True):
+            return None
+    d_np = np.asarray(data)
+    n = d_np.shape[0]
+    if n == 0:
+        return None
+    v_np = (
+        np.ones(n, bool) if valid is None else np.asarray(valid, dtype=bool)
+    )
+    s_np = np.asarray(seg_j, dtype=np.int64)
+    axis = mesh.axis_names[0]
+    d = _pad_sharded(d_np, nsh, 0, mesh, axis)
+    v = _pad_sharded(v_np, nsh, False, mesh, axis)
+    s = _pad_sharded(s_np, nsh, 0, mesh, axis)
+    out, cnt = _agg_fn(mesh, axis, name, bool(is_bool), int(k))(d, v, s)
+    _MESH_AGG_TOTAL.inc()
+    _obs_trace.note("agg_shards", nsh)
+    if name == "count":
+        return out, None
+    if name == "sum":
+        return out, None
+    if name == "avg":
+        avg = out.astype(jnp.float64) / jnp.maximum(cnt, 1)
+        return avg, cnt > 0
+    agged = out.astype(bool) if is_bool else out
+    return agged, cnt > 0

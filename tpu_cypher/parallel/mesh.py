@@ -41,6 +41,8 @@ except ImportError:  # pragma: no cover
     def shard_map(f, mesh, in_specs, out_specs):
         return _shard_map_legacy(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs)
 
+from ..utils import config as _config
+
 EDGE_AXIS = "edges"
 
 # engine-level row axis: TpuTable columns and CSR edge arrays are sharded
@@ -87,8 +89,63 @@ class use_mesh:
         _ACTIVE_MESH = self._prev
 
 
+def resolve_mesh(spec) -> Optional[Mesh]:
+    """One mesh-construction chokepoint for every activation surface
+    (``CypherSession.tpu(mesh=...)``, the ``TPU_CYPHER_MESH`` env default).
+
+    ``Mesh`` passes through; an integer N builds a row mesh over the first
+    N visible devices; ``"auto"``/``"all"`` takes every device. Anything
+    that resolves to a single device (or ``""``/``"off"``/``None``) means
+    single-device execution and returns None."""
+    if spec is None:
+        return None
+    if isinstance(spec, Mesh):
+        return spec
+    if isinstance(spec, int):
+        n = spec
+    else:
+        s = str(spec).strip().lower()
+        if s in ("", "off", "none", "0", "1"):
+            return None
+        if s in ("auto", "all"):
+            n = len(jax.devices())
+        else:
+            try:
+                n = int(s)
+            except ValueError:
+                return None
+    devs = jax.devices()
+    n = min(n, len(devs))
+    if n <= 1:
+        return None
+    return make_row_mesh(devs[:n])
+
+
+def activate_mesh(mesh: Optional[Mesh]) -> Optional[Mesh]:
+    """Set the process-global engine mesh (None deactivates). The session
+    factory uses this for persistent activation; scoped activation should
+    prefer the ``use_mesh`` context manager."""
+    global _ACTIVE_MESH
+    _ACTIVE_MESH = mesh
+    return mesh
+
+
+# env-default mesh, resolved lazily and memoized per spec string so the
+# hot-path current_mesh() stays a dict probe after first use
+_ENV_MESH_CACHE: dict = {}
+
+
+def _env_default_mesh() -> Optional[Mesh]:
+    spec = _config.MESH_SPEC.get()
+    if spec not in _ENV_MESH_CACHE:
+        _ENV_MESH_CACHE[spec] = resolve_mesh(spec)
+    return _ENV_MESH_CACHE[spec]
+
+
 def current_mesh() -> Optional[Mesh]:
-    return _ACTIVE_MESH
+    if _ACTIVE_MESH is not None:
+        return _ACTIVE_MESH
+    return _env_default_mesh()
 
 
 def shard_rows(arr):
@@ -98,7 +155,7 @@ def shard_rows(arr):
     ``padded_to_mesh`` instead, which pads arbitrary row counts to a shard
     multiple (VERDICT r2 weak #3: the divisible-only skip silently
     un-sharded real workloads — 1,999,987 edges on an 8-mesh)."""
-    mesh = _ACTIVE_MESH
+    mesh = current_mesh()
     if mesh is None:
         return arr
     shape = getattr(arr, "shape", None)
@@ -112,7 +169,7 @@ def shard_rows(arr):
 
 
 def mesh_size() -> int:
-    mesh = _ACTIVE_MESH
+    mesh = current_mesh()
     if mesh is None:
         return 1
     return int(np.prod(list(mesh.shape.values())))
@@ -129,7 +186,7 @@ def padded_to_mesh(host_arr, fill) -> Tuple[Any, int]:
     sentinel. With no active mesh (or an empty input) this is a plain
     ``jnp.asarray`` with pad 0."""
     arr = np.asarray(host_arr)
-    mesh = _ACTIVE_MESH
+    mesh = current_mesh()
     if mesh is None or arr.ndim == 0 or arr.shape[0] == 0:
         return jnp.asarray(arr), 0
     size = int(np.prod(list(mesh.shape.values())))
@@ -247,4 +304,37 @@ def sharded_training_step(mesh: Mesh, num_nodes: int, hops: int):
         )
     )
     _TRAIN_STEP_CACHE[key] = f
+    return f
+
+
+_RANGE_COUNT_CACHE: dict = {}
+
+
+def sharded_range_count(mesh: Mesh):
+    """Per-query equal-key counts over ROW_AXIS-sharded sorted ``edge_keys``
+    — the mesh tier of the WCOJ leapfrog intersect.
+
+    A NamedSharding over the leading dim partitions a sorted array into
+    contiguous slices, and searchsorted range counts are ADDITIVE over
+    contiguous partitions: each shard counts matches in its local adjacency
+    slice with two binary searches and the counts ``psum``-combine, exactly
+    where a relational engine would shuffle-reduce. Queries and their
+    validity mask stay replicated (they are small relative to edges — the
+    broadcast-join analog); sentinel pad keys (above every real key) can
+    never match a query so pads contribute zero."""
+    f = _RANGE_COUNT_CACHE.get(mesh)
+    if f is None:
+
+        def kernel(keys_shard, q, qok):
+            lo = jnp.searchsorted(keys_shard, q, side="left")
+            hi = jnp.searchsorted(keys_shard, q, side="right")
+            local = jnp.where(qok, (hi - lo).astype(jnp.int64), 0)
+            return lax.psum(local, ROW_AXIS)
+
+        f = jax.jit(
+            shard_map(
+                kernel, mesh, in_specs=(P(ROW_AXIS), P(), P()), out_specs=P()
+            )
+        )
+        _RANGE_COUNT_CACHE[mesh] = f
     return f

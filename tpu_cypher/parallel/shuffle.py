@@ -43,7 +43,14 @@ from jax import lax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ..backend.tpu.bucketing import round_up_pow2
+from ..obs import trace as _obs_trace
+from ..obs.metrics import REGISTRY as _REGISTRY
 from .mesh import current_mesh, mesh_size, shard_map
+
+_MESH_DISTINCT_TOTAL = _REGISTRY.counter(
+    "tpu_cypher_mesh_distinct_total",
+    "DISTINCT counts executed on the sharded hash-repartition tier",
+)
 
 # Key namespace: real keys ship DOUBLED (even numbers — injective, equality
 # and bucket assignment preserved); pad slots use per-side odd sentinels that
@@ -439,3 +446,112 @@ def hash_repartition_join(
     idx = mask_nonzero(valid, size=total)
     l_rows, r_rows = tree_take((l_out, r_out), idx)
     return l_rows, r_rows
+
+
+# ---------------------------------------------------------------------------
+# sharded DISTINCT: hash-repartition the equivalence keys so equal values
+# meet on one shard, count run boundaries locally, psum the partial counts
+# ---------------------------------------------------------------------------
+
+_DISTINCT_CACHE: Dict[Any, Any] = {}
+
+
+def _distinct_fn(mesh, axis, nsh, cap):
+    key = (mesh, axis, cap)
+    got = _DISTINCT_CACHE.get(key)
+    if got is not None:
+        return got
+
+    def local(keys, live):
+        # route by mixed VALUE so every occurrence of a key lands on one
+        # shard; liveness travels as a sidecar lane (packed equivalence
+        # keys use the full 63-bit namespace, so no key value can be
+        # reserved as a pad sentinel the way the join's doubling does)
+        n = keys.shape[0]
+        is_live = live != 0
+        tgt = jnp.where(
+            is_live,
+            _mix64(keys) % jnp.uint64(nsh),
+            (jnp.arange(n) % nsh).astype(jnp.uint64),
+        ).astype(jnp.int32)
+        order = jnp.argsort(tgt, stable=True)
+        tgt_s = jnp.take(tgt, order)
+        is_real = jnp.take(is_live, order)
+        creal = jnp.cumsum(is_real.astype(jnp.int64))
+        start = jnp.searchsorted(tgt_s, tgt_s, side="left")
+        before = jnp.where(
+            start > 0, jnp.take(creal, jnp.maximum(start - 1, 0)), 0
+        )
+        rank = creal - 1 - before
+        overflow = jnp.any((rank >= cap) & is_real)
+        rank_c = jnp.where(is_real, jnp.minimum(rank, cap), cap)
+        keys_s = jnp.take(keys, order)
+        buf_k = jnp.zeros((nsh, cap + 1), jnp.int64)
+        buf_v = jnp.zeros((nsh, cap + 1), jnp.int64)
+        buf_k = buf_k.at[tgt_s, rank_c].set(
+            jnp.where(rank_c < cap, keys_s, 0)
+        )
+        buf_v = buf_v.at[tgt_s, rank_c].set(
+            jnp.where(rank_c < cap, is_real.astype(jnp.int64), 0)
+        )
+        rk = lax.all_to_all(buf_k[:, :cap], axis, 0, 0, tiled=True).reshape(-1)
+        rv = lax.all_to_all(buf_v[:, :cap], axis, 0, 0, tiled=True).reshape(-1)
+        live2 = rv != 0
+        # live rows sort to the front (dead-last), grouped by key: a run
+        # boundary among the live prefix is one distinct value
+        order2 = jnp.lexsort((rk, (~live2).astype(jnp.int8)))
+        k_s = jnp.take(rk, order2)
+        l_s = jnp.take(live2, order2)
+        idx = jnp.arange(k_s.shape[0])
+        first = l_s & ((idx == 0) | (k_s != jnp.roll(k_s, 1)))
+        local_distinct = jnp.sum(first.astype(jnp.int64))
+        return lax.psum(local_distinct, axis)[None], overflow[None]
+
+    spec = P(axis)
+    fn = jax.jit(
+        shard_map(
+            local, mesh=mesh, in_specs=(spec, spec), out_specs=(spec, spec)
+        )
+    )
+    _DISTINCT_CACHE[key] = fn
+    return fn
+
+
+def sharded_distinct_count(
+    keys, valid=None, cap_factor: float = 2.0
+) -> Optional[int]:
+    """Distinct count of int64 equivalence keys over the active mesh: the
+    DISTINCT analog of ``hash_repartition_join`` — one tiled ``all_to_all``
+    routes every occurrence of a key value to ``mix(value) % n_shards``, so
+    each shard's local run-boundary count is over a disjoint slice of the
+    value space and the partials ``psum`` exactly. Returns the count, or
+    None when no multi-device mesh is active, rows are not addressable
+    from this process, or a skewed key distribution overflows the static
+    bucket capacity — the caller keeps the global sort path."""
+    mesh = current_mesh()
+    nsh = mesh_size()
+    if mesh is None or nsh <= 1:
+        return None
+    for arr in (keys, valid):
+        if arr is not None and not getattr(arr, "is_fully_addressable", True):
+            return None
+    from ..runtime.faults import fault_point
+
+    fault_point("shuffle")
+    axis = mesh.axis_names[0]
+    k_np = np.asarray(keys, dtype=np.int64)
+    if valid is not None:
+        k_np = k_np[np.asarray(valid)]
+    n = len(k_np)
+    if n == 0:
+        return 0
+    k = _pad_sharded(k_np, nsh, 0, mesh, axis)
+    live = _pad_sharded(np.ones(n, dtype=np.int64), nsh, 0, mesh, axis)
+    b = int(k.shape[0]) // nsh
+    cap = round_up_pow2(int(b / nsh * cap_factor) + 16, 16)
+    counts, overflow = _distinct_fn(mesh, axis, nsh, cap)(k, live)
+    if bool(np.asarray(overflow).any()):
+        return None
+    _MESH_DISTINCT_TOTAL.inc()
+    _obs_trace.note("distinct_shards", nsh)
+    return int(np.asarray(counts)[0])

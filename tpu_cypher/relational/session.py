@@ -549,9 +549,22 @@ class CypherSession:
         persistent_cache_dir: Optional[str] = None,
         memory_budget_bytes: Optional[int] = None,
         query_deadline_seconds: Optional[float] = None,
+        mesh=None,
     ) -> "CypherSession":
+        """TPU-backend session. ``mesh`` activates mesh-native table
+        algebra for everything this process ingests afterwards: a
+        ``jax.sharding.Mesh``, a device count, or ``"auto"``/``"all"``
+        (see ``parallel.mesh.resolve_mesh``; the ``TPU_CYPHER_MESH`` env
+        var sets the same default without code changes). Activation is
+        process-global — the mesh decides the physical layout of graph
+        ingest, which outlives any one session scope; use
+        ``parallel.mesh.use_mesh`` for scoped activation."""
         from ..backend.tpu.table import TpuTable
 
+        if mesh is not None:
+            from ..parallel import mesh as _mesh
+
+            _mesh.activate_mesh(_mesh.resolve_mesh(mesh))
         return CypherSession(
             TpuTable,
             persistent_cache_dir=persistent_cache_dir,

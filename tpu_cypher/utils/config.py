@@ -206,6 +206,32 @@ BROADCAST_LIMIT = declare(
     help="max rows broadcast to every shard instead of hash-shuffled",
 )
 
+# mesh execution (parallel/mesh.py): table algebra runs mesh-native when a
+# mesh is active — either via parallel.mesh.use_mesh / CypherSession.tpu(
+# mesh=...) or the TPU_CYPHER_MESH env default below
+MESH_SPEC = declare(
+    "TPU_CYPHER_MESH",
+    "",
+    str,
+    help="default engine mesh: '' / 'off' = single device; 'auto' / 'all' "
+    "= one row-sharding mesh over every visible device; an integer N = "
+    "mesh over the first N devices",
+)
+MESH_AGG = declare(
+    "TPU_CYPHER_MESH_AGG",
+    "auto",
+    str,
+    help="sharded segment aggregates / distinct-count tier while a mesh "
+    "is active: auto (integer data only, bit-identical psum combine) | off",
+)
+MESH_WCOJ = declare(
+    "TPU_CYPHER_MESH_WCOJ",
+    "auto",
+    str,
+    help="sharded WCOJ count tier: each shard range-counts its local "
+    "slice of the sorted edge_keys and counts psum-combine: auto | off",
+)
+
 # compiler diagnostics (backend/tpu/compiler.py)
 ISLAND_WARN_ROWS = declare(
     "TPU_CYPHER_ISLAND_WARN_ROWS",
