@@ -555,18 +555,21 @@ def _wcoj_vs_binary(
 
     Each shape is gated by a host-side transient estimate — the same
     degrade-to-a-skip-note contract as the distinct rung, because an
-    over-scaled leg OOM-kills the whole JSON line. Triangle's transient
-    is the count-tier expanded-lane total (sum of min end degrees, lean
-    ~40B lanes, so it gets the distinct gate's x8 slack); clique4's is
-    the 3-walk count, because a multi-close count degrades to the
-    acyclic shadow whose intermediate IS that row set with fat
-    sort-buffered rows (measured ~0.7KB/row), so it gets no slack."""
+    over-scaled leg OOM-kills the whole JSON line. Both transients are
+    count-tier expanded-lane totals (lean ~40B lanes, so both get the
+    distinct gate's x8 slack): triangle's is the sum of min end degrees,
+    clique4's the 3-walk lane bound. Clique4 used to get NO slack because
+    a multi-close pure count degraded to the acyclic shadow and
+    materialized the 3-walk row set at fat sort-buffered width (the
+    878M-row r06 note); the WCOJ count tier now answers multi-close
+    shapes directly with range-count products, so the leg measures
+    instead of recording an OOM skip."""
     from tpu_cypher.utils.config import WCOJ_MODE
 
     entry = {}
     for label, query, key, cap_mult in (
         ("triangle", TRIANGLE, "triangles", 8),
-        ("clique4", CLIQUE4, "cliques", 1),
+        ("clique4", CLIQUE4, "cliques", 8),
     ):
         est = int(est_rows[label])
         if est > budget_rows * cap_mult:
@@ -586,7 +589,12 @@ def _wcoj_vs_binary(
             "count": int(outw[0][key]),
             "wcoj_tier": tierw,
         }
-        if feasible_binary:
+        # clique4's binary plan DOES materialize the 3-walk row set at fat
+        # sort-buffered width — its sub-leg keeps the old no-slack bound
+        # even though the WCOJ count leg above ran with lane slack
+        # (triangle's binary transient is the 2-hop set, already covered
+        # by ``feasible_binary``)
+        if feasible_binary and (label != "clique4" or est <= budget_rows):
             WCOJ_MODE.set("off")
             try:
                 dtb, outb, tierb = _time_query(g, query, repeats=1)
@@ -839,6 +847,139 @@ def _derive_tpu_env(log: list) -> None:
         log.append({"derived_tpu_env": entry})
 
 
+# join-order leg: chain/cycle shapes with skewed label/type selectivities —
+# the regime where the cost-based optimizer's anchor + order choice departs
+# from syntax order. Each query is timed under TPU_CYPHER_OPT=syntax and
+# =force on the same warm graph (the plan-cache key carries the mode, so
+# each leg replans); counts must agree or the leg reports the mismatch.
+_JOIN_ORDER_QUERIES = (
+    ("rare_last", "MATCH (a:Person)-[:KNOWS]->(b:Person)-[:RARE]->(c:Admin) "
+                  "RETURN count(*) AS c"),
+    ("rare_mid", "MATCH (a:Person)-[:KNOWS]->(b)-[:RARE]->(c)-[:KNOWS]->(d:Person) "
+                 "RETURN count(*) AS c"),
+    ("label_last", "MATCH (a:Person)-[:KNOWS]->(b:Person)-[:KNOWS]->(c:Admin) "
+                   "RETURN count(*) AS c"),
+    ("cycle_close", "MATCH (a:Person)-[:KNOWS]->(b:Person)-[:RARE]->(c)-[:KNOWS]->(a) "
+                    "RETURN count(*) AS c"),
+    ("filter_hoist", "MATCH (a:Person)-[:KNOWS]->(b:Person)-[:KNOWS]->(c:Admin) "
+                     "WHERE c.id < 40 RETURN count(*) AS c"),
+)
+
+
+def _join_order_graph(session):
+    """Skewed two-label / two-reltype graph, built from arrays (a CREATE
+    string at this scale would spend the whole leg parsing). Big enough
+    that expand cost is row-volume-bound — the regime the padded-row cost
+    model prices — rather than fixed per-operator overhead: ~30k nodes
+    (1-in-50 Admin), 300k KNOWS, 600 RARE."""
+    from tpu_cypher.api import types as T
+    from tpu_cypher.api.mapping import NodeMapping, RelationshipMapping
+    from tpu_cypher.api.schema import PropertyGraphSchema
+    from tpu_cypher.relational.graphs import ElementTable, ScanGraph
+
+    rng = np.random.default_rng(17)
+    n, dense_e, rare_e = 30_000, 300_000, 600
+    ids = np.arange(n, dtype=np.int64)
+    admin = ids % 50 == 0
+    prop_types = {"id": T.CTInteger.nullable}
+
+    def rel_edges(count, id_base):
+        src = rng.integers(0, n, count)
+        dst = rng.integers(0, n, count)
+        keep = src != dst
+        src, dst = src[keep], dst[keep]
+        eids = np.arange(len(src), dtype=np.int64) + id_base
+        return session.table_cls.from_arrays(
+            {"id": eids, "source": src, "target": dst}
+        )
+
+    tables = []
+    for label, mask in (("Person", ~admin), ("Admin", admin)):
+        tables.append(
+            ElementTable(
+                NodeMapping(
+                    id_key="id",
+                    implied_labels=frozenset({label}),
+                    property_mapping=(("id", "id"),),
+                ),
+                session.table_cls.from_arrays({"id": ids[mask]}),
+            )
+        )
+    for rtype, table in (
+        ("KNOWS", rel_edges(dense_e, 1 << 40)),
+        ("RARE", rel_edges(rare_e, 1 << 41)),
+    ):
+        tables.append(
+            ElementTable(
+                RelationshipMapping(
+                    id_key="id",
+                    source_key="source",
+                    target_key="target",
+                    rel_type=rtype,
+                ),
+                table,
+            )
+        )
+    schema = (
+        PropertyGraphSchema.empty()
+        .with_node_combination(frozenset({"Person"}), prop_types)
+        .with_node_combination(frozenset({"Admin"}), prop_types)
+        .with_relationship_type("KNOWS", {})
+        .with_relationship_type("RARE", {})
+    )
+    from tpu_cypher.relational.session import PropertyGraph
+
+    return PropertyGraph(session, ScanGraph(tables, schema))
+
+
+def _join_order_leg(session) -> dict:
+    """Optimizer-vs-syntax join-order speedup per query (the ISSUE-14 /
+    ROADMAP-2 acceptance measurement): wins_frac is the share of queries
+    the model's order beats syntax order, max_regression the worst
+    optimizer/syntax slowdown. Regression-gated in CI by
+    tests/test_optimizer.py on result equality; the timing ratios ride
+    the trajectory here. Never raises — an over-scaled or faulted leg
+    degrades to an error note."""
+    from tpu_cypher.utils.config import OPT_MODE
+
+    try:
+        g = _join_order_graph(session)
+        queries = {}
+        wins = 0
+        worst = 1.0
+        mismatches = 0
+        for name, query in _JOIN_ORDER_QUERIES:
+            OPT_MODE.set("syntax")
+            try:
+                dts, outs, _ = _time_query(g, query, repeats=3)
+            finally:
+                OPT_MODE.reset()
+            OPT_MODE.set("force")
+            try:
+                dto, outo, _ = _time_query(g, query, repeats=3)
+            finally:
+                OPT_MODE.reset()
+            match = outs == outo
+            speedup = dts / max(dto, 1e-9)
+            wins += speedup > 1.0
+            worst = min(worst, speedup)
+            mismatches += not match
+            queries[name] = {
+                "syntax_seconds": round(dts, 6),
+                "optimizer_seconds": round(dto, 6),
+                "speedup": round(speedup, 3),
+                "rows_match": match,
+            }
+        return {
+            "queries": queries,
+            "wins_frac": round(wins / len(_JOIN_ORDER_QUERIES), 3),
+            "max_regression": round(worst, 3),
+            "mismatches": mismatches,
+        }
+    except Exception as exc:  # fault-ok: telemetry only
+        return {"error": str(exc)[:200]}
+
+
 def main():
     force_cpu = os.environ.get("TPU_CYPHER_BENCH_FORCE_CPU") == "1"
     timeouts = [
@@ -922,6 +1063,10 @@ def main():
         # bucket lattice ({qps_1d, qps_8d, scaling_efficiency,
         # shard_recompiles})
         "mesh_scaling": _mesh_scaling(),
+        # cost-based optimizer health: per-query optimizer-vs-syntax
+        # join-order speedups ({queries, wins_frac, max_regression,
+        # mismatches}) — the ISSUE-14 acceptance measurement
+        "join_order": _join_order_leg(session),
         "probe_log": probe_log,
     }
     print(json.dumps(result))

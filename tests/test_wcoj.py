@@ -495,7 +495,10 @@ def test_bench_wcoj_vs_binary_rung():
     assert gated["clique4"]["wcoj_seconds"] is None
     assert gated["clique4"]["binary_seconds"] is None
     assert "over budget" in gated["clique4"]["skipped"]
-    # triangle's lean count-tier lanes get x8 slack; clique4 gets none
+    # both WCOJ count legs are lean count-tier lanes now (the multi-close
+    # count tier answers clique4 without materializing the 3-walk set),
+    # so both get the x8 slack — but clique4's BINARY sub-leg still
+    # materializes fat 3-walk rows and keeps the no-slack bound
     near = bench._wcoj_vs_binary(
         g,
         feasible_binary=True,
@@ -503,4 +506,6 @@ def test_bench_wcoj_vs_binary_rung():
         budget_rows=1_000_000,
     )
     assert near["triangle"]["wcoj_seconds"] > 0
-    assert near["clique4"]["wcoj_seconds"] is None
+    assert near["clique4"]["wcoj_seconds"] > 0
+    assert near["clique4"]["binary_seconds"] is None
+    assert near["clique4"]["binary_skipped"]

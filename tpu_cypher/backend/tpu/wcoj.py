@@ -591,6 +591,7 @@ class MultiwayIntersectOp(_FusedExpandBase):
         if (
             not self.header.expressions
             and not self.enforced_pairs
+            and len(self.closes) == 1
             and WCOJ_MODE.get().strip().lower() != "force"
             and _fused_binary_count_available(gi)
         ):
@@ -602,6 +603,11 @@ class MultiwayIntersectOp(_FusedExpandBase):
             # measure faster than sum(min-deg) probing. Auto mode hands
             # the count back to the classic plan; force keeps the pure
             # WCOJ path (the bench's wcoj-vs-binary rung, differentials).
+            # ONLY single-close shapes hand back: the classic fused tiers
+            # count one cycle close, so a multi-close count (clique4+)
+            # would shadow into the materialized blowup (the 878M-row
+            # r06 note) when `_count`'s range-count products answer it
+            # without materializing anything.
             raise GraphIndexError(
                 "fused binary count tier predicted faster: shadow answers"
             )
@@ -653,7 +659,8 @@ def _est_binary_blowup(gi: GraphIndex, ctx, types_key, rev: bool) -> int:
     over the pivot: edges(pivot types) * max_degree(pivot orientation) —
     each frontier row of an edge-shaped input can expand by up to the max
     degree before the close filters. Host-cached per (types, orientation);
-    the EmptyHeaded-style rule compares it against TPU_CYPHER_WCOJ_MIN_ROWS."""
+    the EmptyHeaded-style rule compares it against the cost model's
+    per-graph routing threshold (``optimizer.cost.wcoj_threshold``)."""
     cache = getattr(gi, "_wcoj_est", None)
     if cache is None:
         cache = gi._wcoj_est = {}
@@ -679,7 +686,7 @@ def plan_multiway_intersect_fastpath(
     ``TPU_CYPHER_WCOJ=force`` routes every structural fit (differential
     tests), ``off`` disables routing entirely."""
     from ...relational.ops import CacheOp
-    from ...utils.config import WCOJ_MIN_ROWS, WCOJ_MODE
+    from ...utils.config import WCOJ_MODE
 
     mode = WCOJ_MODE.get().strip().lower()
     if mode not in ("auto", "force"):
@@ -753,8 +760,16 @@ def plan_multiway_intersect_fastpath(
         if gi.num_nodes == 0 or gi.num_nodes >= (1 << 30):
             return None
         if mode == "auto":
+            # the routing threshold is the cost model's, not the env
+            # constant: `wcoj_threshold` returns the measured per-graph
+            # crossover (intersect-vs-binary unit costs from profile
+            # feedback), honouring TPU_CYPHER_WCOJ_MIN_ROWS verbatim when
+            # the operator pinned it and reproducing the hand-tuned
+            # default exactly while uncalibrated
+            from ...optimizer.cost import prefer_wcoj
+
             est = _est_binary_blowup(gi, ctx, node.types_key, node.backwards)
-            if est <= int(WCOJ_MIN_ROWS.get()):
+            if not prefer_wcoj(est, graph_obj, ctx):
                 return None
     except (GraphIndexError, TpuBackendError):
         return None

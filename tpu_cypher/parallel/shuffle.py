@@ -298,14 +298,26 @@ def broadcast_join(
     left side LOCALLY, with NO collective in the join at all (the engines'
     broadcast join, delegated to Catalyst in the reference; SURVEY §2.3
     "broadcast small relations"). Returns matching global row-index pairs,
-    or None when no mesh is active or the build side exceeds
-    ``TPU_CYPHER_BROADCAST_LIMIT`` rows (default 4096)."""
+    or None when no mesh is active or the build side exceeds the cost
+    model's broadcast window (``optimizer.cost.broadcast_build_limit`` —
+    at least ``TPU_CYPHER_BROADCAST_LIMIT`` rows, default 4096, extended
+    past it when replication still beats repartitioning both sides; a
+    pinned env knob is honoured verbatim)."""
     mesh = current_mesh()
     nsh = mesh_size()
     if mesh is None or nsh <= 1:
         return None
     n_l, n_r = int(l_key.shape[0]), int(r_key.shape[0])
-    if n_l == 0 or n_r == 0 or n_r > _broadcast_limit():
+    try:
+        from ..optimizer.cost import broadcast_build_limit
+
+        limit = broadcast_build_limit(n_l, nsh)
+    except Exception as exc:
+        from ..errors import reraise_if_device
+
+        reraise_if_device(exc, site="shuffle.broadcast")
+        limit = _broadcast_limit()
+    if n_l == 0 or n_r == 0 or n_r > limit:
         return None
     from ..runtime.faults import fault_point
 

@@ -626,4 +626,7 @@ def plan_relational(
     driving_table=None,
     driving_header=None,
 ) -> RelationalOperator:
+    from ..optimizer.joinorder import maybe_reorder
+
+    logical_plan = maybe_reorder(logical_plan, ctx)
     return RelationalPlanner(ctx, driving_table, driving_header).process(logical_plan)

@@ -286,6 +286,7 @@ class CypherResult:
                     entry["duration_ms"] = round(dt * 1000, 3)
                     self.execution_log.append(entry)
                     self._emit_query_event(True, scope)
+                    self._observe_feedback(trace)
                     return recs
                 except Exception as exc:  # classified below; see errors.py
                     typed = ERR.classify(exc)
@@ -315,6 +316,28 @@ class CypherResult:
                             raise
                         raise typed from exc
         raise last_typed  # pragma: no cover - loop always returns/raises
+
+    def _observe_feedback(self, trace) -> None:
+        """Fold this query's operator spans (seconds, true/padded rows)
+        into the optimizer's per-graph calibration — the adaptive half of
+        the cost model. Advisory: a feedback failure never takes down a
+        query that just succeeded."""
+        graph = self._graph
+        if graph is None:
+            # internal results are not handed the ambient graph; the plan's
+            # leaf operators carry the resolved relational graph
+            graph = getattr(self.relational_plan, "graph", None)
+        if graph is None or trace is None:
+            return
+        try:
+            from ..optimizer import feedback as _feedback
+
+            base = getattr(graph, "_graph", graph)
+            _feedback.observe(trace, base, self.relational_plan.context)
+        except Exception as exc:
+            from .. import errors as ERR
+
+            ERR.reraise_if_device(exc, site="optimizer.feedback")
 
     def _emit_query_event(self, ok: bool, scope) -> None:
         """One schema-versioned JSON line per finished query to the
@@ -894,13 +917,18 @@ class CypherSession:
             if v is not None and not isinstance(v, (bool, int, float, str)):
                 return None
             psig.append((k, type(v).__name__))
-        # plan-SHAPE config is part of the key: WCOJ routing happens at
-        # plan time, so flipping TPU_CYPHER_WCOJ between calls (the bench's
-        # wcoj-vs-binary legs, serve-tier overrides) must not replay a
-        # stale cached plan
+        # plan-SHAPE config is part of the key: WCOJ routing and join-order
+        # choice happen at plan time, so flipping TPU_CYPHER_WCOJ or
+        # TPU_CYPHER_OPT between calls (the bench's wcoj-vs-binary and
+        # join-order legs, serve-tier overrides) must not replay a stale
+        # cached plan. Calibration drift is deliberately NOT in the key:
+        # a cached plan stays pinned while feedback accumulates (zero warm
+        # recompiles); a replan under new calibration needs a mode flip or
+        # cache eviction.
         plan_cfg = (
             _config.WCOJ_MODE.get().strip().lower(),
             int(_config.WCOJ_MIN_ROWS.get()),
+            _config.OPT_MODE.get().strip().lower(),
         )
         return (query, id(graph._graph), tuple(psig), plan_cfg)
 

@@ -5,8 +5,10 @@ once. It is the serving-layer face of the engine's existing admission
 machinery:
 
 * **cost ordering** — each query carries a padded-memory cost estimate
-  (``estimate_cost_bytes``: scan rows x pattern fan-out, rounded up the
-  bucket lattice exactly like a real materialize would be). Cheap queries
+  (``estimate_cost_bytes``, delegating to the optimizer's cost model:
+  statistics-fed per-hop fanout when the graph has them, the legacy scan
+  rows x pattern fan-out proxy otherwise, rounded up the bucket lattice
+  exactly like a real materialize would be). Cheap queries
   are never starved behind a giant analytical scan; among one tenant's
   waiters, the smallest padded footprint runs first.
 * **per-tenant fairness** — the next slot goes to the waiting tenant with
@@ -79,13 +81,26 @@ def _graph_rows(g) -> int:
 
 
 def estimate_cost_bytes(graph, query: str) -> int:
-    """Padded-memory cost of a query: base scan rows x (1 + relationship
-    count in the pattern text), rounded up the active bucket lattice, at a
-    nominal bytes-per-row. Deliberately crude — it only needs to ORDER
-    queries (and trip the HBM budget for the hopeless ones), not predict
-    footprints; the real per-materialize admission still happens inside
-    execution at every count sync."""
-    rows = _graph_rows(getattr(graph, "_graph", graph))
+    """Padded-memory cost of a query, priced by the optimizer's cost
+    model (``optimizer.cost.estimate_query_cost_bytes``): real per-hop
+    fanout when the graph carries statistics, the legacy
+    rows x (1 + relationship count) proxy otherwise — either way on the
+    active bucket lattice at a nominal bytes-per-row. It only needs to
+    ORDER queries (and trip the HBM budget for the hopeless ones), not
+    predict footprints; the real per-materialize admission still happens
+    inside execution at every count sync."""
+    base = getattr(graph, "_graph", graph)
+    rows = _graph_rows(base)
+    try:
+        from ..optimizer.cost import estimate_query_cost_bytes
+
+        return estimate_query_cost_bytes(
+            base, query, fallback_rows=rows, bytes_per_row=_EST_BYTES_PER_ROW
+        )
+    except Exception as exc:
+        from ..errors import reraise_if_device
+
+        reraise_if_device(exc, site="serve.estimate")
     fanout = 1 + query.count("]")  # each -[..]- pattern closes one bracket
     est_rows = max(rows, 1) * max(fanout, 1)
     return bucketing.round_size(est_rows) * _EST_BYTES_PER_ROW

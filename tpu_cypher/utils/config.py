@@ -57,6 +57,15 @@ class ConfigOption(Generic[T]):
     def reset(self):
         self._override = None
 
+    @property
+    def overridden(self) -> bool:
+        """True when the operator pinned this knob explicitly — an
+        in-process ``set()`` or a live environment variable. Adaptive
+        layers (the cost-based optimizer) treat an overridden knob as a
+        hand-tuned constant to respect, and only substitute their own
+        modelled value for knobs still at the declared default."""
+        return self._override is not None or self.name in os.environ
+
     def __repr__(self) -> str:  # pragma: no cover - debugging nicety
         return f"ConfigOption({self.name!r}, default={self.default!r})"
 
@@ -196,6 +205,40 @@ WCOJ_MIN_ROWS = declare(
     int,
     help="auto mode routes a cyclic pattern to WCOJ only when the "
     "estimated binary-join intermediate exceeds this many rows",
+)
+
+# cost-based adaptive query optimizer (tpu_cypher/optimizer/)
+OPT_MODE = declare(
+    "TPU_CYPHER_OPT",
+    "auto",
+    str,
+    help="cost-based join-order optimizer: auto (apply the padded-lattice "
+    "cost model's plan when it predicts a win) | syntax (keep the "
+    "syntax-driven order — pre-PR-14 behavior) | force (always apply the "
+    "model's chosen order, even on ties; differential tests)",
+)
+OPT_DP_MAX_RELS = declare(
+    "TPU_CYPHER_OPT_DP_MAX_RELS",
+    8,
+    int,
+    help="pattern-size ceiling for exact DP join-order enumeration over "
+    "connected subpatterns; larger patterns use the greedy fallback",
+)
+OPT_MARGIN = declare(
+    "TPU_CYPHER_OPT_MARGIN",
+    0.9,
+    float,
+    help="auto mode applies a reordered plan only when its modelled cost "
+    "is below margin x the syntax-order cost (hysteresis against churning "
+    "plans on estimate noise); force ignores the margin",
+)
+OPT_FEEDBACK = declare(
+    "TPU_CYPHER_OPT_FEEDBACK",
+    "on",
+    str,
+    help="adaptive feedback: fold result.profile() span timings and "
+    "true-vs-padded row counts back into per-graph calibration factors "
+    "(persisted beside the compile cache): on | off",
 )
 
 # sharded shuffle (parallel/shuffle.py)
