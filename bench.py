@@ -356,9 +356,11 @@ def _serve_soak() -> dict:
     clients, admission scheduling, micro-batching) distilled to the four
     numbers that regress: qps, p99_ms, recompiles_after_warmup (must stay
     0: the serving layer adds no shape churn), batched_dispatch_ratio
-    (must stay > 0: bursts still coalesce). Like ``lint_clean``, never
-    raises — a broken server reports {"error": ...} in the same JSON
-    line instead of killing the bench."""
+    (must stay > 0: bursts still coalesce), plus the result-cache leg
+    (pre-cache baseline vs cached qps under repeat traffic) and the
+    cursor-streaming leg (large result under the fixed RSS ceiling).
+    Like ``lint_clean``, never raises — a broken server reports
+    {"error": ...} in the same JSON line instead of killing the bench."""
     try:
         tests_dir = os.path.join(
             os.path.dirname(os.path.abspath(__file__)), "tests"
@@ -377,6 +379,24 @@ def _serve_soak() -> dict:
         }
     except Exception as exc:  # fault-ok: telemetry only
         return {"error": str(exc)[:200]}
+    # result-cache leg: the same soak under dashboard-shaped traffic
+    # (each client repeats its previous submission half the time), cache
+    # off vs on — the honest pre-cache baseline and the speedup over it,
+    # plus the hit ratio that explains the gap
+    try:
+        pre = soak_serve.main(budget_s=4.0, clients=24, repeat_ratio=0.5,
+                              cache_bytes=0)
+        hot = soak_serve.main(budget_s=4.0, clients=24, repeat_ratio=0.5)
+        out["cache"] = {
+            "qps_precache": pre["qps"],
+            "qps_cached": hot["qps"],
+            "speedup": round(hot["qps"] / max(pre["qps"], 1e-9), 2),
+            "cache_hit_ratio": hot["cache_hit_ratio"],
+            "failures": pre["failures"] + hot["failures"],
+        }
+    except Exception as exc:  # fault-ok: telemetry only
+        out["cache"] = {"error": str(exc)[:200]}
+    out["streaming"] = _serve_streaming()
     # cluster legs: the same soak through the multi-process router at 1
     # and 2 workers, under SIGKILL chaos at 2 — tracks whether replica
     # fan-out scales (scaling_efficiency = qps_2 / (2 * qps_1)) and
@@ -400,6 +420,91 @@ def _serve_soak() -> dict:
     except Exception as exc:  # fault-ok: telemetry only
         out["cluster"] = {"error": str(exc)[:200]}
     return out
+
+
+_SERVE_STREAMING_CODE = r"""
+import asyncio, json, resource, time
+
+from tpu_cypher.relational.session import CypherSession
+from tpu_cypher.serve import QueryServer
+
+
+def peak_rss_mb():
+    # VmHWM, not ru_maxrss: a forked child's ru_maxrss starts at the
+    # PARENT's resident size on Linux, polluting the reading
+    try:
+        with open("/proc/self/status") as f:
+            for line in f:
+                if line.startswith("VmHWM:"):
+                    return int(line.split()[1]) // 1024
+    except OSError:
+        pass
+    return resource.getrusage(resource.RUSAGE_SELF).ru_maxrss // 1024
+
+N = 64  # N**3 = 262,144 rows through the cursor protocol
+
+async def main():
+    session = CypherSession.tpu()
+    parts = [f"(n{i}:P {{id: {i}}})" for i in range(N)]
+    graph = session.create_graph_from_create_query("CREATE " + ", ".join(parts))
+    server = QueryServer(session, port=0)
+    server.register_graph("g", graph)
+    total, t0 = 0, None
+    async with server:
+        reader, writer = await asyncio.open_connection(server.host, server.port)
+        sub = {"op": "submit", "id": "s", "graph": "g", "stream": True,
+               "query": "MATCH (a:P), (b:P), (c:P) "
+                        "RETURN a.id AS x, b.id AS y, c.id AS z"}
+        writer.write((json.dumps(sub) + "\n").encode())
+        await writer.drain()
+        t0 = time.perf_counter()
+        while True:
+            msg = json.loads(await asyncio.wait_for(reader.readline(), 120))
+            t = msg.get("type")
+            if t == "rows":
+                total += len(msg["rows"])
+                writer.write((json.dumps({"op": "next", "id": "s"}) + "\n")
+                             .encode())
+                await writer.drain()
+            elif t == "done":
+                break
+            elif t != "accepted":
+                raise RuntimeError(json.dumps(msg)[:200])
+        seconds = time.perf_counter() - t0
+        writer.close()
+    print(json.dumps({"rows": total, "peak_rss_mb": peak_rss_mb(),
+                      "seconds": round(seconds, 3)}))
+
+asyncio.run(main())
+"""
+
+
+def _serve_streaming() -> dict:
+    """Cursor-streaming health: one large result (262k rows) pulled
+    through the credit-window protocol in a subprocess (the memory
+    high-water mark is process-lifetime, so the ceiling must be measured
+    in its own process). Reports the fixed host-memory ceiling the test
+    suite pins and the delivered row throughput. Never raises."""
+    try:
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        # a forced multi-device host platform (virtual-mesh test envs)
+        # would multiply every device buffer; the ceiling is one-device
+        env.pop("XLA_FLAGS", None)
+        proc = subprocess.run(
+            [sys.executable, "-c", _SERVE_STREAMING_CODE],
+            capture_output=True, text=True, timeout=420, env=env,
+        )
+        if proc.returncode != 0:
+            return {"error": (proc.stderr or proc.stdout)[-200:]}
+        rep = json.loads(proc.stdout.strip().splitlines()[-1])
+        return {
+            "rows": rep["rows"],
+            "peak_rss_mb": rep["peak_rss_mb"],
+            "ceiling_mb": 768,  # the pin in tests/test_serve.py
+            "throughput_rows_s": int(rep["rows"] / max(rep["seconds"], 1e-9)),
+        }
+    except Exception as exc:  # fault-ok: telemetry only
+        return {"error": str(exc)[:200]}
 
 
 _MESH_SCALING_CODE = r"""

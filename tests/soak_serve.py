@@ -40,6 +40,14 @@ line prefixed ``SERVE_SOAK``:
   at least one alive). The invariants stay absolute: ZERO client-visible
   failures and every row set byte-identical to serial execution — dead
   workers are the router's problem, not the clients'.
+* ``--repeat-ratio R`` — each client re-issues its previous submission
+  with probability R (the dashboard-refresh traffic shape the result
+  cache exists for). The report gains ``cached_queries`` /
+  ``cache_hit_ratio``; run with ``--cache-bytes 0`` for the honest
+  pre-cache baseline at the same ratio.
+* ``stage_breakdown`` — accumulated wall seconds per serving stage
+  (queue_wait / route / dispatch / serialize / demux), the latency
+  attribution table in docs/serving.md.
 
 ``bench.py`` imports ``main()`` for its ``serve_soak`` summary field.
 """
@@ -105,13 +113,22 @@ def _random_fault_spec(rng) -> str:
     return f"{kind}@{site}:{occ}"
 
 
-async def _client(i, host, port, t_end, combos, goldens, rng, chaos, stats):
+async def _client(i, host, port, t_end, combos, goldens, rng, chaos, stats,
+                  repeat_ratio=0.0):
     reader, writer = await asyncio.open_connection(host, port)
     tenant = f"t{i % 4}"
     k = 0
+    prev = None
     try:
         while time.monotonic() < t_end:
-            q, params = combos[int(rng.integers(0, len(combos)))]
+            # with --repeat-ratio, re-issue the previous submission (the
+            # dashboard-refresh shape the result cache exists for);
+            # otherwise draw fresh from the corpus
+            if prev is not None and rng.random() < repeat_ratio:
+                q, params = prev
+            else:
+                q, params = combos[int(rng.integers(0, len(combos)))]
+            prev = (q, params)
             qid = f"c{i}-{k}"
             k += 1
             sub = {"op": "submit", "id": qid, "graph": "soak", "query": q,
@@ -147,14 +164,22 @@ async def _client(i, host, port, t_end, combos, goldens, rng, chaos, stats):
                 stats["errors"].append(
                     f"{qid} {q!r} params={params}: rows diverged from serial"
                 )
-            elif terminal.get("batched", 1) > 1:
-                stats["batched_queries"] += 1
+            else:
+                if terminal.get("batched", 1) > 1:
+                    stats["batched_queries"] += 1
+                if terminal.get("cached"):
+                    stats["cached_queries"] += 1
     finally:
         writer.close()
 
 
 def _pkey(params):
     return tuple(sorted(params.items()))
+
+
+def _hit_ratio(hits, misses):
+    total = hits + misses
+    return round(hits / total, 4) if total else None
 
 
 async def _worker_killer(supervisor, t_end, kills, period_s=2.0):
@@ -179,13 +204,15 @@ async def _worker_killer(supervisor, t_end, kills, period_s=2.0):
 def main(budget_s: float = 20.0, clients: int = 100, chaos: bool = False,
          seed: int = 0, batch_window_ms: float = 5.0,
          max_concurrent: int = 8, workers: int = 0,
-         kill_workers: bool = False) -> dict:
+         kill_workers: bool = False, repeat_ratio: float = 0.0,
+         cache_bytes=None) -> dict:
     import numpy as np
 
     from tpu_cypher.backend.tpu import bucketing
     from tpu_cypher.relational.session import CypherSession
     from tpu_cypher.serve import ClusterServer, QueryServer
     from tpu_cypher.serve.batching import DISPATCHES
+    from tpu_cypher.serve.result_cache import HITS, MISSES
     from tpu_cypher.serve.router import REPLICA_RETRIES
     from tpu_cypher.serve.server import _encode_rows
 
@@ -193,7 +220,7 @@ def main(budget_s: float = 20.0, clients: int = 100, chaos: bool = False,
     if workers > 0:
         server = ClusterServer(
             workers=workers, port=0, max_concurrent=max_concurrent * workers,
-            batch_window_ms=batch_window_ms,
+            batch_window_ms=batch_window_ms, cache_bytes=cache_bytes,
         )
         server.register_graph("soak", _create_query())
         # worker-side warmup: the unparameterized corpus shapes (readiness
@@ -205,7 +232,7 @@ def main(budget_s: float = 20.0, clients: int = 100, chaos: bool = False,
         graph = _build_graph(session)
         server = QueryServer(
             session, port=0, max_concurrent=max_concurrent,
-            batch_window_ms=batch_window_ms,
+            batch_window_ms=batch_window_ms, cache_bytes=cache_bytes,
         )
         server.register_graph("soak", graph)
 
@@ -220,12 +247,13 @@ def main(budget_s: float = 20.0, clients: int = 100, chaos: bool = False,
 
     async def run():
         stats = {"queries": 0, "failures": 0, "batched_queries": 0,
-                 "latencies": [], "errors": []}
+                 "cached_queries": 0, "latencies": [], "errors": []}
         kills = []
         disp_before = {
             lbl["batched"]: int(v) for lbl, v in DISPATCHES.items()
         }
         retries_before = sum(int(v) for _, v in REPLICA_RETRIES.items())
+        hits_before, misses_before = int(HITS.value()), int(MISSES.value())
         compiles_before = bucketing.compile_snapshot()
         async with server:
             # clock starts AFTER the server (and, in cluster mode, every
@@ -234,7 +262,7 @@ def main(budget_s: float = 20.0, clients: int = 100, chaos: bool = False,
             tasks = [
                 _client(i, server.host, server.port, t0 + budget_s, combos,
                         goldens, np.random.default_rng(seed + i), chaos,
-                        stats)
+                        stats, repeat_ratio=repeat_ratio)
                 for i in range(clients)
             ]
             if kill_workers and workers > 0:
@@ -266,6 +294,17 @@ def main(budget_s: float = 20.0, clients: int = 100, chaos: bool = False,
             ),
             "batched_dispatch_ratio": round(disp["true"] / total_disp, 4),
             "batched_queries": stats["batched_queries"],
+            "cached_queries": stats["cached_queries"],
+            "cache_hit_ratio": _hit_ratio(
+                int(HITS.value()) - hits_before,
+                int(MISSES.value()) - misses_before,
+            ),
+            "repeat_ratio": repeat_ratio,
+            # where the non-engine time went: accumulated wall seconds per
+            # serving stage (docs/serving.md, "Latency attribution")
+            "stage_breakdown": {
+                k: round(v, 3) for k, v in sorted(server.stages.items())
+            },
             "chaos": chaos,
             "workers": workers,
             "errors": stats["errors"][:10],
@@ -287,6 +326,7 @@ def main(budget_s: float = 20.0, clients: int = 100, chaos: bool = False,
 if __name__ == "__main__":
     argv = sys.argv[1:]
     chaos, kill_workers, workers, args = False, False, 0, []
+    repeat_ratio, cache_bytes = 0.0, None
     i = 0
     while i < len(argv):
         a = argv[i]
@@ -299,6 +339,16 @@ if __name__ == "__main__":
             workers = int(argv[i])
         elif a.startswith("--workers="):
             workers = int(a.split("=", 1)[1])
+        elif a == "--repeat-ratio":
+            i += 1
+            repeat_ratio = float(argv[i])
+        elif a.startswith("--repeat-ratio="):
+            repeat_ratio = float(a.split("=", 1)[1])
+        elif a == "--cache-bytes":
+            i += 1
+            cache_bytes = int(argv[i])
+        elif a.startswith("--cache-bytes="):
+            cache_bytes = int(a.split("=", 1)[1])
         else:
             args.append(a)
         i += 1
@@ -307,7 +357,8 @@ if __name__ == "__main__":
     budget = float(args[0]) if len(args) > 0 else 20.0
     clients = int(args[1]) if len(args) > 1 else 100
     report = main(budget, clients, chaos=chaos, workers=workers,
-                  kill_workers=kill_workers)
+                  kill_workers=kill_workers, repeat_ratio=repeat_ratio,
+                  cache_bytes=cache_bytes)
     errors = report.pop("errors")
     print("SERVE_SOAK " + json.dumps(report))
     for e in errors:

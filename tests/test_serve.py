@@ -1,7 +1,7 @@
 """The multi-tenant query server (``tpu_cypher/serve/``): admission
 scheduling, micro-batching, isolation, and the observability surfaces.
 
-Three layers of coverage:
+Layers of coverage:
 
 * **scheduler/batcher units** — pure asyncio, no engine: cost ordering,
   tenant fairness, quotas, queued-deadline expiry, coalescing semantics.
@@ -9,13 +9,22 @@ Three layers of coverage:
   JSON protocol, per-query results byte-identical to serial execution,
   same-bucket bursts sharing one dispatch, chaos queries degrading
   without contaminating clean neighbors.
+* **result cache** — zero-dispatch hits byte-identical to the original
+  execution, fingerprint invalidation, LRU byte budget, and the
+  chaos/deadline exclusions.
+* **cursor streaming** — pull-based pages under the credit window,
+  early close, backpressure isolation, and a subprocess pin that a
+  >1M-row result streams under a fixed host-memory ceiling.
 * **HTTP goldens** — ``GET /metrics`` byte-identical to the in-process
   ``session.metrics_text()``; ``GET /queries/<id>`` serving the span
-  tree JSON.
+  tree JSON; ``GET /cache`` + ``GET /cache/flush``.
 """
 
 import asyncio
 import json
+import os
+import subprocess
+import sys
 
 import pytest
 
@@ -25,6 +34,7 @@ from tpu_cypher.serve import (
     AdmissionScheduler,
     BatchWindow,
     QueryServer,
+    ResultCache,
     batch_key,
     estimate_cost_bytes,
 )
@@ -488,3 +498,402 @@ def test_http_healthz_and_404(session, graph):
     health = json.loads(hb)
     assert health["ok"] is True and health["graphs"] == ["g"]
     assert ns.endswith("404 Not Found")
+
+
+# ---------------------------------------------------------------------------
+# result cache
+# ---------------------------------------------------------------------------
+
+
+def test_result_cache_lru_byte_budget_eviction():
+    """Unit: the byte budget LRU-evicts, oversized and degraded payloads
+    never store, and a fingerprint mismatch is a miss that drops the
+    stale entry."""
+    p = {"rows": [{"id": 1}], "degraded": False}
+    one = len(json.dumps(p))
+    cache = ResultCache(max_bytes=2 * one)
+    assert cache.store("k1", "fp", p) and cache.store("k2", "fp", p)
+    assert cache.lookup("k1", "fp") is not None  # freshen k1
+    assert cache.store("k3", "fp", p)  # evicts k2 (LRU), not k1
+    assert cache.lookup("k2", "fp") is None
+    assert cache.lookup("k1", "fp") is not None
+    assert cache.lookup("k3", "fp") is not None
+    # fingerprint mismatch: miss AND the stale entry is gone
+    assert cache.lookup("k1", "other-fp") is None
+    assert cache.lookup("k1", "fp") is None
+    # exclusions: oversized, degraded, uncacheable (None key), non-JSON
+    assert not cache.store("big", "fp", {"rows": [{"id": i} for i in range(99)]})
+    assert not cache.store("deg", "fp", {"rows": [], "degraded": True})
+    assert not cache.store(None, "fp", p)
+    assert not cache.store("obj", "fp", {"rows": object()})
+    stats = cache.stats()
+    assert stats["entries"] == 1 and stats["bytes"] == one
+    assert cache.flush() == 1 and cache.stats()["bytes"] == 0
+
+
+def test_result_cache_disabled_by_zero_budget():
+    cache = ResultCache(max_bytes=0)
+    assert not cache.enabled
+    assert not cache.store("k", "fp", {"rows": []})
+    assert cache.lookup("k", "fp") is None
+
+
+def test_graph_fingerprint_tracks_statistics(session, graph):
+    """Graphs with different data fingerprint differently; the same graph
+    fingerprints stably."""
+    from tpu_cypher.serve.result_cache import graph_fingerprint
+
+    g2 = session.create_graph_from_create_query("CREATE (a:P {id: 0})")
+    fp = graph_fingerprint(session, graph)
+    assert fp == graph_fingerprint(session, graph)
+    assert fp != graph_fingerprint(session, g2)
+
+
+def test_cache_hit_byte_identical_and_zero_dispatch(session, graph):
+    """The tentpole property: a repeat read is served with ZERO device
+    dispatch (the batcher's dispatch counter does not move), in well
+    under a millisecond, with zero compile movement, and its row pages
+    are byte-identical to the original execution's."""
+    from tpu_cypher.serve.batching import DISPATCHES
+
+    async def run():
+        async with _serve(session, graph) as srv:
+            m1 = await _client(srv.host, srv.port, [
+                {"op": "submit", "id": "c1", "graph": "g", "query": ROWS_Q},
+            ])
+            before = sum(int(v) for _, v in DISPATCHES.items())
+            m2 = await _client(srv.host, srv.port, [
+                {"op": "submit", "id": "c2", "graph": "g", "query": ROWS_Q},
+            ])
+            after = sum(int(v) for _, v in DISPATCHES.items())
+            _, rec = await _http(srv.host, srv.port, "/queries/c2")
+        return m1, m2, after - before, json.loads(rec)
+
+    m1, m2, dispatches, rec = asyncio.run(run())
+    d1, d2 = _terminals(m1)["c1"], _terminals(m2)["c2"]
+    assert d1["cached"] is False and d2["cached"] is True
+    assert dispatches == 0  # the hit never reached the batcher
+    assert d2["seconds"] < 0.001  # served from host memory, sub-ms
+    assert rec["cached"] is True and rec["compile_stats"] == {}
+    # the hit's profile is a synthesized single-span cache trace
+    assert rec["profile"]["root"]["children"][0]["name"] == "cache"
+    # row pages byte-identical to the original execution's
+    pages1 = json.dumps(_rows_of(m1, "c1"), sort_keys=True)
+    pages2 = json.dumps(_rows_of(m2, "c2"), sort_keys=True)
+    assert pages1 == pages2
+
+
+def test_cache_excludes_chaos_and_deadline_queries(session, graph):
+    """Chaos-injected and deadline-carrying queries neither hit nor
+    populate: their state is client-scoped (and degraded payloads are
+    refused at store time regardless)."""
+
+    async def run():
+        async with _serve(session, graph) as srv:
+            await _client(srv.host, srv.port, [
+                {"op": "submit", "id": "f1", "graph": "g", "query": HOP_Q,
+                 "faults": "oom@expand:*"},
+                {"op": "submit", "id": "d1", "graph": "g", "query": HOP_Q,
+                 "deadline_s": 30.0},
+            ])
+            entries = srv.cache.stats()["entries"]
+            # a later clean repeat of the same text is a genuine miss
+            m = await _client(srv.host, srv.port, [
+                {"op": "submit", "id": "f2", "graph": "g", "query": HOP_Q},
+            ])
+        return entries, m
+
+    entries, m = asyncio.run(run())
+    assert entries == 0
+    assert _terminals(m)["f2"]["cached"] is False
+
+
+def test_cache_fingerprint_mismatch_invalidates(session, graph):
+    """A lookup under a changed statistics fingerprint is a miss that
+    evicts the stale entry — the graph-change invalidation path (a
+    re-registered graph object also changes the batch key itself; the
+    fingerprint guards in-place drift)."""
+
+    async def run():
+        async with _serve(session, graph) as srv:
+            await _client(srv.host, srv.port, [
+                {"op": "submit", "id": "i1", "graph": "g", "query": COUNT_Q},
+            ])
+            assert srv.cache.stats()["entries"] == 1
+            srv._fingerprints["g"] = "stats-changed"
+            m = await _client(srv.host, srv.port, [
+                {"op": "submit", "id": "i2", "graph": "g", "query": COUNT_Q},
+            ])
+            entries = srv.cache.stats()["entries"]
+        return m, entries
+
+    m, entries = asyncio.run(run())
+    assert _terminals(m)["i2"]["cached"] is False
+    assert entries == 1  # re-populated under the new fingerprint
+
+
+def test_cache_batched_burst_populates_then_hits(session, graph):
+    """A coalesced burst executes once AND populates; a straggler after
+    the window is a pure cache hit tagged ``cached`` — not another batch."""
+    from tpu_cypher.serve.batching import DISPATCHES
+
+    async def run():
+        async with _serve(session, graph, batch_window_ms=50) as srv:
+            burst = await _client(srv.host, srv.port, [
+                {"op": "submit", "id": f"s{i}", "graph": "g", "query": COUNT_Q}
+                for i in range(3)
+            ])
+            before = sum(int(v) for _, v in DISPATCHES.items())
+            late = await _client(srv.host, srv.port, [
+                {"op": "submit", "id": "late", "graph": "g", "query": COUNT_Q},
+            ])
+            after = sum(int(v) for _, v in DISPATCHES.items())
+        return burst, late, after - before
+
+    burst, late, dispatches = asyncio.run(run())
+    dones = _terminals(burst)
+    assert {d["batched"] for d in dones.values()} == {3}
+    assert all(d["cached"] is False for d in dones.values())
+    d = _terminals(late)["late"]
+    assert d["cached"] is True and dispatches == 0
+    assert _rows_of(late, "late") == _rows_of(burst, "s0")
+
+
+def test_http_cache_stats_and_flush(session, graph):
+    async def run():
+        async with _serve(session, graph) as srv:
+            await _client(srv.host, srv.port, [
+                {"op": "submit", "id": "h1", "graph": "g", "query": COUNT_Q},
+                {"op": "submit", "id": "h2", "graph": "g", "query": COUNT_Q},
+            ])
+            _, stats = await _http(srv.host, srv.port, "/cache")
+            _, flushed = await _http(srv.host, srv.port, "/cache/flush")
+            _, stats2 = await _http(srv.host, srv.port, "/cache")
+        return json.loads(stats), json.loads(flushed), json.loads(stats2)
+
+    stats, flushed, stats2 = asyncio.run(run())
+    assert stats["entries"] == 1 and stats["bytes"] > 0
+    assert stats["max_bytes"] > 0
+    assert flushed == {"flushed": 1}
+    assert stats2["entries"] == 0 and stats2["bytes"] == 0
+
+
+# ---------------------------------------------------------------------------
+# cursor streaming
+# ---------------------------------------------------------------------------
+
+# 16^3 = 4096 rows -> 16 pages of PAGE_ROWS=256: enough to exercise the
+# credit window without being slow
+CROSS_Q = "MATCH (a:P), (b:P), (c:P) RETURN a.id AS x, b.id AS y, c.id AS z"
+
+
+async def _stream_client(host, port, submit, close_after=None):
+    """Drive one streaming query: ack every page (``next``), optionally
+    closing the cursor after ``close_after`` pages. Returns all messages."""
+    reader, writer = await asyncio.open_connection(host, port)
+
+    async def send(obj):
+        writer.write((json.dumps(obj) + "\n").encode())
+        await writer.drain()
+
+    await send(submit)
+    msgs, pages = [], 0
+    while True:
+        msg = json.loads(await asyncio.wait_for(reader.readline(), 30))
+        msgs.append(msg)
+        if msg.get("type") == "rows":
+            pages += 1
+            if close_after is not None and pages >= close_after:
+                await send({"op": "close", "id": submit["id"]})
+                close_after = None
+            else:
+                await send({"op": "next", "id": submit["id"]})
+        if msg.get("type") in ("done", "error", "cancelled"):
+            break
+    writer.close()
+    return msgs
+
+
+def test_stream_rows_match_eager_and_zero_row_parity(session, graph):
+    """Streamed pages reassemble to exactly the eager path's rows; a
+    zero-row stream still sends one empty rows frame (protocol parity)."""
+
+    async def run():
+        async with _serve(session, graph) as srv:
+            s = await _stream_client(srv.host, srv.port, {
+                "op": "submit", "id": "st", "graph": "g", "query": ROWS_Q,
+                "stream": True,
+            })
+            e = await _client(srv.host, srv.port, [
+                {"op": "submit", "id": "ea", "graph": "g", "query": ROWS_Q},
+            ])
+            z = await _stream_client(srv.host, srv.port, {
+                "op": "submit", "id": "zz", "graph": "g",
+                "query": "MATCH (a:P {id: 99}) RETURN a.id AS id",
+                "stream": True,
+            })
+        return s, e, z
+
+    s, e, z = asyncio.run(run())
+    d = _terminals(s)["st"]
+    assert d["streamed"] is True and d["cached"] is False
+    assert d["rows"] == d["total_rows"] == 2
+    assert json.dumps(_rows_of(s, "st")) == json.dumps(_rows_of(e, "ea"))
+    zd = _terminals(z)["zz"]
+    assert zd["rows"] == 0
+    assert [m["rows"] for m in z if m["type"] == "rows"] == [[]]
+
+
+def test_stream_close_ends_delivery_early(session, graph):
+    """``close`` after the first page: delivery stops, the query
+    terminates ``done`` with only the rows sent so far."""
+
+    async def run():
+        async with _serve(session, graph) as srv:
+            return await _stream_client(srv.host, srv.port, {
+                "op": "submit", "id": "cl", "graph": "g", "query": CROSS_Q,
+                "stream": True,
+            }, close_after=1)
+
+    msgs = asyncio.run(run())
+    d = _terminals(msgs)["cl"]
+    assert d["total_rows"] == 4096
+    assert 0 < d["rows"] < 4096  # ended early, not exhausted
+
+
+def test_stream_backpressure_parks_only_its_cursor(session, graph, monkeypatch):
+    """A consumer that never grants credit parks its cursor after exactly
+    ``window`` pages — while the event loop keeps serving other clients.
+    ``close`` then releases it."""
+    import tpu_cypher.serve.server as SRV
+
+    monkeypatch.setenv("TPU_CYPHER_SERVE_STREAM_WINDOW", "2")
+
+    async def run():
+        async with _serve(session, graph) as srv:
+            reader, writer = await asyncio.open_connection(srv.host, srv.port)
+
+            async def send(obj):
+                writer.write((json.dumps(obj) + "\n").encode())
+                await writer.drain()
+
+            async def recv():
+                return json.loads(await asyncio.wait_for(reader.readline(), 30))
+
+            before = SRV.BACKPRESSURE_WAITS.value()
+            await send({"op": "submit", "id": "bp", "graph": "g",
+                        "query": CROSS_Q, "stream": True})
+            assert (await recv())["type"] == "accepted"
+            pages = [await recv(), await recv()]  # the full window, no acks
+            assert all(m["type"] == "rows" for m in pages)
+            # the cursor must now be parked awaiting credit
+            for _ in range(100):
+                if SRV.BACKPRESSURE_WAITS.value() > before:
+                    break
+                await asyncio.sleep(0.01)
+            waits = SRV.BACKPRESSURE_WAITS.value() - before
+            # ... and the loop still serves other clients meanwhile
+            other = await _client(srv.host, srv.port, [
+                {"op": "submit", "id": "ok", "graph": "g", "query": COUNT_Q},
+            ])
+            await send({"op": "close", "id": "bp"})
+            tail = []
+            while True:
+                m = await recv()
+                tail.append(m)
+                if m.get("type") in ("done", "error", "cancelled"):
+                    break
+            writer.close()
+        return waits, other, pages + tail
+
+    waits, other, msgs = asyncio.run(run())
+    assert waits >= 1
+    assert _terminals(other)["ok"]["type"] == "done"
+    d = _terminals(msgs)["bp"]
+    # exactly the window's worth of pages went out before the park
+    assert sum(1 for m in msgs if m.get("type") == "rows") == 2
+    assert d["rows"] == 512 and d["total_rows"] == 4096
+
+
+# the subprocess pin: a >1M-row result (108^3 = 1,259,712 rows) streamed
+# to a deliberately slow consumer must stay under a fixed host-memory
+# ceiling. Runs in its own process because the high-water mark is
+# process-lifetime; measured via /proc/self/status VmHWM, NOT ru_maxrss —
+# on Linux a forked child's ru_maxrss starts at the PARENT's resident
+# size, so under a multi-GB pytest parent it reports the suite's
+# footprint instead of the stream's.
+_RSS_CEILING_MB = 768
+_RSS_SCRIPT = r"""
+import asyncio, json, resource, sys
+
+
+def peak_rss_mb():
+    try:
+        with open("/proc/self/status") as f:
+            for line in f:
+                if line.startswith("VmHWM:"):
+                    return int(line.split()[1]) // 1024
+    except OSError:
+        pass  # non-Linux: fall back, accepting the fork-inherited baseline
+    return resource.getrusage(resource.RUSAGE_SELF).ru_maxrss // 1024
+
+from tpu_cypher.relational.session import CypherSession
+from tpu_cypher.serve import QueryServer
+
+N = 108  # N**3 = 1,259,712 rows
+
+async def main():
+    session = CypherSession.tpu()
+    parts = [f"(n{i}:P {{id: {i}}})" for i in range(N)]
+    graph = session.create_graph_from_create_query("CREATE " + ", ".join(parts))
+    server = QueryServer(session, port=0)
+    server.register_graph("g", graph)
+    total, done = 0, None
+    async with server:
+        reader, writer = await asyncio.open_connection(server.host, server.port)
+        sub = {"op": "submit", "id": "big", "graph": "g", "stream": True,
+               "query": "MATCH (a:P), (b:P), (c:P) "
+                        "RETURN a.id AS x, b.id AS y, c.id AS z"}
+        writer.write((json.dumps(sub) + "\n").encode())
+        await writer.drain()
+        pages = 0
+        while True:
+            msg = json.loads(await asyncio.wait_for(reader.readline(), 120))
+            t = msg.get("type")
+            if t == "rows":
+                total += len(msg["rows"])
+                pages += 1
+                if pages % 512 == 0:
+                    await asyncio.sleep(0.005)  # a deliberately slow consumer
+                writer.write((json.dumps({"op": "next", "id": "big"}) + "\n")
+                             .encode())
+                await writer.drain()
+            elif t == "done":
+                done = msg
+                break
+            elif t != "accepted":
+                print(json.dumps({"error": msg}), flush=True)
+                sys.exit(1)
+        writer.close()
+    print(json.dumps({"rows": total, "total_rows": done["total_rows"],
+                      "streamed": done["streamed"],
+                      "peak_rss_mb": peak_rss_mb()}))
+
+asyncio.run(main())
+"""
+
+
+def test_stream_million_rows_under_fixed_rss_ceiling():
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    # conftest forces an 8-virtual-device XLA host platform for mesh
+    # tests; the serving ceiling is a one-device measurement
+    env.pop("XLA_FLAGS", None)
+    proc = subprocess.run(
+        [sys.executable, "-c", _RSS_SCRIPT],
+        capture_output=True, text=True, timeout=540, env=env,
+    )
+    assert proc.returncode == 0, (proc.stdout[-2000:], proc.stderr[-2000:])
+    out = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert out["rows"] == out["total_rows"] == 108 ** 3
+    assert out["streamed"] is True
+    assert out["peak_rss_mb"] < _RSS_CEILING_MB, out

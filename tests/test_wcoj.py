@@ -153,8 +153,9 @@ def test_intersect_kernel_launches_and_declines(monkeypatch):
     assert int(total) == int(np.asarray(cnt).sum())
     assert dispatch.use_counts()["intersect"]["pallas"] == 1
     # past the VMEM residency cap the launch must decline to the
-    # searchsorted path (same results, no kernel)
-    monkeypatch.setattr(PI, "MAX_KEYS", 8)
+    # searchsorted path (same results, no kernel) — pin the knob the
+    # cost model honors verbatim
+    monkeypatch.setenv("TPU_CYPHER_PALLAS_MAX_KEYS", "8")
     lo2, cnt2, _ = PI.intersect_range_count(keys, q, ok)
     assert (np.asarray(cnt2) == np.asarray(cnt)).all()
     assert (np.asarray(lo2) == np.asarray(lo)).all()

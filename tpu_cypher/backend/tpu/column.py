@@ -116,6 +116,79 @@ class TpuBackendError(Exception):
     pass
 
 
+def _decode_host(kind, data, valid, iflag, vocab) -> List[Any]:
+    """Per-kind host-array -> Python-value decode shared by ``to_values``
+    and the chunked ``to_values_range`` (``data``/``valid``/``iflag`` are
+    ALREADY-SLICED host numpy arrays)."""
+    if kind == I64:
+        return [
+            int(v) if (valid is None or valid[i]) else None
+            for i, v in enumerate(data)
+        ]
+    if kind == F64:
+        return [
+            (
+                (int(v) if (iflag is not None and iflag[i]) else float(v))
+                if (valid is None or valid[i])
+                else None
+            )
+            for i, v in enumerate(data)
+        ]
+    if kind == BOOL:
+        return [
+            bool(v) if (valid is None or valid[i]) else None
+            for i, v in enumerate(data)
+        ]
+    if kind == STR:
+        vb = vocab or []
+        return [
+            (vb[v] if v >= 0 else None)
+            if (valid is None or valid[i])
+            else None
+            for i, v in enumerate(data)
+        ]
+    if kind == DATE:
+        from .temporal import decode_date
+
+        return [
+            decode_date(v) if (valid is None or valid[i]) else None
+            for i, v in enumerate(data)
+        ]
+    if kind == LDT:
+        from .temporal import decode_ldt
+
+        return [
+            decode_ldt(v) if (valid is None or valid[i]) else None
+            for i, v in enumerate(data)
+        ]
+    if kind in (ZDT, ZT):
+        from .temporal import decode_zdt, decode_zt, parse_offset_str
+
+        off = parse_offset_str((vocab or ["+00:00"])[0])
+        dec = decode_zdt if kind == ZDT else decode_zt
+        return [
+            dec(v, off) if (valid is None or valid[i]) else None
+            for i, v in enumerate(data)
+        ]
+    if kind == LT:
+        from .temporal import decode_lt
+
+        return [
+            decode_lt(v) if (valid is None or valid[i]) else None
+            for i, v in enumerate(data)
+        ]
+    if kind == DUR:
+        from ...api.values import Duration
+
+        return [
+            Duration(months=int(r[0]), days=int(r[1]), microseconds=int(r[2]))
+            if (valid is None or valid[i])
+            else None
+            for i, r in enumerate(data)
+        ]
+    raise TpuBackendError(kind)  # pragma: no cover
+
+
 class InexactPromotionError(TpuBackendError):
     """An I64->F64 promotion would round integers beyond 2**53; the caller
     must use a host-exact representation (OBJ / local oracle) instead."""
@@ -144,6 +217,11 @@ class Column:
     # TPU per array). Mirrors hold the LOGICAL rows only (no padding).
     _np_cache: Optional[np.ndarray] = None
     _np_valid: Optional[np.ndarray] = None
+    # lazily-fetched (data, valid, int_flag) host tuple for the decode
+    # paths (``to_values`` / ``to_values_range``): ONE D2H per array per
+    # column lifetime, then chunk decodes slice host-side. Columns are
+    # immutable after construction, so the fetch can never go stale.
+    _host_fetch: Optional[tuple] = None
     # sharding padding (``parallel.mesh.padded_to_mesh``): the trailing
     # ``pad`` device rows are phantom rows added so the array shards evenly
     # over the active mesh. They are ALWAYS marked invalid in ``valid``, so
@@ -392,11 +470,13 @@ class Column:
             _np_cache=host, _np_valid=hv, pad=pad, pad_synth=ps,
         )
 
-    def to_values(self, row_mask: Optional[np.ndarray] = None) -> List[Any]:
-        """Decode to Python values (respecting validity)."""
-        if self.kind == OBJ:
-            vals = list(self.data)
-        else:
+    def _host_arrays(self):
+        """Host mirrors of (data, valid, int_flag), fetched AT MOST ONCE
+        per column instance and cached — the cursor-streaming decode path
+        slices these host-side per chunk, so a streamed result pays one
+        D2H transfer per column regardless of how many chunks it spans
+        (and never compiles a per-bounds device slice program)."""
+        if self._host_fetch is None:
             data = (
                 self._np_cache if self._np_cache is not None
                 else to_host(self.data)
@@ -407,82 +487,38 @@ class Column:
                 valid = self._np_valid
             else:
                 valid = to_host(self.valid)
-            if self.kind == I64:
-                vals = [
-                    int(v) if (valid is None or valid[i]) else None
-                    for i, v in enumerate(data)
-                ]
-            elif self.kind == F64:
-                iflag = (
-                    to_host(self.int_flag) if self.int_flag is not None else None
-                )
-                vals = [
-                    (
-                        (int(v) if (iflag is not None and iflag[i]) else float(v))
-                        if (valid is None or valid[i])
-                        else None
-                    )
-                    for i, v in enumerate(data)
-                ]
-            elif self.kind == BOOL:
-                vals = [
-                    bool(v) if (valid is None or valid[i]) else None
-                    for i, v in enumerate(data)
-                ]
-            elif self.kind == STR:
-                vocab = self.vocab or []
-                vals = [
-                    (vocab[v] if v >= 0 else None)
-                    if (valid is None or valid[i])
-                    else None
-                    for i, v in enumerate(data)
-                ]
-            elif self.kind == DATE:
-                from .temporal import decode_date
+            iflag = (
+                to_host(self.int_flag) if self.int_flag is not None else None
+            )
+            self._host_fetch = (data, valid, iflag)
+        return self._host_fetch
 
-                vals = [
-                    decode_date(v) if (valid is None or valid[i]) else None
-                    for i, v in enumerate(data)
-                ]
-            elif self.kind == LDT:
-                from .temporal import decode_ldt
-
-                vals = [
-                    decode_ldt(v) if (valid is None or valid[i]) else None
-                    for i, v in enumerate(data)
-                ]
-            elif self.kind in (ZDT, ZT):
-                from .temporal import decode_zdt, decode_zt, parse_offset_str
-
-                off = parse_offset_str((self.vocab or ["+00:00"])[0])
-                dec = decode_zdt if self.kind == ZDT else decode_zt
-                vals = [
-                    dec(v, off) if (valid is None or valid[i]) else None
-                    for i, v in enumerate(data)
-                ]
-            elif self.kind == LT:
-                from .temporal import decode_lt
-
-                vals = [
-                    decode_lt(v) if (valid is None or valid[i]) else None
-                    for i, v in enumerate(data)
-                ]
-            elif self.kind == DUR:
-                from ...api.values import Duration
-
-                vals = [
-                    Duration(
-                        months=int(r[0]), days=int(r[1]), microseconds=int(r[2])
-                    )
-                    if (valid is None or valid[i])
-                    else None
-                    for i, r in enumerate(data)
-                ]
-            else:  # pragma: no cover
-                raise TpuBackendError(self.kind)
+    def to_values(self, row_mask: Optional[np.ndarray] = None) -> List[Any]:
+        """Decode to Python values (respecting validity)."""
+        if self.kind == OBJ:
+            vals = list(self.data)
+        else:
+            data, valid, iflag = self._host_arrays()
+            vals = _decode_host(self.kind, data, valid, iflag, self.vocab)
         if row_mask is not None:
             vals = [v for v, keep in zip(vals, row_mask) if keep]
         return vals
+
+    def to_values_range(self, lo: int, hi: int) -> List[Any]:
+        """Decode rows ``[lo, hi)`` only — the chunked-materialize step of
+        cursor streaming (``TpuTable.rows_chunked``). Host arrays are
+        cached by ``_host_arrays``, so per-chunk cost is the decode of
+        ``hi - lo`` rows and nothing else."""
+        if self.kind == OBJ:
+            return list(self.data[lo:hi])
+        data, valid, iflag = self._host_arrays()
+        return _decode_host(
+            self.kind,
+            data[lo:hi],
+            valid[lo:hi] if valid is not None else None,
+            iflag[lo:hi] if iflag is not None else None,
+            self.vocab,
+        )
 
     # -- ops ---------------------------------------------------------------
 

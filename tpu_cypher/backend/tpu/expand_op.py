@@ -80,9 +80,9 @@ def _mxu_tiled_enabled() -> bool:
 
 
 def _mxu_tiled_max() -> int:
-    from ...utils.config import MXU_TILED_MAX
+    from ...optimizer.cost import mxu_tiled_node_cap
 
-    return int(MXU_TILED_MAX.get())
+    return mxu_tiled_node_cap()
 
 
 # which MXU tier answered each dense-eligible count — bench.py reports the

@@ -431,7 +431,7 @@ class GraphIndex:
 
     def dense_adj(
         self, types_key: Tuple[str, ...], reverse: bool, ctx,
-        max_nodes: int = 16384,
+        max_nodes: Optional[int] = None,
     ) -> Optional[Tuple[Any, int, int]]:
         """Dense bf16[(Npad, Npad)] adjacency with edge-MULTIPLICITY
         entries for the MXU matmul tier (``jit_ops.mxu_close_count`` /
@@ -441,7 +441,14 @@ class GraphIndex:
         max_row_sum)`` (the exactness metadata callers use to bound the
         f32 accumulator), or None when the graph is too large for the
         dense form (Npad^2 bf16 per matrix) or a multiplicity exceeds
-        bf16's exact-integer range (256). Rows/cols past N are zero."""
+        bf16's exact-integer range (256). Rows/cols past N are zero.
+        ``max_nodes=None`` resolves through the cost model
+        (``optimizer.cost.mxu_dense_node_cap``), which honors a
+        ``TPU_CYPHER_MXU_DENSE_MAX`` pin verbatim."""
+        if max_nodes is None:
+            from ...optimizer.cost import mxu_dense_node_cap
+
+            max_nodes = mxu_dense_node_cap()
         key = (types_key, reverse, max_nodes)
         if key not in self._dense_adj:
             self.node_ids(ctx)

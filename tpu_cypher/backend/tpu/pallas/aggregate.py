@@ -46,8 +46,16 @@ _LANES = 128
 _BLOCK = _ROWS * _LANES
 
 # group-count cap: the (k_pad, BLOCK) compare matrix at 8 B/lane stays
-# ~2 MiB; larger GROUP BYs keep the scatter formulation
+# ~2 MiB; larger GROUP BYs keep the scatter formulation. Declared-default
+# mirror; eligibility routes through ``optimizer.cost.pallas_cap`` so a
+# ``TPU_CYPHER_PALLAS_MAX_GROUPS`` pin is honored verbatim.
 MAX_GROUPS = 256
+
+
+def _max_groups() -> int:
+    from ....optimizer.cost import pallas_cap
+
+    return pallas_cap("aggregate")
 
 
 def _seg_reduce_kernel_for(op: str, identity):
@@ -181,7 +189,7 @@ def segment_aggregate(data, valid, iflag, seg_j, *, name: str, kind: str, k: int
     with the oracle formulation). GROUP BY cardinality is capped by the
     VMEM compare-matrix budget."""
     eligible = (
-        0 < k <= MAX_GROUPS
+        0 < k <= _max_groups()
         and data.ndim == 1
         and (
             name == "count"

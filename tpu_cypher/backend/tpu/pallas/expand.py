@@ -40,8 +40,17 @@ _LANES = 128
 _BLOCK = _ROWS * _LANES
 
 # VMEM cap for the resident frontier state: (F+1) cum + F starts, int32 —
-# ~2 MiB at the cap, leaving room for tiles and double buffers
+# ~2 MiB at the cap, leaving room for tiles and double buffers. The
+# module constant mirrors the declared default; eligibility routes
+# through the cost model (``optimizer.cost.pallas_cap("expand")``) so a
+# ``TPU_CYPHER_PALLAS_MAX_FRONTIER`` pin is honored verbatim.
 MAX_FRONTIER = 1 << 18
+
+
+def _max_frontier() -> int:
+    from ....optimizer.cost import pallas_cap
+
+    return pallas_cap("expand")
 
 
 def _expand_rows_kernel(cum_ref, starts_ref, row_ref, edge_ref):
@@ -118,7 +127,7 @@ def expand_materialize_counted(rp, ci, eo, pos, deg, nvalid, *, size: int):
     frontier = int(pos.shape[0])
     eligible = (
         0 < size < 2**30
-        and 0 < frontier <= MAX_FRONTIER
+        and 0 < frontier <= _max_frontier()
         and rp.dtype == jnp.int32
         and ci.dtype == jnp.int32
     )

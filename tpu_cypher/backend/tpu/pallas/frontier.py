@@ -88,8 +88,16 @@ dispatch.register(
 
 
 # VMEM budget for the resident degree vector (int32): 4 MiB at the cap —
-# larger graphs keep the two-gather jnp formulation
+# larger graphs keep the two-gather jnp formulation. Declared-default
+# mirror; eligibility routes through ``optimizer.cost.pallas_cap`` so a
+# ``TPU_CYPHER_PALLAS_MAX_NODES`` pin is honored verbatim.
 MAX_NODES = 1 << 20
+
+
+def _max_nodes() -> int:
+    from ....optimizer.cost import pallas_cap
+
+    return pallas_cap("frontier")
 
 
 def csr_frontier_degree_sum(
@@ -107,7 +115,7 @@ def csr_frontier_degree_sum(
         max_deg is not None
         and max_deg < 2**21
         and int(pos.shape[0]) > 0
-        and int(rp.shape[0]) - 1 <= MAX_NODES
+        and int(rp.shape[0]) - 1 <= _max_nodes()
     )
     return dispatch.launch(
         "frontier_deg_sum",

@@ -43,8 +43,16 @@ _BLOCK = _ROWS * _LANES
 
 # VMEM-residency cap on the POW2-PADDED key list: two int32 planes at the
 # cap are 8 MiB, comfortably inside the ~16 MiB/core budget next to the
-# streamed query tiles
+# streamed query tiles. Declared-default mirror; eligibility routes
+# through ``optimizer.cost.pallas_cap`` so a ``TPU_CYPHER_PALLAS_MAX_KEYS``
+# pin is honored verbatim.
 MAX_KEYS = 1 << 20
+
+
+def _max_keys() -> int:
+    from ....optimizer.cost import pallas_cap
+
+    return pallas_cap("intersect")
 
 # all real edge keys are anchor*N + candidate < 2**60 (the executor
 # requires num_nodes < 2**30), so the pad sentinel sorts strictly last
@@ -163,7 +171,7 @@ def intersect_range_count(keys, q, qvalid):
     npow = bucketing.round_up_pow2(nk) if nk else 0
     kernel_ok = (
         0 < nk
-        and npow <= MAX_KEYS
+        and npow <= _max_keys()
         and int(q.shape[0]) > 0
         and keys.dtype == jnp.int64
     )

@@ -57,8 +57,17 @@ _BLOCK = _ROWS * _LANES
 _PROBE_LIMIT = 16
 _BUILD_ROUNDS = _PROBE_LIMIT
 # build capacity cap: 4 int32 table vectors at load factor <= 1/2 stay
-# well under the VMEM budget (S = 2*cap -> 16 B/slot -> 4 MiB at the cap)
+# well under the VMEM budget (S = 2*cap -> 16 B/slot -> 4 MiB at the cap).
+# Declared-default mirror; eligibility routes through
+# ``optimizer.cost.pallas_cap`` so a ``TPU_CYPHER_PALLAS_MAX_BUILD`` pin
+# is honored verbatim.
 MAX_BUILD = 1 << 17
+
+
+def _max_build() -> int:
+    from ....optimizer.cost import pallas_cap
+
+    return pallas_cap("join")
 
 
 def _split64(x):
@@ -238,7 +247,7 @@ def join_probe_bucketed(
         and (
             jnp.issubdtype(ld.dtype, jnp.integer) or ld.dtype == jnp.bool_
         )
-        and 0 < nvalid_cap <= MAX_BUILD
+        and 0 < nvalid_cap <= _max_build()
         and int(ld.shape[0]) > 0
     )
 

@@ -322,6 +322,25 @@ class TpuTable(Table):
         for i in range(self._nrows):
             yield {c: v[i] for c, v in decoded.items()}
 
+    def rows_chunked(self, chunk_rows: int) -> Iterator[List[Dict[str, Any]]]:
+        """Yield row dicts in bounded batches of ``chunk_rows`` WITHOUT
+        ever materializing the whole decoded result: per chunk, each
+        column decodes only its ``[lo, hi)`` slice host-side
+        (``Column.to_values_range`` — one cached D2H per column for the
+        table's lifetime). The cursor-streaming delivery path lives on
+        this, keeping peak host memory at O(chunk) for arbitrarily large
+        results."""
+        chunk_rows = max(int(chunk_rows), 1)
+        for lo in range(0, self._nrows, chunk_rows):
+            hi = min(lo + chunk_rows, self._nrows)
+            decoded = {
+                c: col.to_values_range(lo, hi)
+                for c, col in self._cols.items()
+            }
+            yield [
+                {c: v[i] for c, v in decoded.items()} for i in range(hi - lo)
+            ]
+
     # -- simple ops --------------------------------------------------------
 
     def select(self, cols: Sequence[str]) -> "TpuTable":

@@ -12,7 +12,7 @@ per-shard padded shape times the shard count, plus a cross-shard term for
 operators that imply a shuffle/psum — this is the "mesh-aware plan
 costing" item PR 13 left open.
 
-The four heuristics this module subsumes (each keeps its env knob as a
+The heuristics this module subsumes (each keeps its env knob as a
 hand override, detected via ``ConfigOption.overridden``):
 
 * ``wcoj.py`` routing — :func:`wcoj_threshold` / :func:`prefer_wcoj`
@@ -25,14 +25,30 @@ hand override, detected via ``ConfigOption.overridden``):
   the modelled replication cost still beats a hash repartition (it never
   *shrinks* the window below the declared limit);
 * join-order search (``joinorder.py``) composes :class:`CostModel` steps
-  instead of trusting syntax order.
+  instead of trusting syntax order;
+* MXU tier gating — :func:`mxu_dense_node_cap` (modelled from the HBM
+  budget when one is set) and :func:`mxu_tiled_node_cap` replace the
+  fixed node caps in ``graph_index.dense_adj`` / ``expand_op``;
+* Pallas eligibility — :func:`pallas_cap` derives each kernel's size cap
+  from its VMEM working-set budget instead of a per-module constant.
 """
 
 from __future__ import annotations
 
 from typing import Optional, Tuple
 
-from ..utils.config import BROADCAST_LIMIT, WCOJ_MIN_ROWS
+from ..utils.config import (
+    BROADCAST_LIMIT,
+    MEM_BUDGET,
+    MXU_DENSE_MAX,
+    MXU_TILED_MAX,
+    PALLAS_MAX_BUILD,
+    PALLAS_MAX_FRONTIER,
+    PALLAS_MAX_GROUPS,
+    PALLAS_MAX_KEYS,
+    PALLAS_MAX_NODES,
+    WCOJ_MIN_ROWS,
+)
 from .stats import GraphStatistics
 
 # generic selectivity of one residual filter predicate (no value-level
@@ -196,6 +212,68 @@ def broadcast_build_limit(n_l: int, nsh: int) -> int:
         return limit
     crossover = padded_rows(n_l) // max(int(nsh) - 1, 1)
     return max(limit, min(crossover, 1 << 20))
+
+
+# -- MXU tier node caps (backend/tpu/graph_index.py, expand_op.py) --------
+
+
+def mxu_dense_node_cap() -> int:
+    """Node-count ceiling for the dense MXU adjacency tier
+    (``GraphIndex.dense_adj``: one bf16[(Npad, Npad)] matrix per cached
+    orientation). A ``TPU_CYPHER_MXU_DENSE_MAX`` pin wins verbatim.
+    Otherwise, with an HBM budget set (``TPU_CYPHER_MEM_BUDGET``) the cap
+    is the largest DENSE_BLOCK multiple whose padded matrix fits a quarter
+    of the budget at 2 bytes/cell — the same byte-budget reasoning every
+    materialize admission runs — clipped so one extreme budget cannot
+    route absurd sizes; with no budget the declared default stands."""
+    if MXU_DENSE_MAX.overridden:
+        return int(MXU_DENSE_MAX.get())
+    default = int(MXU_DENSE_MAX.default)
+    budget = int(MEM_BUDGET.get())
+    if budget <= 0:
+        return default
+    block = 256  # GraphIndex.DENSE_BLOCK
+    # Npad^2 * 2 B (bf16) <= budget / 4, Npad a block multiple
+    npad = int((budget / 8) ** 0.5) // block * block
+    return max(block, min(npad, 1 << 16))
+
+
+def mxu_tiled_node_cap() -> int:
+    """Node-count ceiling for the TILED MXU close-count tier (row-block
+    tiles, no full dense matrix — the cap bounds total FLOPs, not memory).
+    ``TPU_CYPHER_MXU_TILED_MAX`` is honored whether pinned or defaulted;
+    routing through the cost model keeps the gate a single decision
+    point beside the dense cap it backstops."""
+    return int(MXU_TILED_MAX.get())
+
+
+# -- Pallas kernel eligibility caps (backend/tpu/pallas/*) ----------------
+
+# per-kernel VMEM working-set model: (knob, budget bytes, bytes/element).
+# The unpinned cap is budget // bytes_per_element — each knob's declared
+# default equals that quotient, so routing through the model changes no
+# behavior until an operator pins a knob or the budgets are retuned.
+_PALLAS_BUDGETS = {
+    "expand": (PALLAS_MAX_FRONTIER, 2 << 20, 8),  # cum + starts, int32
+    "frontier": (PALLAS_MAX_NODES, 4 << 20, 4),  # degree vector, int32
+    "intersect": (PALLAS_MAX_KEYS, 8 << 20, 8),  # two int32 key planes
+    "join": (PALLAS_MAX_BUILD, 4 << 20, 32),  # 4 table vecs at LF 1/2
+}
+
+
+def pallas_cap(kernel: str) -> int:
+    """Eligibility size cap for one Pallas kernel. A pinned
+    ``TPU_CYPHER_PALLAS_MAX_*`` knob wins verbatim; otherwise the cap is
+    the kernel's VMEM working-set budget divided by its bytes-per-element
+    — the byte-budget decision the old per-module constants hand-encoded.
+    ``aggregate`` caps GROUP BY cardinality (a compare-matrix shape, not a
+    resident buffer) so it keeps its declared lane-tile default."""
+    if kernel == "aggregate":
+        return int(PALLAS_MAX_GROUPS.get())
+    knob, vmem_bytes, bytes_per_elem = _PALLAS_BUDGETS[kernel]
+    if knob.overridden:
+        return int(knob.get())
+    return vmem_bytes // bytes_per_elem
 
 
 # -- serve admission (serve/scheduler.estimate_cost_bytes) ----------------

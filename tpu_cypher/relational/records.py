@@ -70,6 +70,30 @@ class RelationalCypherRecords:
                 sp.note("rows", len(out))
         return out
 
+    def iter_chunks(self, chunk_rows: int):
+        """Yield ``CypherMap`` rows in bounded lists of ``chunk_rows`` —
+        the cursor-streaming materialize step. Backed by the table's
+        chunked decode (``TpuTable.rows_chunked``) when available, so a
+        huge result never holds more than one decoded chunk of host
+        values at a time; tables without a chunked path fall back to
+        paging the fully-decoded row iterator (host backends, where the
+        rows were Python objects all along)."""
+        mats = self._materializers()
+        chunk_rows = max(int(chunk_rows), 1)
+        chunked = getattr(self.table, "rows_chunked", None)
+        if chunked is not None:
+            for rows in chunked(chunk_rows):
+                yield [CypherMap((n, f(r)) for n, f in mats) for r in rows]
+            return
+        buf: List[CypherMap] = []
+        for r in self.table.rows():
+            buf.append(CypherMap((n, f(r)) for n, f in mats))
+            if len(buf) >= chunk_rows:
+                yield buf
+                buf = []
+        if buf:
+            yield buf
+
     def to_bag(self):
         from ..testing.bag import Bag
 

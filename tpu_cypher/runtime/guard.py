@@ -28,7 +28,12 @@ from typing import Optional
 
 from ..errors import QueryTimeout
 from ..obs.metrics import REGISTRY as _REGISTRY
-from ..utils.config import CHUNK_ROWS, DEADLINE_S, LADDER_MODE
+from ..utils.config import (
+    CHUNK_ROWS,
+    DEADLINE_S,
+    LADDER_MODE,
+    SERVE_STREAM_CHUNK_ROWS,
+)
 
 # ladder rungs, in degradation order (docs/robustness.md)
 RUNG_DEVICE = "device"
@@ -133,6 +138,19 @@ def chunk_rows() -> Optional[int]:
     if g is None or g.rung != RUNG_CHUNKED:
         return None
     return max(int(CHUNK_ROWS.get()), 1024)
+
+
+def stream_chunk_rows() -> int:
+    """Row-chunk size for cursor streaming (serve/): the same bounded-slice
+    discipline as the chunked ladder rung, but ALWAYS active — result
+    delivery decodes and encodes at most this many rows at a time, which is
+    what holds streaming's host-memory ceiling. Follows
+    ``TPU_CYPHER_CHUNK_ROWS`` unless ``TPU_CYPHER_SERVE_STREAM_CHUNK_ROWS``
+    pins it separately; clamped to the same floor as ``chunk_rows``."""
+    n = int(SERVE_STREAM_CHUNK_ROWS.get())
+    if n <= 0:
+        n = int(CHUNK_ROWS.get())
+    return max(n, 1024)
 
 
 def check_deadline(site: str) -> None:

@@ -13,9 +13,14 @@ The subsystem (``docs/serving.md``) in one line per layer:
 * ``batching`` — same-plan/same-params/same-bucket queries arriving
   within ``TPU_CYPHER_SERVE_BATCH_WINDOW_MS`` coalesce into ONE device
   dispatch, demuxed per client.
+* ``result_cache`` — byte-budgeted LRU of complete wire payloads keyed
+  on the micro-batch demux key and invalidated by the graph-statistics
+  fingerprint: repeat reads return in <1ms with ZERO device dispatch.
 * ``server`` — the asyncio front end: newline-JSON submit/stream/cancel
-  plus ``GET /metrics`` (``session.metrics_text()`` verbatim) and
-  ``GET /queries/<id>`` (per-query profile JSON) on the same port.
+  (plus pull-based cursor streaming: ``"stream": true`` + ``next`` /
+  ``close`` credit flow) plus ``GET /metrics``
+  (``session.metrics_text()`` verbatim), ``GET /queries/<id>``
+  (per-query profile JSON), and ``GET /cache`` on the same port.
 
 And the fault-isolated multi-process tier layered on top (PR 11):
 
@@ -42,6 +47,7 @@ Run one with ``python -m tpu_cypher.serve`` (demo graph; set
 
 from .batching import BatchWindow, batch_key, bucket_signature
 from .cluster import ClusterServer
+from .result_cache import ResultCache
 from .router import Router
 from .scheduler import AdmissionScheduler, estimate_cost_bytes, preflight_admit
 from .server import PAGE_ROWS, PROTOCOL_VERSION, QueryServer
@@ -61,6 +67,7 @@ __all__ = [
     "PAGE_ROWS",
     "PROTOCOL_VERSION",
     "QueryServer",
+    "ResultCache",
     "Router",
     "SessionPool",
     "SubprocessLauncher",

@@ -31,6 +31,7 @@ the cluster's admission ceiling defaults to ``max_concurrent x workers``
 
 from __future__ import annotations
 
+import asyncio
 import tempfile
 import time
 from typing import Any, Dict, List, Optional
@@ -41,8 +42,9 @@ from ..utils.config import (
     SERVE_MAX_CONCURRENT,
     SERVE_WORKERS,
 )
+from . import wire
 from .router import Router
-from .server import QueryServer, _Ticket
+from .server import PAGE_ROWS, QueryServer, _Ticket
 from .supervisor import SubprocessLauncher, Supervisor
 
 
@@ -62,6 +64,7 @@ class ClusterServer(QueryServer):  # shared-by: loop
         retry_max: Optional[int] = None,
         hedge_ms: Optional[float] = None,
         lanes: int = 4,
+        cache_bytes: Optional[int] = None,
     ):
         self.n_workers = max(
             int(workers if workers is not None else SERVE_WORKERS.get()), 1
@@ -72,6 +75,7 @@ class ClusterServer(QueryServer):  # shared-by: loop
         super().__init__(
             host=host, port=port, max_concurrent=max_concurrent,
             batch_window_ms=batch_window_ms, tenant_quota=tenant_quota,
+            cache_bytes=cache_bytes,
         )
         # one compile-cache dir shared by every worker: restart warmups
         # load artifacts from here instead of recompiling
@@ -163,3 +167,34 @@ class ClusterServer(QueryServer):  # shared-by: loop
             tenant=t.tenant, deadline_s=remaining, faults=t.faults,
             qid=t.qid,
         )
+
+    async def _open_stream(self, t: _Ticket, graph):
+        """Cursor streaming over the cluster: route the query like any
+        other (retry/hedging/breakers all apply), then page the payload
+        the worker necessarily returned whole — the worker wire protocol
+        is one-shot. The cursor protocol stays identical to the
+        single-process server; only the front-end memory ceiling differs
+        (one full payload instead of one chunk)."""
+        payload = await self._execute_payload(t, graph)
+        rows = payload.pop("rows", [])
+        meta = dict(payload)
+        meta["total_rows"] = len(rows)
+        return meta, wire.ListPages(rows, page_rows=PAGE_ROWS)
+
+    async def _flush_caches(self) -> int:
+        """Flush the front-end cache AND every reachable worker's — the
+        ``/cache/flush`` endpoint must leave no replica serving stale
+        results."""
+        dropped = self.cache.flush()
+        workers = list(self.supervisor.workers) if self.supervisor else []
+        for w in workers:
+            if not w.available:
+                continue
+            try:
+                reply = await wire.request(
+                    w.host, w.port, {"op": "cache_flush"}, timeout=5.0
+                )
+                dropped += int(reply.get("flushed") or 0)
+            except (OSError, EOFError, asyncio.TimeoutError):
+                pass  # fault-ok: a dead worker's cache dies with it
+        return dropped

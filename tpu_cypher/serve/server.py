@@ -19,12 +19,29 @@ scheduler, and a batch coalescer, and serves two protocols on ONE port:
   "message"}``. Multiple queries stream concurrently on one connection;
   every message carries the query id it belongs to.
 
+  A submit with ``"stream": true`` opens a pull-based CURSOR instead of
+  the eager demux: pages flow under a credit window
+  (``TPU_CYPHER_SERVE_STREAM_WINDOW`` unacknowledged pages), the client
+  grants credit with ``{"op": "next", "id": ..., "n": 1}`` and may end
+  early with ``{"op": "close", "id": ...}``; the ``done`` message then
+  carries ``streamed: true`` and ``total_rows``. Row decode happens one
+  bounded chunk at a time (``wire.RowStream``), so an arbitrarily large
+  result streams under a fixed host-memory ceiling and a slow consumer
+  parks only its own cursor — never the loop or a device slot.
+
+  Repeat reads are served by a ZERO-DISPATCH result cache
+  (``serve/result_cache.py``): hits skip batching, admission, and the
+  device entirely, stamping ``cached: true`` on the ``done`` message.
+
 * **observability over HTTP** (sniffed from the first line, so curl and a
   Prometheus scraper need no special port): ``GET /metrics`` returns
   ``session.metrics_text()`` VERBATIM (golden-tested against the
   in-process text so the surfaces cannot drift), ``GET /queries/<id>``
   returns the per-query record — status, execution log, ladder rungs,
   batch tags, and the full ``profile()`` span tree as JSON.
+  ``GET /cache`` reports result-cache occupancy and hit counters;
+  ``GET /cache/flush`` drops every cached result (cluster mode fans the
+  flush out to its worker processes).
 
 Execution path per submit: resolve graph -> batch coalescing
 (``serve/batching.py``) -> pre-flight budget admission + cost-ordered,
@@ -54,10 +71,12 @@ from ..utils.config import (
     SERVE_MAX_CONCURRENT,
     SERVE_PORT,
     SERVE_QUEUE_HIGH,
+    SERVE_STREAM_WINDOW,
     SERVE_TENANT_QUOTA,
 )
 from . import wire
-from .batching import BatchWindow, batch_key
+from .batching import Batch, BatchWindow, batch_key
+from .result_cache import ResultCache, graph_fingerprint
 from .scheduler import AdmissionScheduler, preflight_admit
 from .session_pool import SessionPool
 
@@ -74,6 +93,14 @@ QUERY_SECONDS = _REGISTRY.histogram(
     "tpu_cypher_serve_query_seconds",
     "wall seconds from submit to done, per client query",
 )
+CURSORS_OPEN = _REGISTRY.gauge(
+    "tpu_cypher_serve_cursor_open",
+    "streaming cursors currently open",
+)
+BACKPRESSURE_WAITS = _REGISTRY.counter(
+    "tpu_cypher_serve_cursor_backpressure_waits_total",
+    "times a streaming cursor paused for client credit",
+)
 
 # the wire module owns value/row encoding now (router and worker processes
 # need the identical forms); these aliases keep existing importers working
@@ -87,10 +114,11 @@ class _Ticket:
     __slots__ = (
         "qid", "query", "graph_name", "parameters", "tenant", "deadline_s",
         "faults", "conn", "status", "cancelled", "task", "submitted_at",
+        "stream", "cursor",
     )
 
     def __init__(self, qid, query, graph_name, parameters, tenant,
-                 deadline_s, faults, conn):
+                 deadline_s, faults, conn, stream=False):
         self.qid = qid
         self.query = query
         self.graph_name = graph_name
@@ -99,10 +127,28 @@ class _Ticket:
         self.deadline_s = deadline_s
         self.faults = faults
         self.conn = conn
+        self.stream = bool(stream)
+        self.cursor: Optional["_Cursor"] = None
         self.status = "queued"
         self.cancelled = False
         self.task: Optional[asyncio.Task] = None
         self.submitted_at = time.monotonic()
+
+
+class _Cursor:  # shared-by: loop
+    """Flow-control state for ONE streamed query: a credit window of
+    unacknowledged pages. The delivery loop pauses (on ``wake``) once
+    ``sent - acked`` reaches ``window``; each client ``next`` message
+    grants credit. A slow consumer therefore blocks only its own
+    delivery task — the event loop, other cursors, and the device slots
+    (released before delivery starts) never wait on it."""
+
+    def __init__(self, window: int):
+        self.window = max(int(window), 1)
+        self.acked = 0
+        self.sent = 0
+        self.closed = False
+        self.wake = asyncio.Event()
 
 
 class _Conn:  # shared-by: loop
@@ -114,9 +160,13 @@ class _Conn:  # shared-by: loop
         self.closed = False
 
     async def send(self, obj: Dict[str, Any]) -> None:
+        await self.send_raw((json.dumps(obj) + "\n").encode())
+
+    async def send_raw(self, data: bytes) -> None:
+        """Write one pre-serialized frame (callers that attribute
+        serialize time — the demux stage accounting — encode first)."""
         if self.closed:
             return
-        data = (json.dumps(obj) + "\n").encode()
         async with self.lock:
             if self.closed:
                 return
@@ -138,6 +188,7 @@ class QueryServer:  # shared-by: loop
         max_concurrent: Optional[int] = None,
         batch_window_ms: Optional[float] = None,
         tenant_quota: Optional[int] = None,
+        cache_bytes: Optional[int] = None,
     ):
         self.host = host
         self.port = int(port if port is not None else SERVE_PORT.get())
@@ -159,6 +210,12 @@ class QueryServer:  # shared-by: loop
             max_c, tenant_quota=quota, queue_high=int(SERVE_QUEUE_HIGH.get())
         )
         self.batcher = BatchWindow(window)
+        self.cache = ResultCache(cache_bytes)
+        self._fingerprints: Dict[str, str] = {}
+        # accumulated per-stage wall seconds (queue_wait / route /
+        # dispatch / demux / serialize) — the soak harness's latency
+        # attribution reads this
+        self.stages: Dict[str, float] = {}
         self._graphs: Dict[str, PropertyGraph] = {}
         self._tickets: Dict[str, _Ticket] = {}
         self._records: "OrderedDict[str, Dict[str, Any]]" = OrderedDict()
@@ -168,8 +225,13 @@ class QueryServer:  # shared-by: loop
     # -- graphs ----------------------------------------------------------
 
     def register_graph(self, name: str, graph: PropertyGraph) -> None:
-        """Mount a catalog graph for clients to query by name."""
+        """Mount a catalog graph for clients to query by name. Computes
+        the graph's statistics fingerprint here — the SYNC setup path —
+        so result-cache lookups on the event loop are one dict read.
+        Re-registering a name with changed data yields a new fingerprint,
+        which invalidates that graph's cached results on next lookup."""
         self._graphs[name] = graph
+        self._fingerprints[name] = graph_fingerprint(self.session, graph)
 
     def warmup(self, queries, graph_name: str,
                parameters: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
@@ -265,6 +327,10 @@ class QueryServer:  # shared-by: loop
             await self._op_submit(msg, conn)
         elif op == "cancel":
             await self._op_cancel(msg, conn)
+        elif op == "next":
+            await self._op_next(msg, conn)
+        elif op == "close":
+            await self._op_close(msg, conn)
         elif op == "ping":
             await conn.send({"type": "pong", "protocol": PROTOCOL_VERSION})
         else:
@@ -303,7 +369,7 @@ class QueryServer:  # shared-by: loop
             qid, query, graph_name, dict(msg.get("parameters") or {}),
             str(msg.get("tenant") or "default"),
             float(deadline_s) if deadline_s else None,
-            msg.get("faults"), conn,
+            msg.get("faults"), conn, stream=bool(msg.get("stream")),
         )
         self._tickets[qid] = t
         await conn.send({"type": "accepted", "id": qid})
@@ -319,6 +385,8 @@ class QueryServer:  # shared-by: loop
             )
             return
         t.cancelled = True
+        if t.cursor is not None:
+            t.cursor.wake.set()  # unblock a backpressure-paused stream
         if t.status == "queued" and t.task is not None:
             # still pre-dispatch: tear the task down now (a sealed batch
             # with followers is handled inside the task — it executes for
@@ -326,15 +394,74 @@ class QueryServer:  # shared-by: loop
             t.task.cancel()
         await conn.send({"type": "cancel_requested", "id": qid})
 
+    async def _op_next(self, msg: Dict[str, Any], conn: _Conn) -> None:
+        """Grant streaming credit: the client acknowledges page(s),
+        letting a backpressure-paused cursor resume."""
+        qid = str(msg.get("id") or "")
+        t = self._tickets.get(qid)
+        cur = t.cursor if t is not None else None
+        if cur is None:
+            await conn.send(
+                {"type": "error", "id": qid or None, "error": "UnknownQuery",
+                 "message": f"no open cursor {qid!r}"}
+            )
+            return
+        try:
+            n = max(int(msg.get("n") or 1), 1)
+        except (TypeError, ValueError):
+            n = 1
+        cur.acked += n
+        cur.wake.set()
+
+    async def _op_close(self, msg: Dict[str, Any], conn: _Conn) -> None:
+        """Close a streaming cursor early: delivery stops after the
+        in-flight page and the query finishes with the rows sent so far."""
+        qid = str(msg.get("id") or "")
+        t = self._tickets.get(qid)
+        cur = t.cursor if t is not None else None
+        if cur is None:
+            await conn.send(
+                {"type": "error", "id": qid or None, "error": "UnknownQuery",
+                 "message": f"no open cursor {qid!r}"}
+            )
+            return
+        cur.closed = True
+        cur.wake.set()
+        await conn.send({"type": "close_requested", "id": qid})
+
     # -- the execution pipeline ------------------------------------------
 
     async def _run_ticket(self, t: _Ticket) -> None:
         graph = self._graphs[t.graph_name]
+        if t.stream:
+            try:
+                await self._run_stream(t, graph)
+            except asyncio.CancelledError:
+                self._terminal(
+                    t, "cancelled", {"type": "cancelled", "id": t.qid}
+                )
+                await t.conn.send({"type": "cancelled", "id": t.qid})
+            except Exception as exc:  # fault-ok: typed error reply
+                await self._fail(t, exc)
+            return
         # chaos schedules and per-request deadlines are client-scoped
-        # state: such queries never share a dispatch
+        # state: such queries never share a dispatch — and, for the same
+        # reason, never hit or populate the result cache
         key = None
         if t.faults is None and t.deadline_s is None:
             key = batch_key(self.session, t.query, graph, t.parameters)
+            hit = self.cache.lookup(key, self._fingerprints.get(t.graph_name, ""))
+            if hit is not None:
+                # zero-dispatch fast path: no batch window, no admission
+                # wait, no device work — the stored payload is served
+                # straight from host memory on a sealed single-member batch
+                batch = Batch(None, t.qid)
+                batch.result = hit
+                try:
+                    await self._finish(t, batch)
+                except Exception as exc:  # fault-ok: typed error reply
+                    await self._fail(t, exc)
+                return
         batch, is_leader = self.batcher.lead_or_join(key, t.qid)
         try:
             if is_leader:
@@ -354,6 +481,11 @@ class QueryServer:  # shared-by: loop
         except Exception as exc:  # fault-ok: surfaced as a typed error reply
             await self._fail(t, exc)
 
+    def _stage(self, name: str, seconds: float) -> None:
+        """Accumulate per-stage wall seconds (queue_wait / route /
+        dispatch / demux / serialize) for latency attribution."""
+        self.stages[name] = self.stages.get(name, 0.0) + max(seconds, 0.0)
+
     async def _dispatch(self, t: _Ticket, graph, batch) -> None:
         """The leader's path: admission, one isolated execution, publish."""
         try:
@@ -361,13 +493,28 @@ class QueryServer:  # shared-by: loop
             deadline_at = (
                 t.submitted_at + t.deadline_s if t.deadline_s else None
             )
+            tq0 = time.perf_counter()
             await self.scheduler.acquire(cost, t.tenant, deadline_at)
+            self._stage("queue_wait", time.perf_counter() - tq0)
             t.status = "running"
+            td0 = time.perf_counter()
             try:
                 payload = await self._execute_payload(t, graph)
             finally:
                 self.scheduler.release(t.tenant)
+            wall = time.perf_counter() - td0
+            self._stage("dispatch", wall)
+            # route = everything around the engine seconds: lane hop in
+            # one process, connect/serialize/worker hop in cluster mode
+            self._stage(
+                "route", wall - float(payload.get("seconds") or 0.0)
+            )
             self.batcher.publish(batch, result=payload)
+            fp = self._fingerprints.get(t.graph_name)
+            if batch.key is not None and fp is not None:
+                # populate AFTER publish (and after any router mutation):
+                # the stored payload is exactly what members received
+                self.cache.store(batch.key, fp, payload)
         except asyncio.CancelledError:
             raise
         except Exception as exc:  # fault-ok: published to every member as a typed error
@@ -395,6 +542,105 @@ class QueryServer:  # shared-by: loop
             deadline_s=remaining, faults=t.faults,
         )
 
+    # -- cursor streaming ------------------------------------------------
+
+    async def _open_stream(self, t: _Ticket, graph):
+        """Streamed-execution hook: ``(meta, page source)``. The cluster
+        tier overrides this to route through an engine worker."""
+        remaining = None
+        if t.deadline_s:
+            remaining = max(
+                t.deadline_s - (time.monotonic() - t.submitted_at), 1e-6
+            )
+        return await self.pool.run(
+            lambda: wire.open_stream(
+                self.session, graph, t.query, t.parameters,
+                deadline_s=remaining, faults=t.faults, page_rows=PAGE_ROWS,
+            )
+        )
+
+    async def _run_stream(self, t: _Ticket, graph) -> None:
+        """The pull-based delivery path (``"stream": true`` submits).
+
+        Device execution happens once, under an admission slot; the slot
+        is released BEFORE delivery, so a slow consumer holds host memory
+        for one chunk — never a device slot. Pages then flow under the
+        cursor's credit window: decode rides the pool lanes
+        (``RowStream.next_page`` is blocking host work), sends ride this
+        task, and a full window parks on the cursor event until the
+        client grants credit (``next``), closes, cancels, or disconnects.
+        Streamed queries never batch and never touch the result cache —
+        their value is precisely the results too big to hold whole."""
+        cost = preflight_admit(graph, t.query, t.tenant)
+        deadline_at = t.submitted_at + t.deadline_s if t.deadline_s else None
+        tq0 = time.perf_counter()
+        await self.scheduler.acquire(cost, t.tenant, deadline_at)
+        self._stage("queue_wait", time.perf_counter() - tq0)
+        t.status = "running"
+        td0 = time.perf_counter()
+        try:
+            meta, source = await self._open_stream(t, graph)
+        finally:
+            self.scheduler.release(t.tenant)
+        wall = time.perf_counter() - td0
+        self._stage("dispatch", wall)
+        self._stage("route", wall - float(meta.get("seconds") or 0.0))
+        cur = _Cursor(int(SERVE_STREAM_WINDOW.get()))
+        t.cursor = cur
+        CURSORS_OPEN.set(CURSORS_OPEN.value() + 1)
+        streamed = 0
+        seq = 0
+        try:
+            while not (t.cancelled or cur.closed or t.conn.closed):
+                if cur.sent - cur.acked >= cur.window:
+                    BACKPRESSURE_WAITS.inc()
+                    cur.wake.clear()
+                    await cur.wake.wait()
+                    continue
+                tp0 = time.perf_counter()
+                page = await self.pool.run(source.next_page)
+                if page is None:
+                    break
+                msg = {"type": "rows", "id": t.qid, "seq": seq, "rows": page}
+                ts0 = time.perf_counter()
+                data = (json.dumps(msg) + "\n").encode()
+                tser = time.perf_counter() - ts0
+                self._stage("serialize", tser)
+                await t.conn.send_raw(data)
+                self._stage("demux", time.perf_counter() - tp0 - tser)
+                cur.sent += 1
+                seq += 1
+                streamed += len(page)
+        finally:
+            with contextlib.suppress(Exception):  # fault-ok: teardown only
+                source.close()
+            CURSORS_OPEN.set(max(CURSORS_OPEN.value() - 1, 0))
+        if t.cancelled:
+            self._terminal(t, "cancelled", {"type": "cancelled", "id": t.qid})
+            await t.conn.send({"type": "cancelled", "id": t.qid})
+            return
+        if seq == 0:
+            # zero-row parity with the eager path: always >= 1 rows frame
+            await t.conn.send(
+                {"type": "rows", "id": t.qid, "seq": 0, "rows": []}
+            )
+        done = {
+            "type": "done",
+            "id": t.qid,
+            "rows": streamed,
+            "total_rows": meta["total_rows"],
+            "seconds": meta["seconds"],
+            "batched": 1,
+            "batch_leader": t.qid,
+            "rungs": meta["rungs"],
+            "degraded": meta["degraded"],
+            "streamed": True,
+            "cached": False,
+        }
+        self._terminal(t, "done", done, payload={**meta, "rows": []})
+        self._records[t.qid]["rows"] = streamed
+        await t.conn.send(done)
+
     async def _finish(self, t: _Ticket, batch) -> None:
         if batch.error is not None:
             raise batch.error
@@ -404,13 +650,19 @@ class QueryServer:  # shared-by: loop
             await t.conn.send({"type": "cancelled", "id": t.qid})
             return
         rows = payload["rows"]
+        td0 = time.perf_counter()
+        ser = 0.0
         for seq in range(0, max(len(rows), 1), PAGE_ROWS):
             page = rows[seq : seq + PAGE_ROWS]
             if page or seq == 0:
-                await t.conn.send(
-                    {"type": "rows", "id": t.qid, "seq": seq // PAGE_ROWS,
-                     "rows": page}
-                )
+                msg = {"type": "rows", "id": t.qid, "seq": seq // PAGE_ROWS,
+                       "rows": page}
+                ts0 = time.perf_counter()
+                data = (json.dumps(msg) + "\n").encode()
+                ser += time.perf_counter() - ts0
+                await t.conn.send_raw(data)
+        self._stage("serialize", ser)
+        self._stage("demux", time.perf_counter() - td0 - ser)
         done = {
             "type": "done",
             "id": t.qid,
@@ -420,6 +672,7 @@ class QueryServer:  # shared-by: loop
             "batch_leader": batch.leader_id,
             "rungs": payload["rungs"],
             "degraded": payload["degraded"],
+            "cached": bool(payload.get("cached", False)),
         }
         self._terminal(t, "done", done, payload=payload, batch=batch)
         await t.conn.send(done)
@@ -458,6 +711,7 @@ class QueryServer:  # shared-by: loop
                 degraded=payload["degraded"],
                 compile_stats=payload["compile_stats"],
                 profile=payload["profile"],
+                cached=bool(payload.get("cached", False)),
             )
         if batch is not None:
             record.update(batched=batch.size, batch_leader=batch.leader_id)
@@ -465,6 +719,11 @@ class QueryServer:  # shared-by: loop
         while len(self._records) > _QUERY_LOG_MAX:
             self._records.popitem(last=False)
         self._tickets.pop(t.qid, None)
+
+    async def _flush_caches(self) -> int:
+        """Drop every cached result (``GET /cache/flush``). The cluster
+        tier overrides this to also fan out to its workers."""
+        return self.cache.flush()
 
     # -- HTTP observability surface --------------------------------------
 
@@ -481,7 +740,16 @@ class QueryServer:  # shared-by: loop
             _, path, _ = first.decode("latin-1").split(" ", 2)
         except ValueError:
             path = "/"
-        status, ctype, body = self._http_response(path)
+        if path.split("?", 1)[0] == "/cache/flush":
+            # the one ASYNC route: the cluster tier fans the flush out to
+            # its worker processes over the wire
+            dropped = await self._flush_caches()
+            status, ctype, body = (
+                "200 OK", "application/json",
+                json.dumps({"flushed": dropped}).encode(),
+            )
+        else:
+            status, ctype, body = self._http_response(path)
         head = (
             f"HTTP/1.1 {status}\r\n"
             f"Content-Type: {ctype}\r\n"
@@ -514,6 +782,11 @@ class QueryServer:  # shared-by: loop
                     json.dumps({"error": f"unknown query {qid!r}"}).encode(),
                 )
             return ("200 OK", "application/json", json.dumps(rec).encode())
+        if path == "/cache":
+            return (
+                "200 OK", "application/json",
+                json.dumps(self.cache.stats()).encode(),
+            )
         if path == "/healthz":
             return (
                 "200 OK", "application/json",

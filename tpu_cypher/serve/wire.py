@@ -93,6 +93,119 @@ def execute_payload(
     }
 
 
+def open_stream(
+    session,
+    graph,
+    query: str,
+    parameters: Optional[Dict[str, Any]] = None,
+    *,
+    deadline_s: Optional[float] = None,
+    faults: Optional[str] = None,
+    page_rows: int = 256,
+) -> "tuple[Dict[str, Any], RowStream]":
+    """One engine execution -> ``(meta, RowStream)`` WITHOUT materializing
+    the result rows: device execution runs here (inside the deadline and
+    chaos scopes, same as ``execute_payload``), but row decode is deferred
+    to the returned stream's ``next_page`` pulls, one bounded chunk at a
+    time. ``meta`` carries everything ``execute_payload`` does except
+    ``rows``, plus ``total_rows``. BLOCKING engine work — callers put both
+    this call and every ``next_page`` on a worker lane
+    (``SessionPool.run``)."""
+    t0 = time.perf_counter()
+    with contextlib.ExitStack() as stack:
+        if deadline_s:
+            stack.enter_context(G.request_deadline(deadline_s))
+        if faults is not None:
+            stack.enter_context(F.scoped_spec(faults))
+        result = session.cypher(query, parameters or {}, graph=graph)
+        records = result.records
+    columns = list(records.columns) if records is not None else []
+    log = list(result.execution_log)
+    rungs = [e["rung"] for e in log]
+    meta = {
+        "columns": columns,
+        "total_rows": int(records.size) if records is not None else 0,
+        "seconds": round(time.perf_counter() - t0, 6),
+        "execution_log": log,
+        "rungs": rungs,
+        "degraded": bool(rungs and rungs[-1] != G.RUNG_DEVICE),
+        "compile_stats": result.compile_stats,
+        "profile": result.profile(execute=False).to_dict(),
+    }
+    return meta, RowStream(records, columns, page_rows=page_rows)
+
+
+class RowStream:
+    """Pull-based source of ENCODED row pages over a live query result.
+
+    Decodes one bounded chunk at a time (``guard.stream_chunk_rows()``
+    rows via ``records.iter_chunks``) and serves at most ``page_rows``
+    wire-encoded rows per ``next_page()`` call — peak host memory is
+    O(chunk), independent of the total result size, which is what lets a
+    10M-row result stream under a fixed ceiling. Decode is BLOCKING host
+    work: drive ``next_page`` from a worker lane, never the event loop."""
+
+    def __init__(self, records, columns: List[str], *, page_rows: int = 256):
+        self._columns = list(columns)
+        self._page_rows = max(int(page_rows), 1)
+        self._chunks = (
+            records.iter_chunks(G.stream_chunk_rows())
+            if records is not None
+            else iter(())
+        )
+        self._buf: List[Any] = []
+        self._pos = 0
+        self.rows_sent = 0
+
+    def next_page(self) -> Optional[List[Dict[str, Any]]]:
+        """The next encoded page, or None once the result is exhausted."""
+        while self._pos >= len(self._buf):
+            nxt = next(self._chunks, None)
+            if nxt is None:
+                return None
+            self._buf = nxt
+            self._pos = 0
+        hi = min(self._pos + self._page_rows, len(self._buf))
+        page = encode_rows(self._buf[self._pos:hi], self._columns)
+        self.rows_sent += len(page)
+        self._pos = hi
+        return page
+
+    def close(self) -> None:
+        """Drop the buffered chunk and the underlying iterator (early
+        client close / cancel)."""
+        self._chunks = iter(())
+        self._buf = []
+        self._pos = 0
+
+
+class ListPages:
+    """``RowStream``-shaped pager over ALREADY-ENCODED rows — the cluster
+    front end streams a router payload it necessarily received whole (the
+    worker wire protocol is one-shot), so the protocol stays identical to
+    the single-process server even though the ceiling there is the full
+    payload."""
+
+    def __init__(self, rows: List[Dict[str, Any]], *, page_rows: int = 256):
+        self._rows = rows
+        self._page_rows = max(int(page_rows), 1)
+        self._pos = 0
+        self.rows_sent = 0
+
+    def next_page(self) -> Optional[List[Dict[str, Any]]]:
+        if self._pos >= len(self._rows):
+            return None
+        hi = min(self._pos + self._page_rows, len(self._rows))
+        page = self._rows[self._pos:hi]
+        self.rows_sent += len(page)
+        self._pos = hi
+        return page
+
+    def close(self) -> None:
+        self._rows = []
+        self._pos = 0
+
+
 # ---------------------------------------------------------------------------
 # framing
 # ---------------------------------------------------------------------------

@@ -54,6 +54,8 @@ from .. import errors as ERR
 from ..relational.session import CypherSession
 from ..runtime import faults as F
 from . import wire
+from .batching import batch_key
+from .result_cache import ResultCache, graph_fingerprint
 from .session_pool import SessionPool
 
 
@@ -69,6 +71,14 @@ class EngineWorker:  # shared-by: loop
         self.worker_id = worker_id
         self.pool = SessionPool(session, workers=lanes)
         self.graphs = graphs
+        # per-worker result cache: catches repeats the front end's cache
+        # missed (restart, retry/hedge landing here). Fingerprints are
+        # computed at boot — graph replicas are immutable for the
+        # worker's lifetime
+        self.cache = ResultCache()
+        self._fingerprints = {
+            name: graph_fingerprint(session, g) for name, g in graphs.items()
+        }
         self.host = host
         self.port = 0
         self.inflight = 0
@@ -133,6 +143,8 @@ class EngineWorker:  # shared-by: loop
             return {"ok": True, "draining": True, "inflight": self.inflight}
         if op == "execute":
             return await self._op_execute(msg)
+        if op == "cache_flush":
+            return {"ok": True, "flushed": self.cache.flush()}
         return {"id": msg.get("id"), "ok": False, "error": "ProtocolError",
                 "message": f"unknown op {op!r}"}
 
@@ -146,6 +158,18 @@ class EngineWorker:  # shared-by: loop
             return {"id": qid, "ok": False, "error": "UnknownGraph",
                     "message": f"graph {msg.get('graph')!r} not replicated "
                     f"(have: {sorted(self.graphs)})"}
+        # chaos-injected and deadline-carrying requests never touch the
+        # cache — same exclusion as the front end's (client-scoped state)
+        key = None
+        fp = self._fingerprints.get(msg.get("graph"), "")
+        if msg.get("faults") is None and not msg.get("deadline_s"):
+            key = batch_key(
+                self.pool.session, msg["query"], graph,
+                msg.get("parameters") or {},
+            )
+            hit = self.cache.lookup(key, fp)
+            if hit is not None:
+                return {"id": qid, "ok": True, "payload": hit}
         self.inflight += 1
         try:
             payload = await self.pool.run(
@@ -156,6 +180,8 @@ class EngineWorker:  # shared-by: loop
                     faults=msg.get("faults"),
                 )
             )
+            if key is not None:
+                self.cache.store(key, fp, payload)
             return {"id": qid, "ok": True, "payload": payload}
         except Exception as exc:  # fault-ok: surfaced typed to the router
             typed = ERR.classify(exc)

@@ -191,6 +191,58 @@ MXU_TILED_MAX = declare(
     help="node-count ceiling for the tiled MXU close-count tier",
 )
 
+# MXU dense-adjacency node cap (backend/tpu/graph_index.py dense_adj).
+# The effective cap is a CostModel decision (optimizer/cost.py
+# mxu_dense_node_cap): a pin here is honored verbatim; otherwise the cap
+# is modelled from TPU_CYPHER_MEM_BUDGET when one is set.
+MXU_DENSE_MAX = declare(
+    "TPU_CYPHER_MXU_DENSE_MAX",
+    16384,
+    int,
+    help="node-count ceiling for the dense MXU adjacency tier "
+    "(Npad^2 bf16 per matrix); modelled from the HBM budget unless pinned",
+)
+
+# per-kernel Pallas eligibility caps (backend/tpu/pallas/*). Each default
+# mirrors the kernel's VMEM working-set budget; the effective cap routes
+# through optimizer/cost.pallas_cap so a pin is honored verbatim while the
+# unpinned value stays a derived byte-budget decision.
+PALLAS_MAX_FRONTIER = declare(
+    "TPU_CYPHER_PALLAS_MAX_FRONTIER",
+    1 << 18,
+    int,
+    help="frontier cap for the Pallas expand kernel (resident cum+starts "
+    "state, ~8 B per frontier element of a ~2 MiB VMEM budget)",
+)
+PALLAS_MAX_NODES = declare(
+    "TPU_CYPHER_PALLAS_MAX_NODES",
+    1 << 20,
+    int,
+    help="node cap for the Pallas frontier-degree kernel (resident int32 "
+    "degree vector, 4 B per node of a ~4 MiB VMEM budget)",
+)
+PALLAS_MAX_KEYS = declare(
+    "TPU_CYPHER_PALLAS_MAX_KEYS",
+    1 << 20,
+    int,
+    help="pow2-padded key cap for the Pallas intersect kernel (two int32 "
+    "planes, 8 B per key of an ~8 MiB VMEM budget)",
+)
+PALLAS_MAX_BUILD = declare(
+    "TPU_CYPHER_PALLAS_MAX_BUILD",
+    1 << 17,
+    int,
+    help="build-side cap for the Pallas hash-join kernel (4 int32 table "
+    "vectors at load factor 1/2, 32 B per build row of a ~4 MiB budget)",
+)
+PALLAS_MAX_GROUPS = declare(
+    "TPU_CYPHER_PALLAS_MAX_GROUPS",
+    256,
+    int,
+    help="GROUP BY cardinality cap for the Pallas segment-aggregate "
+    "kernel (the (k_pad, block) compare matrix budget)",
+)
+
 # worst-case-optimal multiway join (backend/tpu/wcoj.py)
 WCOJ_MODE = declare(
     "TPU_CYPHER_WCOJ",
@@ -387,6 +439,29 @@ SERVE_RETRY_MAX = declare(
     2,
     int,
     help="max replica retries of an idempotent read after WorkerLost",
+)
+
+# zero-dispatch result cache + backpressured cursor streaming (serve/)
+SERVE_CACHE_BYTES = declare(
+    "TPU_CYPHER_SERVE_CACHE_BYTES",
+    64 << 20,
+    int,
+    help="byte budget of the serving-tier result cache (host-side encoded "
+    "row pages, LRU-evicted); 0 = cache off",
+)
+SERVE_STREAM_WINDOW = declare(
+    "TPU_CYPHER_SERVE_STREAM_WINDOW",
+    4,
+    int,
+    help="cursor-stream credit window: row pages the server may send "
+    "ahead of client 'next' credits before backpressure blocks the cursor",
+)
+SERVE_STREAM_CHUNK_ROWS = declare(
+    "TPU_CYPHER_SERVE_STREAM_CHUNK_ROWS",
+    0,
+    int,
+    help="rows decoded per cursor-stream chunk (the streaming face of the "
+    "ladder's chunk machinery); 0 = follow TPU_CYPHER_CHUNK_ROWS",
 )
 
 # observability (obs/metrics.py, utils/profiling.py, obs/trace.py)
