@@ -71,6 +71,15 @@ CLIQUE4 = (
     "(a)-[:KNOWS]->(d:Person), (b)-[:KNOWS]->(d), (c)-[:KNOWS]->(d) "
     "RETURN count(*) AS cliques"
 )
+CLIQUE4_MAT = (
+    # the MATERIALIZING 4-clique: property expressions force the WCOJ
+    # materialize tier (the count tier never sees d.id), and the distinct
+    # aggregate answers on the compressed form without ever decompressing
+    # the flat row set — the factorized-execution acceptance shape
+    "MATCH (a:Person)-[:KNOWS]->(b:Person)-[:KNOWS]->(c:Person)-[:KNOWS]->(a), "
+    "(a)-[:KNOWS]->(d:Person), (b)-[:KNOWS]->(d), (c)-[:KNOWS]->(d) "
+    "RETURN count(DISTINCT d.id) AS hubs"
+)
 
 
 # ---------------------------------------------------------------------------
@@ -713,7 +722,80 @@ def _wcoj_vs_binary(
             leg["binary_seconds"] = None
             leg["binary_skipped"] = "binary transient arrays over budget"
         entry[label] = leg
+    entry["clique4_materialize"] = _factorized_materialize(
+        g,
+        est_lane_rows=est_rows.get("clique4_lanes", est_rows["clique4"]),
+        est_flat_rows=est_rows["clique4"],
+        budget_rows=budget_rows,
+    )
     return entry
+
+
+def _factorized_materialize(
+    g, est_lane_rows: int, est_flat_rows: int, budget_rows: int
+) -> dict:
+    """The clique4 MATERIALIZE leg: the shape that used to record an
+    unconditional ``transient rows ... over budget`` skip, because the
+    flat 3-walk row set (878M rows at SF1, the r06 note) cannot be
+    admitted. The factorized tier (``backend/tpu/factorized.py``) stores
+    that intermediate as prefix lanes + per-lane suffix runs, so its
+    transient is the LANE extent — the leg now measures under
+    ``TPU_CYPHER_FACTORIZE=force`` and only degrades to a typed skip when
+    the factorized (lane) estimate itself busts the budget. A flat
+    comparison sub-leg runs when the flat estimate fits, yielding the
+    ``factorized_vs_flat`` speedup; both sub-legs degrade to notes, never
+    raises — an exception here must not kill the JSON line."""
+    from tpu_cypher import errors as ERR
+    from tpu_cypher.utils.config import FACTORIZE, WCOJ_MODE
+
+    leg = {
+        "est_lane_rows": int(est_lane_rows),
+        "est_flat_rows": int(est_flat_rows),
+    }
+    # lanes are lean (prefix ids + run bounds), so the lane estimate gets
+    # the same x8 slack as the count-tier legs above
+    if est_lane_rows > budget_rows * 8:
+        leg["factorized_seconds"] = None
+        leg["skipped"] = (
+            f"factorized lane rows {int(est_lane_rows)} over budget"
+        )
+        return leg
+    WCOJ_MODE.set("force")
+    FACTORIZE.set("force")
+    try:
+        dtf, outf, tierf = _time_query(g, CLIQUE4_MAT, repeats=1)
+        leg["factorized_seconds"] = round(dtf, 6)
+        leg["hubs"] = int(outf[0]["hubs"])
+        leg["factorized_tier"] = tierf
+    except ERR.AdmissionRejected as exc:
+        leg["factorized_seconds"] = None
+        leg["skipped"] = f"admission rejected: {exc}"[:200]
+        return leg
+    except Exception as exc:
+        leg["factorized_seconds"] = None
+        leg["error"] = f"{type(exc).__name__}: {exc}"[:200]
+        return leg
+    finally:
+        WCOJ_MODE.reset()
+        FACTORIZE.reset()
+    if est_flat_rows <= budget_rows:
+        WCOJ_MODE.set("force")
+        FACTORIZE.set("off")
+        try:
+            dtl, outl, _ = _time_query(g, CLIQUE4_MAT, repeats=1)
+            leg["flat_seconds"] = round(dtl, 6)
+            leg["counts_match"] = int(outl[0]["hubs"]) == leg["hubs"]
+            leg["factorized_vs_flat"] = round(dtl / max(dtf, 1e-9), 2)
+        except Exception as exc:
+            leg["flat_seconds"] = None
+            leg["flat_error"] = f"{type(exc).__name__}: {exc}"[:200]
+        finally:
+            WCOJ_MODE.reset()
+            FACTORIZE.reset()
+    else:
+        leg["flat_seconds"] = None
+        leg["flat_skipped"] = f"flat rows {int(est_flat_rows)} over budget"
+    return leg
 
 
 def run_config(
@@ -779,7 +861,13 @@ def run_config(
     rung["wcoj_vs_binary"] = _wcoj_vs_binary(
         g,
         feasible_binary=two_hop_paths <= budget_rows * 8,
-        est_rows={"triangle": min_deg_sum, "clique4": int(w3.sum())},
+        # clique4_lanes: the factorized materialize stores lanes (triangle
+        # prefixes, bounded by the 2-walk count), not the flat 3-walk set
+        est_rows={
+            "triangle": min_deg_sum,
+            "clique4": int(w3.sum()),
+            "clique4_lanes": int(w2.sum()),
+        },
         budget_rows=budget_rows,
     )
 

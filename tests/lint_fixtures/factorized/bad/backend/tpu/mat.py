@@ -1,0 +1,28 @@
+"""known-bad: the factorized run layout decoded without lattice discipline."""
+import jax.numpy as jnp
+
+from backend.tpu import bucketing
+
+
+def decode_exact_total(cnts, flat_mask):
+    # the flat total (sum of run counts) baked unrounded into the decode
+    # materialize: one compiled program per distinct factorization
+    tot = int(jnp.sum(cnts))
+    return jnp.nonzero(flat_mask, size=tot)[0]
+
+
+def search_unmasked_prefix(run_mask, count_dev):
+    size = bucketing.round_size(int(count_dev))
+    cnts = jnp.nonzero(run_mask, size=size)[0]
+    # cumsum forfeits the mask: pad lanes absorb the running total, so
+    # the rank search binds flat rows to dead lanes
+    prefix = jnp.cumsum(cnts)
+    flat = jnp.arange(size)
+    return jnp.searchsorted(prefix, flat, side="right")
+
+
+def sum_unmasked_run_counts(run_mask, count_dev):
+    size = bucketing.round_size(int(count_dev))
+    cnts = jnp.nonzero(run_mask, size=size)[0]
+    # pad-lane run counts pollute the flat-row total
+    return jnp.sum(cnts)

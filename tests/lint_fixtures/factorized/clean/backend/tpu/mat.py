@@ -1,0 +1,37 @@
+"""known-clean: the factorized run layout under full lattice discipline.
+
+Mirrors ``backend/tpu/factorized.py``: lane and flat extents both round
+the bucket lattice, dead-lane prefix sums are masked to the sentinel
+before the rank search, and weighted totals mask pad lanes to the
+neutral element first.
+"""
+import jax.numpy as jnp
+
+from backend.tpu import bucketing
+
+ID_SENTINEL = 1 << 62
+
+
+def decode_rounded_total(cnts, flat_mask):
+    tot = bucketing.round_size(int(jnp.sum(cnts)))
+    return jnp.nonzero(flat_mask, size=tot)[0]
+
+
+def search_masked_prefix(run_mask, count_dev, total_dev):
+    n = int(count_dev)
+    size = bucketing.round_size(n)
+    cnts = jnp.nonzero(run_mask, size=size)[0]
+    live = jnp.arange(size) < n
+    # re-establish the mask cumsum forfeited: dead lanes to the sentinel
+    # so the rank search never lands on them
+    prefix = jnp.where(live, jnp.cumsum(cnts), ID_SENTINEL)
+    flat = jnp.arange(bucketing.round_size(int(total_dev)))
+    return jnp.searchsorted(prefix, flat, side="right")
+
+
+def sum_masked_run_counts(run_mask, count_dev):
+    n = int(count_dev)
+    size = bucketing.round_size(n)
+    cnts = jnp.nonzero(run_mask, size=size)[0]
+    live = jnp.arange(size) < n
+    return jnp.sum(jnp.where(live, cnts, 0))

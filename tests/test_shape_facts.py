@@ -257,6 +257,11 @@ EXPECTED_LINES = {
     # the per-shard kernel bodies (the sharded tiers' compile boundary)
     ("shard_map", "pad-mask-discipline"): [19, 30],
     ("shard_map", "shape-stability"): [40],
+    # the factorized run layout (backend/tpu/factorized.py): the rules
+    # classify run-count prefixes, sentinel-masked cumsums, and the
+    # mixed-radix decode extent like any other bucketed materialize
+    ("factorized", "shape-stability"): [11],
+    ("factorized", "pad-mask-discipline"): [21, 28],
 }
 
 

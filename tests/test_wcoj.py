@@ -41,7 +41,7 @@ from tpu_cypher.backend.tpu.graph_index import GraphIndex, GraphIndexError
 from tpu_cypher.backend.tpu.pallas import dispatch, intersect as PI
 from tpu_cypher.backend.tpu import wcoj as W
 from tpu_cypher.runtime import faults, guard
-from tpu_cypher.utils.config import REGISTRY, WCOJ_MIN_ROWS, WCOJ_MODE
+from tpu_cypher.utils.config import FACTORIZE, REGISTRY, WCOJ_MIN_ROWS, WCOJ_MODE
 
 
 @pytest.fixture(autouse=True)
@@ -51,6 +51,7 @@ def _clean():
     yield
     WCOJ_MODE.reset()
     WCOJ_MIN_ROWS.reset()
+    FACTORIZE.reset()
     dispatch.MODE.reset()
     dispatch.reset()
     bucketing.MODE.reset()
@@ -247,10 +248,13 @@ def test_corner_graphs(create, expected):
 
 
 def test_multi_close_materialize_degrades_to_shadow(loopy_oracle):
-    """A 4-clique on a LOOPY graph carries uniqueness pairs, forcing the
-    materializing tier — which supports exactly one close constraint.
-    The fused op must answer through its classic shadow plan, correctly."""
+    """With factorized execution pinned OFF, the multi-close materialize
+    keeps its historical contract: a 4-clique on a LOOPY graph carries
+    uniqueness pairs, forcing the materializing tier — whose flat form
+    supports exactly one close constraint. The fused op must answer
+    through its classic shadow plan, correctly."""
     WCOJ_MODE.set("force")
+    FACTORIZE.set("off")
     clique = CYCLIC_CORPUS[5]
     g = CypherSession.tpu().create_graph_from_create_query(_loopy_create())
     before = _tiers()
@@ -258,6 +262,23 @@ def test_multi_close_materialize_degrades_to_shadow(loopy_oracle):
     after = _tiers()
     assert got == [dict(r) for r in loopy_oracle[clique]]
     assert after["shadow"] > before["shadow"]
+
+
+def test_multi_close_materialize_measured_by_default(loopy_oracle):
+    """The factorized tier (backend/tpu/factorized.py) lifts the
+    single-close restriction: by default the same 4-clique answers
+    through a MEASURED materialize tier — run-decode over the per-close
+    intersection counts — instead of falling back to the shadow plan."""
+    WCOJ_MODE.set("force")
+    clique = CYCLIC_CORPUS[5]
+    g = CypherSession.tpu().create_graph_from_create_query(_loopy_create())
+    before = _tiers()
+    got = [dict(r) for r in g.cypher(clique).records.collect()]
+    after = _tiers()
+    assert got == [dict(r) for r in loopy_oracle[clique]]
+    assert after["shadow"] == before["shadow"]
+    measured = ("materialize", "factorized")
+    assert sum(after[t] for t in measured) > sum(before[t] for t in measured)
 
 
 # ---------------------------------------------------------------------------
@@ -478,6 +499,14 @@ def test_bench_wcoj_vs_binary_rung():
         # force leg answers from a wcoj tier, the off leg never touches one
         assert "wcoj" in entry["wcoj_tier"], entry
         assert "wcoj" not in entry["binary_tier"], entry
+    # the factorized materialize leg: measured (not skipped), answered
+    # from the factorized tier, flat comparison agrees and yields the
+    # speedup field
+    mat = out["clique4_materialize"]
+    assert mat["factorized_seconds"] > 0, mat
+    assert "wcoj_factorized" in mat["factorized_tier"], mat
+    assert mat["flat_seconds"] > 0 and mat["counts_match"] is True, mat
+    assert "factorized_vs_flat" in mat
     skipped = bench._wcoj_vs_binary(
         g, feasible_binary=False, est_rows=tiny, budget_rows=1_000_000
     )
@@ -510,3 +539,28 @@ def test_bench_wcoj_vs_binary_rung():
     assert near["clique4"]["wcoj_seconds"] > 0
     assert near["clique4"]["binary_seconds"] is None
     assert near["clique4"]["binary_skipped"]
+    # the materialize leg's gates are FACTORIZED-shaped: an over-budget
+    # LANE estimate is the only typed skip, and an over-budget flat
+    # estimate only drops the comparison sub-leg (the factorized leg
+    # still measures — the old unconditional clique4 skip is gone)
+    big = 10_000_001  # over budget*8: skips the count legs, which these
+    # two cases don't look at — they probe the materialize leg's gates
+    lane_gated = bench._wcoj_vs_binary(
+        g,
+        feasible_binary=False,
+        est_rows={"triangle": big, "clique4": big, "clique4_lanes": big},
+        budget_rows=1_000_000,
+    )
+    m = lane_gated["clique4_materialize"]
+    assert m["factorized_seconds"] is None
+    assert "over budget" in m["skipped"]
+    flat_gated = bench._wcoj_vs_binary(
+        g,
+        feasible_binary=False,
+        est_rows={"triangle": big, "clique4": big, "clique4_lanes": e},
+        budget_rows=1_000_000,
+    )
+    m = flat_gated["clique4_materialize"]
+    assert m["factorized_seconds"] > 0, m
+    assert m["flat_seconds"] is None
+    assert "over budget" in m["flat_skipped"]

@@ -272,6 +272,22 @@ OPERATOR_FORMULAS: List[Dict[str, str]] = [
     {"op": "int|float|bool", "class": "sync",
      "padded_shape": "preserves the size class of the synced operand "
                      "(a synced DATA_DEPENDENT count stays DATA_DEPENDENT)"},
+    # the factorized run-decompress family (backend/tpu/factorized.py):
+    # lane-extent prefix programs plus the bucketed flat-extent decode
+    {"op": "factorized._runs_weights", "class": "run_prefix",
+     "padded_shape": "lane extent (input); per-lane run products cumsum "
+                     "into exclusive prefixes masked to ID_SENTINEL past "
+                     "the live lanes (the pad-mask discipline cumsum "
+                     "otherwise forfeits)"},
+    {"op": "factorized._decode_runs", "class": "run_decode",
+     "padded_shape": "size (bucketed: round_size(chunk or total) passed "
+                     "static); searchsorted over the sentinel-masked "
+                     "prefix then mixed-radix positions at the same "
+                     "extent"},
+    {"op": "factorized._gather_decoded", "class": "gather",
+     "padded_shape": "shape(i) (the decoded flat extent); pad lanes "
+                     "gather duplicate payload and stay dead via the "
+                     "decode's live mask"},
 ]
 
 

@@ -46,6 +46,31 @@ from .column import (
 from .graph_index import CANON_NODE, CANON_REL, GraphIndex, GraphIndexError, rekey_element_expr
 
 
+def _flat_in(t):
+    """Coerce a (possibly factorized) input table to its flat form before
+    positional ``_cols`` access — identity for plain ``TpuTable`` inputs,
+    an admission-guarded decompress for ``FactorizedTable`` ones."""
+    from .table import ensure_flat
+
+    return ensure_flat(t)
+
+
+@jax.jit
+def _csr_run_bounds(rp, pos, present, nvalid):
+    """Per-lane adjacency run bounds straight off the CSR row pointers:
+    ``(lo, cnt, total)`` where lane ``i``'s suffix run is
+    ``ci[lo[i]:lo[i]+cnt[i]]``. Dead lanes (absent frontier ids, tail
+    pads past ``nvalid``) carry ``cnt = 0`` so they contribute no flat
+    rows; the clip keeps the row-pointer gather in-bounds for them (an
+    OOB gather under jit fills with int64 min)."""
+    live = present & (jnp.arange(pos.shape[0], dtype=jnp.int64) < nvalid)
+    p = jnp.clip(pos, 0, rp.shape[0] - 2)
+    lo = jnp.where(live, jnp.take(rp, p), 0)
+    cnt = jnp.where(live, jnp.take(rp, p + 1) - jnp.take(rp, p), 0)
+    cnt = jnp.maximum(cnt, 0)
+    return lo, cnt, jnp.sum(cnt)
+
+
 def _mxu_dense_mode() -> bool:
     """Route 2-hop counts through the MXU dense tier (blocked bf16 A @ A,
     ``jit_ops.mxu_close_count``/``mxu_distinct_pairs``)? Defaults to ON for
@@ -302,7 +327,7 @@ class _FusedExpandBase(RelationalOperator):
         input-table id column at ``row`` (element ids are global, so the
         comparison is sound across type sets and fallback paths)."""
         in_op = self.children[0]
-        in_t = in_op.table
+        in_t = _flat_in(in_op.table)
         rel_cols, rel_header = gi.rel_scan(self.types_key, ctx)
         canon_id = rel_header.id_expr(rel_header.var(CANON_REL))
         own_ids = None
@@ -462,7 +487,7 @@ class _FusedExpandBase(RelationalOperator):
 
         ctx = self.context
         in_op = self.children[0]
-        in_t = in_op.table
+        in_t = _flat_in(in_op.table)
         rel_cols, rel_header = gi.rel_scan(self.types_key, ctx)
         if far_var is not None:
             node_cols, node_header, _ = gi.node_scan(far_labels, ctx)
@@ -650,7 +675,7 @@ class CsrExpandOp(_FusedExpandBase):
         hops = self._chain_hops()
         base = hops[-1]
         in_op = base.children[0]
-        in_t = in_op.table
+        in_t = _flat_in(in_op.table)
         frontier_var = in_op.header.var(base.frontier_fld)
         id_col = in_t._cols[in_op.header.column(in_op.header.id_expr(frontier_var))]
         gi.node_ids(ctx)  # build the compact id space (validates the graph)
@@ -758,7 +783,7 @@ class CsrExpandOp(_FusedExpandBase):
             gi = GraphIndex.of(self.graph)
             ctx = self.context
             in_op = base.children[0]
-            in_t = in_op.table
+            in_t = _flat_in(in_op.table)
             frontier_var = in_op.header.var(base.frontier_fld)
             id_col = in_t._cols[
                 in_op.header.column(in_op.header.id_expr(frontier_var))
@@ -897,6 +922,85 @@ class CsrExpandOp(_FusedExpandBase):
             NATIVE_TIER_COUNTS.inc("two_hop")
         return got
 
+    def _factorized_expand(self, gi: GraphIndex, ctx, in_op, in_t, pos, present):
+        """The expand output as a ``FactorizedTable`` — input rows are the
+        lanes, each lane's suffix run is its CSR adjacency slice, and rel/
+        far-node columns decode through ``(eo,)`` / ``(ci, row_map)``
+        gather-map chains only at collect time. Eligible for directed,
+        label-free, uniqueness-free expands whose routed flat estimate the
+        factorized router rejects (``optimizer.cost.prefer_factorized``);
+        returns None to keep the classic flat materialize."""
+        from ...optimizer.cost import factorized_routing_enabled, prefer_factorized
+        from .factorized import FactorizedTable, RunLevel, note_factorized
+        from .table import TpuTable
+
+        if (
+            self.undirected
+            or self.far_labels
+            or self.enforced_pairs
+            or gi.num_nodes == 0
+            or not self.header.expressions
+            # the pre-gate keeps the default configuration free: no
+            # run-bounds program or row-total sync unless routing is live
+            or not factorized_routing_enabled()
+        ):
+            return None
+        rp, ci, eo = gi.csr(self.types_key, self.backwards, ctx)
+        if int(ci.shape[0]) == 0:
+            return None
+        fault_point("expand")  # the run-total scalar sync below
+        lo, cnt, t_dev = _csr_run_bounds(rp, pos, present, np.int64(in_t.size))
+        total = int(t_dev)
+        nexprs = max(len(self.header.expressions), 1)
+        if not prefer_factorized(total, 24 + 9 * nexprs):
+            return None
+        rel_cols, rel_header = gi.rel_scan(self.types_key, ctx)
+        node_cols, node_header, row_map = gi.node_scan((), ctx)
+        canon_rel = E.Var(CANON_REL)
+        canon_node = E.Var(CANON_NODE)
+        phys = int(pos.shape[0])
+        pfx_cols: Dict[str, Column] = {}
+        lvl_cols: Dict[str, Tuple[Column, Tuple[Any, ...]]] = {}
+        for e in self.header.expressions:
+            col = self.header.column(e)
+            if col in pfx_cols or col in lvl_cols:
+                continue
+            if e in in_op.header:
+                src = in_t._cols[in_op.header.column(e)]
+                if src.kind != OBJ and len(src) != phys:
+                    return None  # misaligned pass-through: flat path
+                pfx_cols[col] = src
+                continue
+            owner = _owner_name(e)
+            if owner == self.rel_fld:
+                key = rekey_element_expr(e, canon_rel)
+                if key is None or key not in rel_header:
+                    raise GraphIndexError(f"unmapped rel expr {e!r}")
+                src = rel_cols[rel_header.column(key)]
+                if src.kind == OBJ or len(src) == 0:
+                    return None  # host-gather columns cannot ride the decode
+                lvl_cols[col] = (src, (eo,))
+                continue
+            if owner == self.far_fld:
+                key = rekey_element_expr(e, canon_node)
+                if key is None or key not in node_header:
+                    raise GraphIndexError(f"unmapped node expr {e!r}")
+                src = node_cols[node_header.column(key)]
+                if src.kind == OBJ or len(src) == 0:
+                    return None
+                lvl_cols[col] = (src, (ci, row_map))
+                continue
+            raise GraphIndexError(f"unmapped expr {e!r}")
+        # the compressed form pays admission for its two run-bound arrays
+        # at the LANE extent — never the flat product
+        bucketing.admit(in_t.size, 16, "factorized")
+        prefix = TpuTable(pfx_cols, in_t.size)
+        out = FactorizedTable(
+            prefix, (RunLevel(lo, cnt, lvl_cols),), nrows=total
+        )
+        note_factorized(total, phys, in_t.size)
+        return out
+
     def _fused_table(self):
         fault_point("expand")
         gi = GraphIndex.of(self.graph)
@@ -909,10 +1013,13 @@ class CsrExpandOp(_FusedExpandBase):
 
             return TpuTable({}, self._count_via_chain(gi, ctx))
         in_op = self.children[0]
-        in_t = in_op.table
+        in_t = _flat_in(in_op.table)
         frontier_var = in_op.header.var(self.frontier_fld)
         id_col = in_t._cols[in_op.header.column(in_op.header.id_expr(frontier_var))]
         pos, present = gi.compact_of(id_col, ctx)
+        fact = self._factorized_expand(gi, ctx, in_op, in_t, pos, present)
+        if fact is not None:
+            return fact
         primary_reverse = self.backwards
         bucketed = bucketing.enabled()
         row, nbr, orig, n_live = self._expand_half(
@@ -1089,7 +1196,7 @@ class CsrExpandIntoOp(_FusedExpandBase):
             gi = GraphIndex.of(self.graph)
             ctx = self.context
             base_in = base.children[0]
-            in_t = base_in.table
+            in_t = _flat_in(base_in.table)
             frontier_var = base_in.header.var(base.frontier_fld)
             id_col = in_t._cols[
                 base_in.header.column(base_in.header.id_expr(frontier_var))
@@ -1268,7 +1375,7 @@ class CsrExpandIntoOp(_FusedExpandBase):
 
                 return TpuTable({}, n)
         in_op = self.children[0]
-        in_t = in_op.table
+        in_t = _flat_in(in_op.table)
         gi = GraphIndex.of(self.graph)
         ctx = self.context
         h = in_op.header
@@ -1345,7 +1452,7 @@ class CsrOptionalExpandOp(_FusedExpandBase):
         gi = GraphIndex.of(self.graph)
         ctx = self.context
         in_op = self.children[0]
-        in_t = in_op.table
+        in_t = _flat_in(in_op.table)
         frontier_var = in_op.header.var(self.frontier_fld)
         id_col = in_t._cols[in_op.header.column(in_op.header.id_expr(frontier_var))]
         gi.node_ids(ctx)
@@ -1480,7 +1587,7 @@ class CsrVarExpandOp(_FusedExpandBase):
         if not self.enforced_pairs:
             return ()
         in_op = self.children[0]
-        in_t = in_op.table
+        in_t = _flat_in(in_op.table)
         h = in_op.header
         sorted_ids, perm = gi.rel_row_index(self.types_key, ctx)
         out = []
@@ -1555,7 +1662,7 @@ class CsrVarExpandOp(_FusedExpandBase):
         count_only = not header.expressions
         gi = GraphIndex.of(self.graph)
         ctx = self.context
-        in_t = in_op.table
+        in_t = _flat_in(in_op.table)
         frontier_var = in_op.header.var(self.source_fld)
         id_col = in_t._cols[in_op.header.column(in_op.header.id_expr(frontier_var))]
         gi.node_ids(ctx)
@@ -1645,7 +1752,7 @@ class CsrVarExpandOp(_FusedExpandBase):
 
         ctx = self.context
         in_op = self.children[0]
-        in_t = in_op.table
+        in_t = _flat_in(in_op.table)
         header = self.header
         if not levels:
             row0 = jnp.zeros(0, jnp.int64)

@@ -189,6 +189,17 @@ def _cols_take_maybe_chunked(dev, idx):
     return out
 
 
+def ensure_flat(t):
+    """Flatten a factorized table (``factorized.FactorizedTable``) to its
+    ``TpuTable`` form — identity on anything already flat. Duck-typed on
+    ``to_flat_table`` so this module never imports ``factorized`` (which
+    imports this one). Every fused-operator input boundary and binary-op
+    ``other`` side passes through here: the flatten is admission-guarded,
+    so an over-budget decompress surfaces as ``AdmissionRejected``."""
+    to_flat = getattr(t, "to_flat_table", None)
+    return to_flat() if to_flat is not None else t
+
+
 class TpuTable(Table):
     def __init__(self, cols: Dict[str, Column], nrows: Optional[int] = None):
         self._cols = dict(cols)
@@ -485,6 +496,7 @@ class TpuTable(Table):
     # -- join --------------------------------------------------------------
 
     def join(self, other: "TpuTable", kind, join_cols) -> "TpuTable":
+        other = ensure_flat(other)
         # bucketed mode keeps pads: the device join folds explicit row-tail
         # masks instead (pad rows can never match), so two inputs whose row
         # counts share a bucket reuse one compiled join pipeline
@@ -842,6 +854,7 @@ class TpuTable(Table):
     # -- union -------------------------------------------------------------
 
     def union_all(self, other: "TpuTable") -> "TpuTable":
+        other = ensure_flat(other)
         t, o = self._depad(), other._depad()
         if t is not self or o is not other:
             return t.union_all(o)

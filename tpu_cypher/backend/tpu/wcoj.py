@@ -47,6 +47,8 @@ from __future__ import annotations
 from functools import partial
 from typing import Any, Dict, NamedTuple, Optional, Tuple
 
+import numpy as np
+
 import jax
 import jax.numpy as jnp
 
@@ -59,8 +61,10 @@ from ...relational.ops import RelationalOperator
 from . import bucketing
 from . import jit_ops as J
 from .column import (
+    OBJ,
     Column,
     TpuBackendError,
+    mask_to_idx as _mask_to_idx,
     mask_to_idx_bucketed as _mask_to_idx_bucketed,
 )
 from .expand_op import (
@@ -78,7 +82,7 @@ from .graph_index import (
 )
 
 # which tier answered each multiway-intersect pull — bench.py reports these
-# per rung (wcoj_count / wcoj_materialize / wcoj_shadow)
+# per rung (wcoj_count / wcoj_materialize / wcoj_factorized / wcoj_shadow)
 WCOJ_TIER_COUNTS = CounterView(
     _OBS_REGISTRY.counter(
         "tpu_cypher_wcoj_tier_total",
@@ -86,7 +90,7 @@ WCOJ_TIER_COUNTS = CounterView(
         labels=("tier",),
     ),
     "tier",
-    ("count", "materialize", "shadow"),
+    ("count", "materialize", "factorized", "shadow"),
 )
 
 _MESH_WCOJ_TOTAL = _OBS_REGISTRY.counter(
@@ -211,6 +215,22 @@ def _clamp_rows(far_rows):
     return jnp.maximum(far_rows, 0)
 
 
+@jax.jit
+def _zero_counts(m, keep):
+    # lane-domain uniqueness folds into the run multiplicities: a dropped
+    # lane contributes zero flat rows, so the factorized form never even
+    # decodes it
+    return jnp.where(keep, m, 0)
+
+
+@jax.jit
+def _eo_at(eo, pos):
+    # run positions of dead/pad rows are clamped by the decode; clip keeps
+    # the orig-edge gather in-bounds regardless (OOB under jit fills with
+    # int64 min, which would poison downstream rel-scan gathers)
+    return jnp.take(eo, jnp.clip(pos, 0, eo.shape[0] - 1))
+
+
 class MultiwayIntersectOp(_FusedExpandBase):
     """Relational operator: candidate = intersection of K adjacency lists.
 
@@ -321,8 +341,10 @@ class MultiwayIntersectOp(_FusedExpandBase):
         """Compact positions + presence per anchor variable; ``valid`` is
         the all-anchors-present row mask (an absent anchor matches no
         edge, exactly the classic join's null semantics)."""
+        from .table import ensure_flat
+
         in_op = self.children[0]
-        in_t = in_op.table
+        in_t = ensure_flat(in_op.table)
         h = in_op.header
         out = []
         valid = None
@@ -413,15 +435,18 @@ class MultiwayIntersectOp(_FusedExpandBase):
         """Materializing tier (row-producing headers and/or uniqueness
         enforcement): iterate the pivot, expand each lane by its close
         range count so close-edge origs are recoverable as ``eo[lo+k]``.
-        Output-bound, single close only — a multi-close materialize (a
-        4-clique whose rel vars someone reads) degrades to the shadow."""
+        Single close keeps the classic output-bound flat path unless the
+        factorized router (``optimizer.cost.prefer_factorized``) swaps in
+        the run-compressed form; a multi-close materialize (a 4-clique
+        whose rel vars someone reads) runs through
+        :meth:`_materialize_multi_close` instead of declining to the
+        shadow."""
         from . import pallas as P
         from .table import TpuTable
+        from ...optimizer.cost import prefer_factorized
 
         if len(self.closes) != 1:
-            raise GraphIndexError(
-                "multiway materialize supports exactly one close constraint"
-            )
+            return self._materialize_multi_close(gi, ctx, lists, valid)
         pivot, close = lists[0], lists[1]
         n = gi.num_nodes
         mask = gi.label_mask(self.pivot.far_labels, ctx)
@@ -445,6 +470,27 @@ class MultiwayIntersectOp(_FusedExpandBase):
             m = _apply_label_mask(m, mask, cand)
             out_dev = _sum_counts(m)
         n_out = int(out_dev)
+        pair_flds = {r for pr in self.enforced_pairs for r in pr}
+        if (
+            self.header.expressions
+            and self.closes[0].rel_fld not in pair_flds
+            and prefer_factorized(
+                n_out, 32 + 9 * max(len(self.header.expressions), 1)
+            )
+        ):
+            if self.enforced_pairs:
+                # no pair names the close rel, so uniqueness reads only
+                # lane-indexed ids and folds into the run multiplicities
+                fault_point("compact")
+                keep = self._wcoj_pair_keep(gi, ctx, row, orig_p, {})
+                m = _zero_counts(m, keep)
+                out_dev = _sum_counts(m)
+                n_out = int(out_dev)
+            fact = self._factorized_assemble(
+                gi, ctx, (close,), row, cand, orig_p, total, (lo,), (m,), n_out
+            )
+            if fact is not None:
+                return fact
         bucketing.admit(
             n_out, 32 + 9 * max(len(self.header.expressions), 1), "intersect"
         )
@@ -461,7 +507,9 @@ class MultiwayIntersectOp(_FusedExpandBase):
             # same compaction discipline as _apply_enforced_pairs (two own
             # rels here, so the keep mask is built locally)
             fault_point("compact")
-            keep = self._wcoj_pair_keep(gi, ctx, in_row, orig_p2, orig_c)
+            keep = self._wcoj_pair_keep(
+                gi, ctx, in_row, orig_p2, {self.closes[0].rel_fld: orig_c}
+            )
             if bucketed:
                 if int(in_row.shape[0]) != n_out:
                     keep = keep & J.row_tail_mask(in_row, n_out)
@@ -483,25 +531,208 @@ class MultiwayIntersectOp(_FusedExpandBase):
         _, _, row_map = gi.node_scan(self.pivot.far_labels, ctx)
         far_rows, _ = J.far_lookup(row_map, cand2)
         far_rows = _clamp_rows(far_rows)
-        return self._assemble_multi(gi, ctx, in_row, orig_p2, orig_c, far_rows, n_out)
+        return self._assemble_multi(
+            gi, ctx, in_row, orig_p2,
+            {self.closes[0].rel_fld: orig_c}, far_rows, n_out,
+        )
 
-    def _wcoj_pair_keep(self, gi: GraphIndex, ctx, row, orig_p, orig_c):
-        """Row-keep mask for enforced uniqueness pairs: the pivot rel reads
-        its canonical rel-scan id at ``orig_p``, the close rel its scan at
-        ``orig_c``, any other rel its input-table id column at ``row`` —
-        element ids are global, so cross-type comparisons stay sound."""
-        in_op = self.children[0]
-        in_t = in_op.table
+    def _materialize_multi_close(self, gi: GraphIndex, ctx, lists, valid):
+        """Multi-close materialize (a 4-clique whose rel vars someone
+        reads, or whose uniqueness pairs survive the planner proof)
+        through the run-compressed representation: one suffix level per
+        close, lane weights = per-lane range-count products. The flat row
+        product (clique4 at SF1: ~878M rows) never materializes — either
+        the output stays a ``FactorizedTable``, or the decode walks the
+        runs directly at the OUTPUT extent (cycle-count-sized).
+        ``TPU_CYPHER_FACTORIZE=off`` keeps the classic decline-to-shadow."""
+        from . import pallas as P
+        from .factorized import _decode_runs, _runs_weights, factorize_mode
+        from .table import TpuTable
+        from ...optimizer.cost import prefer_factorized
+
+        if factorize_mode() == "off":
+            raise GraphIndexError(
+                "multiway materialize supports exactly one close constraint"
+            )
+        fault_point("expand")  # lane/output totals sync below
+        pivot, closes = lists[0], lists[1:]
+        n = gi.num_nodes
+        mask = gi.label_mask(self.pivot.far_labels, ctx)
+        deg, t_dev = J.expand_degrees_total(pivot.rp, pivot.pos, valid)
+        total = int(t_dev)
+        bucketing.admit(total, 24 + 16 * len(closes), "intersect")
+        bucketed = bucketing.enabled()
+        if bucketed:
+            size = bucketing.round_size(total)
+            row, cand, orig_p, live = P.expand_materialize_counted(
+                pivot.rp, pivot.ci, pivot.eo, pivot.pos, deg, t_dev, size=size
+            )
+        else:
+            row, cand, orig_p = J.expand_materialize(
+                pivot.rp, pivot.ci, pivot.eo, pivot.pos, deg, total=total
+            )
+            live = None
+        los, cnts = [], []
+        for j, close in enumerate(closes):
+            q, qok = _probe_queries(close.pos, close.ok, row, cand, live, n=n)
+            lo_j, m_j, _ = P.intersect_range_count(close.keys, q, qok)
+            if j == 0 and mask is not None:
+                m_j = _apply_label_mask(m_j, mask, cand)
+            los.append(lo_j)
+            cnts.append(m_j)
+        pair_flds = {r for pr in self.enforced_pairs for r in pr}
+        pairs_on_close = bool(pair_flds & {c.rel_fld for c in self.closes})
+        if self.enforced_pairs and not pairs_on_close:
+            fault_point("compact")
+            keep = self._wcoj_pair_keep(gi, ctx, row, orig_p, {})
+            cnts[0] = _zero_counts(cnts[0], keep)
+        w, W, tot = _runs_weights(tuple(cnts), t_dev)
+        n_out = int(tot)
+        nexprs = max(len(self.header.expressions), 1)
+        if (
+            self.header.expressions
+            and not pairs_on_close
+            and prefer_factorized(n_out, 32 + 9 * nexprs)
+        ):
+            fact = self._factorized_assemble(
+                gi, ctx, closes, row, cand, orig_p, total,
+                tuple(los), tuple(cnts), n_out,
+            )
+            if fact is not None:
+                return fact
+        # flat through the runs: decode positions at the OUTPUT extent —
+        # the per-close blowup never exists on device
+        bucketing.admit(n_out, 32 + 9 * nexprs, "intersect")
+        size2 = bucketing.round_size(n_out)
+        i, pos, live2 = _decode_runs(
+            W, w, tuple(los), tuple(cnts), np.int64(0), np.int64(n_out), size2
+        )
+        in_row, cand2, orig_p2 = J.tree_take((row, cand, orig_p), i)
+        orig_cs = {
+            c.rel_fld: _eo_at(lst.eo, p_j)
+            for c, lst, p_j in zip(self.closes, closes, pos)
+        }
+        if self.enforced_pairs and pairs_on_close and n_out:
+            fault_point("compact")
+            keep = self._wcoj_pair_keep(gi, ctx, in_row, orig_p2, orig_cs)
+            if bucketed:
+                keep = keep & live2
+                idx, n_out = _mask_to_idx_bucketed(keep)
+                in_row, cand2, orig_p2 = J.tree_take(
+                    (in_row, cand2, orig_p2), idx
+                )
+                orig_cs = J.tree_take(orig_cs, idx)
+            else:
+                idx, n2 = _mask_to_idx(keep)
+                if n2 != n_out:
+                    in_row, cand2, orig_p2 = J.tree_take(
+                        (in_row, cand2, orig_p2), idx
+                    )
+                    orig_cs = J.tree_take(orig_cs, idx)
+                    n_out = n2
+        if not self.header.expressions:
+            return TpuTable({}, n_out)
+        _, _, row_map = gi.node_scan(self.pivot.far_labels, ctx)
+        far_rows, _ = J.far_lookup(row_map, cand2)
+        far_rows = _clamp_rows(far_rows)
+        return self._assemble_multi(
+            gi, ctx, in_row, orig_p2, orig_cs, far_rows, n_out
+        )
+
+    def _factorized_assemble(
+        self, gi: GraphIndex, ctx, closes, row, cand, orig_p, total,
+        los, cnts, n_out: int,
+    ):
+        """The materialize output in factorized form: prefix = the pivot
+        expansion's lane table (input pass-through at ``row``, pivot rel
+        at ``orig_p``, candidate node columns at ``far_rows``), one
+        suffix run level per close whose columns decode through the
+        ``eo[pos]`` gather-map chain at collect time. Admission pays for
+        LANES, never the flat product. Returns None when a close-rel
+        header column cannot ride the device decode (OBJ or empty rel
+        scan) — the caller keeps the flat path."""
+        from .factorized import FactorizedTable, RunLevel, note_factorized
+        from .table import TpuTable, ensure_flat
+
         p = self.pivot
-        c = self.closes[0]
+        in_op = self.children[0]
+        in_t = ensure_flat(in_op.table)
+        relp_cols, relp_header = gi.rel_scan(p.types_key, ctx)
+        node_cols, node_header, row_map = gi.node_scan(p.far_labels, ctx)
+        canon_rel = E.Var(CANON_REL)
+        canon_node = E.Var(CANON_NODE)
+        close_index = {c.rel_fld: j for j, c in enumerate(self.closes)}
+        plan: Dict[str, Tuple[Column, str]] = {}
+        level_plans = tuple({} for _ in closes)
+        for e in self.header.expressions:
+            col = self.header.column(e)
+            if col in plan or any(col in lp for lp in level_plans):
+                continue
+            if e in in_op.header:
+                plan[col] = (in_t._cols[in_op.header.column(e)], "row")
+                continue
+            owner = _owner_name(e)
+            if owner == p.rel_fld or owner in close_index:
+                key = rekey_element_expr(e, canon_rel)
+                if owner == p.rel_fld:
+                    cc, hh = relp_cols, relp_header
+                else:
+                    cc, hh = gi.rel_scan(
+                        self.closes[close_index[owner]].types_key, ctx
+                    )
+                if key is None or key not in hh:
+                    raise GraphIndexError(f"unmapped rel expr {e!r}")
+                src = cc[hh.column(key)]
+                if owner == p.rel_fld:
+                    plan[col] = (src, "origp")
+                    continue
+                if src.kind == OBJ or len(src) == 0:
+                    return None
+                level_plans[close_index[owner]][col] = src
+                continue
+            if owner == p.far_fld:
+                key = rekey_element_expr(e, canon_node)
+                if key is None or key not in node_header:
+                    raise GraphIndexError(f"unmapped node expr {e!r}")
+                plan[col] = (node_cols[node_header.column(key)], "far")
+                continue
+            raise GraphIndexError(f"unmapped expr {e!r}")
+        far_rows, _ = J.far_lookup(row_map, cand)
+        far_rows = _clamp_rows(far_rows)
+        bucketing.admit(total, 9 * max(len(plan), 1), "factorized")
+        count = total if bucketing.enabled() else None
+        pfx_cols = self._gather_plan(
+            plan, {"row": row, "origp": orig_p, "far": far_rows}, count=count
+        )
+        levels = [
+            RunLevel(lo_j, m_j, {c: (src, (lst.eo,)) for c, src in lp.items()})
+            for lo_j, m_j, lst, lp in zip(los, cnts, closes, level_plans)
+        ]
+        out = FactorizedTable(TpuTable(pfx_cols, total), levels, nrows=n_out)
+        note_factorized(n_out, int(row.shape[0]), total)
+        return out
+
+    def _wcoj_pair_keep(self, gi: GraphIndex, ctx, row, orig_p, orig_cs):
+        """Row-keep mask for enforced uniqueness pairs: the pivot rel reads
+        its canonical rel-scan id at ``orig_p``, a close rel its scan at
+        ``orig_cs[rel]`` (an empty dict means the caller proved no pair
+        names a close — the lane-domain fold), any other rel its
+        input-table id column at ``row`` — element ids are global, so
+        cross-type comparisons stay sound."""
+        from .table import ensure_flat
+
+        in_op = self.children[0]
+        in_t = ensure_flat(in_op.table)
+        p = self.pivot
+        close_types = {c.rel_fld: c.types_key for c in self.closes}
         cache: Dict[str, Any] = {}
 
         def ids_of(r):
             if r in cache:
                 return cache[r]
-            if r == p.rel_fld or r == c.rel_fld:
-                types_key = p.types_key if r == p.rel_fld else c.types_key
-                orig = orig_p if r == p.rel_fld else orig_c
+            if r == p.rel_fld or r in orig_cs:
+                types_key = p.types_key if r == p.rel_fld else close_types[r]
+                orig = orig_p if r == p.rel_fld else orig_cs[r]
                 cols, hh = gi.rel_scan(types_key, ctx)
                 cid = hh.id_expr(hh.var(CANON_REL))
                 out = jnp.take(cols[hh.column(cid)].data, orig)
@@ -523,23 +754,28 @@ class MultiwayIntersectOp(_FusedExpandBase):
             keep = k if keep is None else keep & k
         return keep
 
-    def _assemble_multi(self, gi: GraphIndex, ctx, row, orig_p, orig_c,
+    def _assemble_multi(self, gi: GraphIndex, ctx, row, orig_p, orig_cs,
                         far_rows, n_out: int):
-        """Column assembly with TWO rel sources: input pass-through at
-        ``row``, pivot rel at ``orig_p``, close rel at ``orig_c``,
-        candidate node columns at ``far_rows`` (``_assemble`` handles one
-        rel var; everything else is the same tagged-gather plan)."""
-        from .table import TpuTable
+        """Column assembly with one rel source per fused rel: input
+        pass-through at ``row``, pivot rel at ``orig_p``, close rel ``r``
+        at ``orig_cs[r]``, candidate node columns at ``far_rows``
+        (``_assemble`` handles one rel var; everything else is the same
+        tagged-gather plan)."""
+        from .table import TpuTable, ensure_flat
 
         in_op = self.children[0]
-        in_t = in_op.table
+        in_t = ensure_flat(in_op.table)
         p = self.pivot
-        c = self.closes[0]
         relp_cols, relp_header = gi.rel_scan(p.types_key, ctx)
-        relc_cols, relc_header = gi.rel_scan(c.types_key, ctx)
+        close_scans = {
+            c.rel_fld: gi.rel_scan(c.types_key, ctx)
+            for c in self.closes
+            if c.rel_fld in orig_cs
+        }
         node_cols, node_header, _ = gi.node_scan(p.far_labels, ctx)
         canon_rel = E.Var(CANON_REL)
         canon_node = E.Var(CANON_NODE)
+        tags = {r: f"origc{j}" for j, r in enumerate(orig_cs)}
         plan: Dict[str, Tuple[Column, str]] = {}
         for e in self.header.expressions:
             col = self.header.column(e)
@@ -549,13 +785,15 @@ class MultiwayIntersectOp(_FusedExpandBase):
                 plan[col] = (in_t._cols[in_op.header.column(e)], "row")
                 continue
             owner = _owner_name(e)
-            if owner == p.rel_fld or owner == c.rel_fld:
+            if owner == p.rel_fld or owner in close_scans:
                 key = rekey_element_expr(e, canon_rel)
-                hh = relp_header if owner == p.rel_fld else relc_header
+                if owner == p.rel_fld:
+                    cc, hh, tag = relp_cols, relp_header, "origp"
+                else:
+                    cc, hh = close_scans[owner]
+                    tag = tags[owner]
                 if key is None or key not in hh:
                     raise GraphIndexError(f"unmapped rel expr {e!r}")
-                cc = relp_cols if owner == p.rel_fld else relc_cols
-                tag = "origp" if owner == p.rel_fld else "origc"
                 plan[col] = (cc[hh.column(key)], tag)
                 continue
             if owner == p.far_fld:
@@ -566,11 +804,10 @@ class MultiwayIntersectOp(_FusedExpandBase):
                 continue
             raise GraphIndexError(f"unmapped expr {e!r}")
         count = n_out if bucketing.enabled() else None
-        out = self._gather_plan(
-            plan,
-            {"row": row, "origp": orig_p, "origc": orig_c, "far": far_rows},
-            count=count,
-        )
+        idx_by_tag = {"row": row, "origp": orig_p, "far": far_rows}
+        for r, tag in tags.items():
+            idx_by_tag[tag] = orig_cs[r]
+        out = self._gather_plan(plan, idx_by_tag, count=count)
         return TpuTable(out, n_out)
 
     def _fused_table(self):
@@ -617,9 +854,13 @@ class MultiwayIntersectOp(_FusedExpandBase):
             WCOJ_TIER_COUNTS.inc("count")
             _obs_trace.note("wcoj_tier", "count")
             return TpuTable({}, self._count(gi, ctx, lists, valid))
-        WCOJ_TIER_COUNTS.inc("materialize")
-        _obs_trace.note("wcoj_tier", "materialize")
-        return self._materialize(gi, ctx, lists, valid)
+        from .factorized import FactorizedTable
+
+        out = self._materialize(gi, ctx, lists, valid)
+        tier = "factorized" if isinstance(out, FactorizedTable) else "materialize"
+        WCOJ_TIER_COUNTS.inc(tier)
+        _obs_trace.note("wcoj_tier", tier)
+        return out
 
     def _compute_table(self):
         try:

@@ -107,6 +107,10 @@ class CostModel:
             calibration = feedback.get(graph, ctx)
         self.cal = calibration
         self.nsh = _mesh_size()
+        # plan steps this model priced at the factorized (run-compressed)
+        # lane extent instead of the flat row product — exported through
+        # joinorder's ``join_order`` span note for plan introspection
+        self.factorized_steps = 0
 
     # -- mesh-aware work units -------------------------------------------
 
@@ -142,6 +146,13 @@ class CostModel:
         input frontier and the (padded) output."""
         fanout = self.stats.avg_degree(types, reverse)
         est_out = est_in * fanout * self.stats.label_selectivity(target_labels)
+        if prefer_factorized(est_out, 9):
+            # factorized materialize touches the lane (prefix) extent,
+            # never the flat product: device work is the input frontier
+            # plus the run-bound gather over the same lanes
+            self.factorized_steps += 1
+            cost = self._w("expand") * (2.0 * self.work(est_in))
+            return est_out, cost + self.shuffle(est_in)
         cost = self._w("expand") * (self.work(est_in) + self.work(est_out))
         return est_out, cost + self.shuffle(est_out)
 
@@ -194,6 +205,66 @@ def prefer_wcoj(est_rows: int, graph, ctx) -> bool:
     """True when the modelled binary-expand blowup justifies the WCOJ
     tier for this graph."""
     return int(est_rows) > wcoj_threshold(graph, ctx)
+
+
+# -- factorized materialize routing (backend/tpu/factorized.py) -----------
+
+
+def factorized_rows(lanes: int) -> int:
+    """Padded physical size of a factorized intermediate: the *lane*
+    (prefix) extent on the runtime lattice — the sum of run counts, not
+    the run-product. This is the quantity a factorized materialize pays
+    admission for; the flat row product never exists on device."""
+    return padded_rows(lanes)
+
+
+def flat_materialize_busts(flat_rows, bytes_per_row: int) -> bool:
+    """True when a flat materialize of ``flat_rows`` would bust the
+    memory budget that ``bucketing.admit`` enforces — the same padded
+    bytes-per-row arithmetic, run as a what-if instead of a raise. With
+    no budget configured nothing busts (admission is wide open)."""
+    from ..backend.tpu import bucketing
+
+    budget = bucketing.memory_budget_bytes()
+    if budget <= 0:
+        return False
+    eff = (int(flat_rows) + _mesh_size() - 1) // max(_mesh_size(), 1)
+    return padded_rows(eff) * int(bytes_per_row) > budget
+
+
+def factorized_routing_enabled() -> bool:
+    """Cheap pre-gate for producers: can ``prefer_factorized`` possibly
+    answer True without knowing the flat estimate? ``off`` → no; ``auto``
+    with no admission budget → no (nothing busts a wide-open budget), so
+    the default configuration pays ZERO per-expand work — no run-bounds
+    program, no row-total sync — for the factorized route."""
+    from ..utils.config import FACTORIZE
+
+    mode = str(FACTORIZE.get()).strip().lower()
+    if mode == "force":
+        return True
+    if mode == "off":
+        return False
+    from ..backend.tpu import bucketing
+
+    return bucketing.memory_budget_bytes() > 0
+
+
+def prefer_factorized(flat_rows, bytes_per_row: int) -> bool:
+    """Route one materialize to the factorized (run-compressed) form.
+
+    ``TPU_CYPHER_FACTORIZE=force`` always routes it, ``off`` never does;
+    ``auto`` (default) chooses factorized exactly when the flat estimate
+    busts the admission budget — the case that used to decline to the
+    flat shadow tier or record an over-budget bench skip."""
+    from ..utils.config import FACTORIZE
+
+    mode = str(FACTORIZE.get()).strip().lower()
+    if mode == "force":
+        return True
+    if mode == "off":
+        return False
+    return flat_materialize_busts(flat_rows, bytes_per_row)
 
 
 # -- broadcast-vs-hash join window (parallel/shuffle.py) ------------------

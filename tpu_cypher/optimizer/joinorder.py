@@ -376,6 +376,7 @@ def _reorder_chain(head, chain: _Chain, ctx, transform) -> Optional[L.LogicalOpe
             "syntax_cost": round(float(syntax_cost), 1),
             "model_cost": round(float(best_cost), 1),
             "anchor": anchor or "(bound)",
+            "factorized_steps": int(model.factorized_steps),
         },
     )
     if chosen == "syntax":

@@ -17,6 +17,7 @@ to single-device, and the float kinds keep the global path. Gate:
 
 from __future__ import annotations
 
+from functools import partial
 from typing import Any, Dict, Optional, Tuple
 
 import numpy as np
@@ -28,6 +29,7 @@ from jax.sharding import PartitionSpec as P
 
 from ..obs import trace as _obs_trace
 from ..obs.metrics import REGISTRY as _REGISTRY
+from ..runtime.faults import fault_point
 from .mesh import current_mesh, mesh_size, shard_map
 from .shuffle import _pad_sharded
 
@@ -119,6 +121,7 @@ def sharded_segment_agg(
     for arr in (data, valid, seg_j):
         if arr is not None and not getattr(arr, "is_fully_addressable", True):
             return None
+    fault_point("agg")  # staging rows to host for resharding syncs here
     d_np = np.asarray(data)
     n = d_np.shape[0]
     if n == 0:
@@ -143,3 +146,51 @@ def sharded_segment_agg(
         return avg, cnt > 0
     agged = out.astype(bool) if is_bool else out
     return agged, cnt > 0
+
+
+# ---------------------------------------------------------------------------
+# run-length weighted partials (factorized join intermediates)
+# ---------------------------------------------------------------------------
+
+
+@jax.jit
+def _weighted_premultiply(data, valid, weight):
+    """Per-row weighted terms: each logical row stands for ``weight``
+    identical flat rows, so its count contribution is ``weight`` (0 when
+    invalid) and its sum contribution is ``data * weight``."""
+    w = weight if valid is None else jnp.where(valid, weight, 0)
+    if data is None:
+        return None, w
+    zero = jnp.zeros((), data.dtype)
+    d = data if valid is None else jnp.where(valid, data, zero)
+    return d * w.astype(data.dtype), w
+
+
+@partial(jax.jit, static_argnames=("k",))
+def _weighted_segment_sums(pre_sum, pre_cnt, seg_j, k: int):
+    wcnt = jax.ops.segment_sum(pre_cnt, seg_j, num_segments=k)
+    if pre_sum is None:
+        return None, wcnt
+    return jax.ops.segment_sum(pre_sum, seg_j, num_segments=k), wcnt
+
+
+def weighted_segment_partials(data, valid, weight, seg_j, k: int):
+    """Weighted segment partials ``(weighted_sum_or_None, weighted_count)``
+    for the factorized group path (``backend/tpu/factorized.py``): every
+    source row aggregates as ``weight`` identical flat rows without ever
+    decompressing. ``data=None`` computes the count partial only (count(*)
+    / count(expr) need no values). Integer inputs ride the sharded tier —
+    the premultiplied partials are integer sums, so the psum combine stays
+    exact/bit-identical — floats and the no-mesh case take one jitted
+    segment program."""
+    pre_sum, pre_cnt = _weighted_premultiply(data, valid, weight)
+    ints = data is None or jnp.issubdtype(data.dtype, jnp.integer)
+    if ints:
+        got_cnt = sharded_segment_agg(pre_cnt, None, seg_j, "sum", False, k)
+        if got_cnt is not None:
+            if pre_sum is None:
+                return None, got_cnt[0]
+            got_sum = sharded_segment_agg(pre_sum, None, seg_j, "sum", False, k)
+            if got_sum is not None:
+                return got_sum[0], got_cnt[0]
+    return _weighted_segment_sums(pre_sum, pre_cnt, seg_j, k)

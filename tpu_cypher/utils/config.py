@@ -259,6 +259,23 @@ WCOJ_MIN_ROWS = declare(
     "estimated binary-join intermediate exceeds this many rows",
 )
 
+# factorized join intermediates (backend/tpu/factorized.py)
+FACTORIZE = declare(
+    "TPU_CYPHER_FACTORIZE",
+    "auto",
+    str,
+    help="compressed (prefix x suffix-run) materialize tier for expand and "
+    "multiway-join intermediates: auto (only when the flat row set would "
+    "bust the admission budget) | force | off",
+)
+FACTORIZE_CHUNK_ROWS = declare(
+    "TPU_CYPHER_FACTORIZE_CHUNK_ROWS",
+    131072,
+    int,
+    help="logical rows decompressed per chunk when a factorized table is "
+    "enumerated (collect / one-shot flatten); floor 1024",
+)
+
 # cost-based adaptive query optimizer (tpu_cypher/optimizer/)
 OPT_MODE = declare(
     "TPU_CYPHER_OPT",
