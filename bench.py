@@ -359,6 +359,47 @@ def _shape_facts() -> dict:
         }
 
 
+
+def _mutation_soak() -> dict:
+    """Mixed read/write serving health: the 90/10 soak against the
+    WAL-backed delta-CSR store vs the read-only soak against the SAME
+    primed store — identical lattice, identical serving stack, only the
+    write stream differs. Both legs run with the result cache off so the
+    ratio measures engine-bound serving capacity (with the cache on, the
+    read-only side serves ~100% from cache while every write invalidates
+    the mixed side's entries — a cache benchmark, not a write-cost one).
+    ``recompiles_after_compaction`` is the across-compaction pin from
+    docs/mutation.md: the mixed window spans multiple delta compactions
+    and MUST stay 0. ``recovered_writes`` counts WAL batches replayed
+    into a fresh store by the offline differential; acked writes missing
+    after replay surface as failures. Never raises — a broken write path
+    reports {"error": ...} instead of killing the bench."""
+    try:
+        tests_dir = os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), "tests"
+        )
+        if tests_dir not in sys.path:
+            sys.path.insert(0, tests_dir)
+        import soak_serve
+
+        mixed = soak_serve.main(budget_s=4.0, clients=16, write_ratio=0.1,
+                                cache_bytes=0)
+        read_only = soak_serve.main(budget_s=4.0, clients=16, mutable=True,
+                                    cache_bytes=0)
+        return {
+            "mixed_qps": mixed["qps"],
+            "read_only_qps": read_only["qps"],
+            "ratio": round(mixed["qps"] / max(read_only["qps"], 1e-9), 3),
+            "recovered_writes": mixed["recovered_writes"],
+            "missing_committed_writes": mixed["missing_committed_writes"],
+            "recompiles_after_compaction": mixed["recompiles_after_warmup"],
+            "compactions": mixed["compactions"],
+            "failures": mixed["failures"] + read_only["failures"],
+        }
+    except Exception as exc:  # fault-ok: telemetry only
+        return {"error": str(exc)[:200]}
+
+
 def _serve_soak() -> dict:
     """Serving-layer health for the trajectory: a short non-chaos soak of
     the multi-tenant query server (tests/soak_serve.py — concurrent
@@ -1251,6 +1292,10 @@ def main():
         # short concurrent soak + the two regression tripwires
         # (recompiles_after_warmup, batched_dispatch_ratio)
         "serve_soak": _serve_soak(),
+        # mixed read/write serving health against the delta-CSR store:
+        # {mixed_qps, read_only_qps, ratio, recovered_writes,
+        # recompiles_after_compaction} — the ISSUE-17 acceptance numbers
+        "mutation_soak": _mutation_soak(),
         # mesh-execution health: 1d vs 8d virtual-device qps for two-hop +
         # triangle, plus the zero-warm-recompile proof of the per-shard
         # bucket lattice ({qps_1d, qps_8d, scaling_efficiency,

@@ -1,0 +1,30 @@
+"""known-bad: delta-overlay tables sized off the bucket lattice.
+
+The delta overlay holds the rows written since the last compaction. Its
+extents must round on the same lattice as the base tables — sizing them
+to the exact live row count compiles one scan/union program per distinct
+delta fill, which is the recompile-per-write storm the overlay exists to
+avoid.
+"""
+import jax.numpy as jnp
+
+from backend.tpu import jit_ops as J
+
+
+def overlay_exact_rows(live_mask, delta_rows):
+    # delta extent = exact number of live overlay rows: every committed
+    # write changes the scan shape
+    n = len(delta_rows)
+    return jnp.nonzero(live_mask, size=n)[0]
+
+
+def overlay_synced_count(live_mask):
+    # device-synced live count passed straight down as the static size
+    n = int(jnp.sum(live_mask))
+    return J.mask_nonzero(live_mask, size=n)
+
+
+def overlay_tombstone_repeat(vals, counts):
+    # tombstone expansion sized to the exact dead-row total
+    dead_total = int(jnp.sum(counts))
+    return jnp.repeat(vals, counts, total_repeat_length=dead_total)

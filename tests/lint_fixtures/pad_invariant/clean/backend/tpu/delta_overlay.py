@@ -1,0 +1,38 @@
+"""known-clean: delta-overlay extents round on the bucket lattice.
+
+The overlay pads to ``max(round_size(n), min_bucket)`` — one program
+shape per bucket, shared by every write batch that fits, so delta fill
+never re-keys a warm scan (docs/mutation.md).
+"""
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from backend.tpu import bucketing
+from backend.tpu import jit_ops as J
+
+MIN_BUCKET = 8
+
+
+def overlay_pad_target(n: int) -> int:
+    # the overlay's lattice home: round up, never below the min bucket
+    return max(bucketing.round_size(n), MIN_BUCKET)
+
+
+def overlay_live_rows(live_mask, count_dev):
+    size = bucketing.round_size(int(count_dev))
+    return J.mask_nonzero(live_mask, size=size)
+
+
+@partial(jax.jit, static_argnames=("k",))
+def overlay_gather_counted(live_mask, count, k: int):
+    # *_counted discipline: k is a static bucketed param, the true count
+    # travels as a traced operand and masks the tail
+    pos = jnp.nonzero(live_mask, size=k)[0]
+    return pos, jnp.arange(k, dtype=jnp.int64) < count
+
+
+def overlay_tombstone_repeat(vals, counts, dead_dev):
+    total = bucketing.round_up_pow2(int(dead_dev), MIN_BUCKET)
+    return jnp.repeat(vals, counts, total_repeat_length=total)

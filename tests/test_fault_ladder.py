@@ -166,6 +166,75 @@ def test_clean_path_logs_single_device_rung(graphs):
 
 
 # ---------------------------------------------------------------------------
+# the write-path matrix: commit fault sites x every kind (ISSUE 17). No
+# ladder here — a write either commits atomically or fails typed with
+# nothing durable; ``compact`` failures defer instead of failing the
+# already-committed write. Pure write statements (no read prefix) keep the
+# storage-tier ``compact`` site distinct from the device-tier one.
+# ---------------------------------------------------------------------------
+
+
+from tpu_cypher.storage import mutable_graph_from_create_query
+from tpu_cypher.utils.config import COMPACT_DELTA_MAX
+
+
+@pytest.mark.parametrize("kind", sorted(KIND_TO_ERROR))
+@pytest.mark.parametrize("site", ["wal_append", "delta_apply"])
+def test_write_fault_matrix_commit_atomic(tmp_path, site, kind):
+    s = CypherSession.tpu()
+    wal_path = str(tmp_path / f"{site}-{kind}.wal")
+    pg = mutable_graph_from_create_query(
+        s, "CREATE (:W {k: 0})", wal_path=wal_path
+    )
+    size = os.path.getsize(wal_path)
+    version = pg._graph._version
+
+    faults.set_spec(f"{kind}@{site}:1")
+    with pytest.raises(ERR.TpuCypherError) as ei:
+        s.cypher("CREATE (:W {k: 1})", graph=pg)
+    faults.set_spec(None)
+
+    # typed, never raw — same discipline as the read ladder
+    assert isinstance(ei.value, KIND_TO_ERROR[kind]), ei.value
+    assert not isinstance(ei.value, faults.InjectedFault)
+    # atomic: nothing durable, nothing visible (delta_apply rolls the WAL
+    # back to the pre-append offset; wal_append never reached it)
+    assert os.path.getsize(wal_path) == size
+    assert pg._graph._version == version
+    # the fault was transient: the same statement retried commits, and a
+    # cold rebuild from the WAL agrees (the failed attempt never replays)
+    s.cypher("CREATE (:W {k: 1})", graph=pg)
+    rebuilt = mutable_graph_from_create_query(
+        s, "CREATE (:W {k: 0})", wal_path=wal_path
+    )
+    for g in (pg, rebuilt):
+        got = s.cypher(
+            "MATCH (n:W) RETURN count(*) AS c", graph=g
+        ).records.collect()
+        assert got == [{"c": 2}], (site, kind, got)
+
+
+@pytest.mark.parametrize("kind", sorted(KIND_TO_ERROR))
+def test_write_fault_compact_defers(kind):
+    s = CypherSession.tpu()
+    pg = mutable_graph_from_create_query(s, "CREATE (:W {k: 0})")
+    COMPACT_DELTA_MAX.set(1)
+    try:
+        faults.set_spec(f"{kind}@compact:1")
+        r = s.cypher("CREATE (:W {k: 1})", graph=pg)  # must NOT raise
+        faults.set_spec(None)
+        assert r.write_stats["nodes_created"] == 1
+        m = pg._graph
+        assert m.deferred_compactions == 1
+        before = m.compactions
+        s.cypher("CREATE (:W {k: 2})", graph=pg)
+        assert m.compactions > before  # deferral retried next commit
+    finally:
+        COMPACT_DELTA_MAX.reset()
+        faults.set_spec(None)
+
+
+# ---------------------------------------------------------------------------
 # memory admission
 # ---------------------------------------------------------------------------
 

@@ -1,0 +1,505 @@
+"""Transactional graph mutation: delta-CSR writes, snapshot reads, WAL.
+
+Pins the docs/mutation.md contract end to end:
+
+* the Cypher write surface (CREATE / MERGE / SET / DELETE / DETACH
+  DELETE, with a MATCH/UNWIND/WITH read prefix) on both backends;
+* snapshot isolation — a query pins the (base, delta) pair it started
+  with; committed writes never move a pinned reader;
+* WAL durability — replay reproduces committed state byte-identically
+  vs a from-scratch rebuild, a torn tail is dropped, and a failed apply
+  rolls the log back;
+* the write-path fault sites (``wal_append`` / ``delta_apply`` /
+  ``compact``) fail the way the recovery story requires;
+* ZERO warm recompiles across a delta compaction (the bucket-lattice
+  invariant that keeps mutation from churning the compile cache);
+* the serving tier: write payloads carry counters, and the chained
+  statistics fingerprint invalidates cached reads after every write —
+  including cardinality-neutral SETs.
+"""
+
+import asyncio
+import json
+import os
+
+import pytest
+
+from tpu_cypher import errors as ERR
+from tpu_cypher.backend.tpu import bucketing
+from tpu_cypher.errors import MutationError
+from tpu_cypher.relational.session import CypherSession
+from tpu_cypher.runtime import faults
+from tpu_cypher.serve import QueryServer
+from tpu_cypher.storage import (
+    MutableGraph,
+    WriteAheadLog,
+    mutable_graph_from_create_query,
+)
+from tpu_cypher.utils.config import COMPACT_DELTA_MAX
+
+SEED_Q = (
+    "CREATE (a:P {k: 1, name: 'a'}), (b:P {k: 2, name: 'b'}), "
+    "(c:Q {k: 3}), (a)-[:KNOWS {w: 5}]->(b), (b)-[:KNOWS {w: 7}]->(c)"
+)
+
+
+@pytest.fixture(scope="module")
+def session():
+    return CypherSession.tpu()
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.set_spec(None)
+    yield
+    faults.set_spec(None)
+
+
+def _mk(session, wal_path=None):
+    return mutable_graph_from_create_query(
+        session, SEED_Q, name="m", wal_path=wal_path
+    )
+
+
+def _rows(session, pg, query, **params):
+    result = session.cypher(query, params or None, graph=pg)
+    return [dict(r) for r in result.records.collect()]
+
+
+# ---------------------------------------------------------------------------
+# the write surface
+# ---------------------------------------------------------------------------
+
+
+def test_create_nodes_and_rels(session):
+    pg = _mk(session)
+    w = session.cypher("CREATE (:W {k: 10}), (:W {k: 11})", graph=pg)
+    assert w.write_stats["nodes_created"] == 2
+    assert w.write_stats["contains_updates"] is True
+    assert _rows(session, pg, "MATCH (n:W) RETURN n.k AS k ORDER BY k") == [
+        {"k": 10}, {"k": 11},
+    ]
+    w = session.cypher(
+        "MATCH (a:P {k: 1}), (b:Q) CREATE (a)-[:LIKES {w: 9}]->(b)",
+        graph=pg,
+    )
+    assert w.write_stats["relationships_created"] == 1
+    assert _rows(
+        session, pg,
+        "MATCH (a)-[e:LIKES]->(b) RETURN a.k AS ak, e.w AS w, b.k AS bk",
+    ) == [{"ak": 1, "w": 9, "bk": 3}]
+
+
+def test_set_property_label_and_map(session):
+    pg = _mk(session)
+    w = session.cypher(
+        "MATCH (n:P {k: 1}) SET n.k = n.k + 100, n:Promoted", graph=pg
+    )
+    assert w.write_stats["properties_set"] == 1
+    assert w.write_stats["labels_added"] == 1
+    assert _rows(
+        session, pg, "MATCH (n:Promoted) RETURN n.k AS k, n.name AS name"
+    ) == [{"k": 101, "name": "a"}]
+    # whole-map rewrite replaces every property; null drops a key
+    session.cypher(
+        "MATCH (n:Promoted) SET n = {k: 7}, n.gone = null", graph=pg
+    )
+    assert _rows(
+        session, pg, "MATCH (n:Promoted) RETURN n.k AS k, n.name AS name"
+    ) == [{"k": 7, "name": None}]
+
+
+def test_merge_node_and_rel(session):
+    pg = _mk(session)
+    w = session.cypher(
+        "MERGE (n:P {k: 1}) ON MATCH SET n.seen = true "
+        "ON CREATE SET n.fresh = true",
+        graph=pg,
+    )
+    assert w.write_stats["merges_matched"] == 1
+    assert w.write_stats["nodes_created"] == 0
+    w = session.cypher(
+        "MERGE (n:P {k: 99}) ON MATCH SET n.seen = true "
+        "ON CREATE SET n.fresh = true",
+        graph=pg,
+    )
+    assert w.write_stats["nodes_created"] == 1
+    assert _rows(
+        session, pg,
+        "MATCH (n:P) RETURN n.k AS k, n.seen AS s, n.fresh AS f ORDER BY k",
+    ) == [
+        {"k": 1, "s": True, "f": None},
+        {"k": 2, "s": None, "f": None},
+        {"k": 99, "s": None, "f": True},
+    ]
+    # rel merge between bound endpoints: once creates, twice matches
+    q = "MATCH (a:P {k: 1}), (b:P {k: 2}) MERGE (a)-[e:KNOWS {w: 5}]->(b)"
+    assert session.cypher(q, graph=pg).write_stats["merges_matched"] == 1
+    q2 = "MATCH (a:P {k: 1}), (b:P {k: 2}) MERGE (a)-[e:NEW {w: 1}]->(b)"
+    assert (
+        session.cypher(q2, graph=pg).write_stats["relationships_created"] == 1
+    )
+    assert session.cypher(q2, graph=pg).write_stats["merges_matched"] == 1
+
+
+def test_delete_and_detach(session):
+    pg = _mk(session)
+    with pytest.raises(MutationError):
+        session.cypher("MATCH (n:P {k: 2}) DELETE n", graph=pg)
+    w = session.cypher("MATCH (n:P {k: 2}) DETACH DELETE n", graph=pg)
+    assert w.write_stats["nodes_deleted"] == 1
+    assert w.write_stats["relationships_deleted"] == 2  # both incident
+    assert _rows(session, pg, "MATCH (n) RETURN count(*) AS c") == [{"c": 2}]
+    assert _rows(
+        session, pg, "MATCH ()-[e]->() RETURN count(*) AS c"
+    ) == [{"c": 0}]
+
+
+def test_unwind_prefix_and_parameters(session):
+    pg = _mk(session)
+    w = session.cypher(
+        "UNWIND $xs AS x CREATE (:U {v: x * 2})",
+        {"xs": [1, 2, 3]},
+        graph=pg,
+    )
+    assert w.write_stats["nodes_created"] == 3
+    assert _rows(
+        session, pg, "MATCH (n:U) RETURN n.v AS v ORDER BY v"
+    ) == [{"v": 2}, {"v": 4}, {"v": 6}]
+
+
+def test_local_backend_roundtrip():
+    session = CypherSession.local()
+    pg = _mk(session)
+    session.cypher("MATCH (n:P {k: 1}) SET n.k = 50", graph=pg)
+    session.cypher("MERGE (n:W {k: 1})", graph=pg)
+    session.cypher("MATCH (n:Q) DETACH DELETE n", graph=pg)
+    assert _rows(
+        session, pg, "MATCH (n) RETURN n.k AS k ORDER BY k"
+    ) == [{"k": 1}, {"k": 2}, {"k": 50}]
+
+
+def test_write_query_requires_mutable_graph(session):
+    frozen = session.create_graph_from_create_query("CREATE (:P {k: 1})")
+    with pytest.raises(MutationError):
+        session.cypher("CREATE (:W)", graph=frozen)
+
+
+def test_failed_write_commits_nothing(session):
+    pg = _mk(session)
+    before = pg._graph._version
+    with pytest.raises(MutationError):
+        session.cypher("MATCH (n:P) SET n.k = $missing", graph=pg)
+    assert pg._graph._version == before
+    assert _rows(
+        session, pg, "MATCH (n:P) RETURN n.k AS k ORDER BY k"
+    ) == [{"k": 1}, {"k": 2}]
+
+
+# ---------------------------------------------------------------------------
+# snapshot isolation
+# ---------------------------------------------------------------------------
+
+
+def test_pinned_reader_never_moves(session):
+    pg = _mk(session)
+    pinned = session.cypher("MATCH (n) RETURN count(*) AS c", graph=pg)
+    session.cypher("CREATE (:Z), (:Z)", graph=pg)
+    # the reader materializes AFTER the commit, on the snapshot it pinned
+    assert [dict(r) for r in pinned.records.collect()] == [{"c": 3}]
+    fresh = session.cypher("MATCH (n) RETURN count(*) AS c", graph=pg)
+    assert [dict(r) for r in fresh.records.collect()] == [{"c": 5}]
+
+
+def test_snapshot_object_stable_until_write(session):
+    pg = _mk(session)
+    m = pg._graph
+    assert m.snapshot() is m.snapshot()  # cached per version: plan reuse
+    s0 = m.snapshot()
+    session.cypher("CREATE (:Z)", graph=pg)
+    assert m.snapshot() is not s0
+
+
+# ---------------------------------------------------------------------------
+# WAL durability + recovery
+# ---------------------------------------------------------------------------
+
+SCRIPT = (
+    ("CREATE (:W {k: 10, tag: 'w'})", {}),
+    ("MATCH (a:P {k: 1}), (w:W {k: 10}) CREATE (a)-[:OWNS {n: 1}]->(w)", {}),
+    ("MATCH (n:P {k: 1}) SET n.k = 42, n:Promoted", {}),
+    ("MERGE (n:W {k: $k}) ON CREATE SET n.fresh = true", {"k": 11}),
+    ("MATCH (n:Q) DETACH DELETE n", {}),
+    ("UNWIND $xs AS x CREATE (:U {v: x})", {"xs": [1, 2]}),
+)
+
+
+def _run_script(session, pg):
+    for q, params in SCRIPT:
+        session.cypher(q, params or None, graph=pg)
+
+
+def _state(m: MutableGraph):
+    nodes = {
+        i: (tuple(sorted(n.labels)), dict(sorted(n.properties.items())))
+        for i, n in m._nodes.items()
+    }
+    rels = {
+        i: (r.start, r.end, r.rel_type, dict(sorted(r.properties.items())))
+        for i, r in m._rels.items()
+    }
+    return nodes, rels, m.fingerprint(), m._version
+
+
+def test_wal_replay_byte_identical_vs_rebuild(session, tmp_path):
+    wal_path = str(tmp_path / "m.wal")
+    pg = _mk(session, wal_path=wal_path)
+    _run_script(session, pg)
+    want = _state(pg._graph)
+
+    # recovery: a fresh process rebuilds the base from the CREATE query
+    # then replays the WAL — state must be byte-identical
+    recovered = _mk(session, wal_path=wal_path)
+    assert recovered._graph.replayed_batches == len(SCRIPT)
+    assert _state(recovered._graph) == want
+
+    # differential: a from-scratch rebuild that re-EXECUTES the script
+    # (no WAL) agrees too — replay and re-execution converge
+    scratch = _mk(session)
+    _run_script(session, scratch)
+    assert _state(scratch._graph)[:3] == want[:3]
+
+
+def test_wal_torn_tail_dropped(session, tmp_path):
+    wal_path = str(tmp_path / "torn.wal")
+    pg = _mk(session, wal_path=wal_path)
+    session.cypher("CREATE (:W {k: 1})", graph=pg)
+    session.cypher("CREATE (:W {k: 2})", graph=pg)
+    committed = _state(pg._graph)
+    # a SIGKILL mid-append leaves a partial line: committed writes stay,
+    # the torn record is not replayed, boot does not fail
+    with open(wal_path, "ab") as f:
+        f.write(b'deadbeef {"lsn": 3, "batch"')
+    recovered = _mk(session, wal_path=wal_path)
+    assert recovered._graph.replayed_batches == 2
+    assert _state(recovered._graph) == committed
+
+
+def test_wal_sync_modes_roundtrip(tmp_path):
+    # TPU_CYPHER_WAL_SYNC trades durability for append latency; every
+    # mode must still frame records that replay identically
+    rec = {"lsn": 1, "batch": {"nc": [[7, ["W"], {"k": 1}]]}}
+    for sync in ("fsync", "flush", "off"):
+        wal = WriteAheadLog(str(tmp_path / f"{sync}.wal"), sync=sync)
+        off = wal.append(rec)
+        assert off == 0
+        wal.close()
+        replayed = list(WriteAheadLog(str(tmp_path / f"{sync}.wal")).replay())
+        assert replayed == [rec]
+
+
+def test_fault_wal_append_nothing_durable(session, tmp_path):
+    wal_path = str(tmp_path / "apf.wal")
+    pg = _mk(session, wal_path=wal_path)
+    session.cypher("CREATE (:W {k: 1})", graph=pg)
+    size = os.path.getsize(wal_path)
+    faults.set_spec("lost@wal_append:1")
+    with pytest.raises(ERR.DeviceLost):  # typed, never a raw InjectedFault
+        session.cypher("CREATE (:W {k: 2})", graph=pg)
+    faults.set_spec(None)
+    assert os.path.getsize(wal_path) == size  # nothing reached the log
+    assert _rows(
+        session, pg, "MATCH (n:W) RETURN count(*) AS c"
+    ) == [{"c": 1}]
+
+
+def test_fault_delta_apply_rolls_wal_back(session, tmp_path):
+    wal_path = str(tmp_path / "dap.wal")
+    pg = _mk(session, wal_path=wal_path)
+    session.cypher("CREATE (:W {k: 1})", graph=pg)
+    size = os.path.getsize(wal_path)
+    version = pg._graph._version
+    faults.set_spec("lost@delta_apply:1")
+    with pytest.raises(ERR.DeviceLost):
+        session.cypher("CREATE (:W {k: 2})", graph=pg)
+    faults.set_spec(None)
+    # the append happened, then apply failed: the log was truncated back
+    # so the failed write can never replay as committed
+    assert os.path.getsize(wal_path) == size
+    assert pg._graph._version == version
+    recovered = _mk(session, wal_path=wal_path)
+    assert recovered._graph.replayed_batches == 1
+
+
+def test_fault_compact_defers_not_fails(session):
+    COMPACT_DELTA_MAX.set(1)
+    try:
+        pg = _mk(session)
+        faults.set_spec("oom@compact:1")
+        w = session.cypher("CREATE (:W {k: 1})", graph=pg)  # must NOT raise
+        faults.set_spec(None)
+        assert w.write_stats["nodes_created"] == 1
+        m = pg._graph
+        assert m.deferred_compactions == 1
+        before = m.compactions
+        session.cypher("CREATE (:W {k: 2})", graph=pg)
+        assert m.compactions > before  # the deferral retried and succeeded
+        assert m.delta_rows() == 0
+    finally:
+        COMPACT_DELTA_MAX.reset()
+        faults.set_spec(None)
+
+
+# ---------------------------------------------------------------------------
+# fingerprints + compaction
+# ---------------------------------------------------------------------------
+
+
+def test_fingerprint_advances_on_cardinality_neutral_set(session):
+    pg = _mk(session)
+    m = pg._graph
+    fp0 = m.fingerprint()
+    session.cypher("MATCH (n:P {k: 1}) SET n.name = 'renamed'", graph=pg)
+    # counts did not change; the CHAINED fingerprint still must — a result
+    # cache keyed on it would otherwise serve the old property value
+    assert m.fingerprint() != fp0
+
+
+def test_compaction_returns_to_base_only_scan(session):
+    COMPACT_DELTA_MAX.set(4)
+    try:
+        pg = _mk(session)
+        m = pg._graph
+        from tpu_cypher.storage.delta import SnapshotGraph
+
+        session.cypher("CREATE (:W {k: 1})", graph=pg)
+        assert isinstance(m.snapshot(), SnapshotGraph)  # delta overlay live
+        for i in range(2, 9):  # 8 writes total: compaction at 4 and at 8
+            session.cypher(f"CREATE (:W {{k: {i}}})", graph=pg)
+        assert m.compactions == 2
+        assert m.delta_rows() == 0
+        assert not isinstance(m.snapshot(), SnapshotGraph)  # base-only again
+        assert _rows(
+            session, pg, "MATCH (n:W) RETURN count(*) AS c"
+        ) == [{"c": 8}]
+    finally:
+        COMPACT_DELTA_MAX.reset()
+
+
+def test_zero_warm_recompiles_across_compaction(session):
+    """The acceptance pin: a warm query's program shapes survive delta
+    growth AND compaction, because delta extents and the compacted base
+    round on the same bucket lattice. After warming the base-only and
+    union programs once, committing more writes and compacting must
+    compile NOTHING new."""
+    COMPACT_DELTA_MAX.set(6)
+    try:
+        with bucketing.force_mode("pow2"):
+            pg = _mk(session)
+            m = pg._graph
+            q = "MATCH (n:P) RETURN count(*) AS c"
+            _rows(session, pg, q)  # warm the base-only program
+            session.cypher("CREATE (:W {k: 0})", graph=pg)
+            _rows(session, pg, q)  # warm the union (delta-overlay) program
+            before_compactions = m.compactions
+            snap = bucketing.compile_snapshot()
+            for i in range(1, 10):
+                session.cypher(f"CREATE (:W {{k: {i}}})", graph=pg)
+                _rows(session, pg, q)
+            assert m.compactions > before_compactions  # compaction happened
+            delta = bucketing.compile_delta(snap)
+            assert delta["compiles"] == 0, delta
+    finally:
+        COMPACT_DELTA_MAX.reset()
+
+
+# ---------------------------------------------------------------------------
+# serving tier: write payloads + result-cache invalidation
+# ---------------------------------------------------------------------------
+
+
+async def _client(host, port, lines):
+    reader, writer = await asyncio.open_connection(host, port)
+    for line in lines:
+        writer.write((json.dumps(line) + "\n").encode())
+    await writer.drain()
+    want = sum(1 for l in lines if l.get("op") == "submit")
+    out, done = [], 0
+    while done < want:
+        raw = await asyncio.wait_for(reader.readline(), 30)
+        if not raw:
+            break
+        msg = json.loads(raw)
+        out.append(msg)
+        if msg.get("type") in ("done", "error", "cancelled"):
+            done += 1
+    writer.close()
+    return out
+
+
+def _done(msgs, qid):
+    return next(m for m in msgs if m["type"] == "done" and m["id"] == qid)
+
+
+def _rows_of(msgs, qid):
+    rows = []
+    for m in msgs:
+        if m["type"] == "rows" and m["id"] == qid:
+            rows.extend(m["rows"])
+    return rows
+
+
+def test_serve_write_invalidates_result_cache(session):
+    """A cached read stops matching after a write — the chained
+    fingerprint refresh, not a TTL, is what invalidates it."""
+    pg = _mk(session)
+    read_q = "MATCH (n:P) RETURN count(*) AS c"
+
+    async def run():
+        srv = QueryServer(session, port=0)
+        srv.register_graph("g", pg)
+        async with srv:
+            first = await _client(srv.host, srv.port, [
+                {"op": "submit", "id": "r1", "graph": "g", "query": read_q},
+            ])
+            warm = await _client(srv.host, srv.port, [
+                {"op": "submit", "id": "r2", "graph": "g", "query": read_q},
+            ])
+            write = await _client(srv.host, srv.port, [
+                {"op": "submit", "id": "w1", "graph": "g",
+                 "query": "CREATE (:P {k: 9})"},
+            ])
+            after = await _client(srv.host, srv.port, [
+                {"op": "submit", "id": "r3", "graph": "g", "query": read_q},
+            ])
+        return first, warm, write, after
+
+    first, warm, write, after = asyncio.run(run())
+    assert _done(first, "r1")["cached"] is False
+    assert _done(warm, "r2")["cached"] is True  # warm hit pre-write
+    assert _rows_of(warm, "r2") == [{"c": 2}]
+    assert _done(after, "r3")["cached"] is False  # fingerprint moved
+    assert _rows_of(after, "r3") == [{"c": 3}]
+
+
+def test_serve_write_payload_not_batched_not_cached(session):
+    pg = _mk(session)
+
+    async def run():
+        srv = QueryServer(session, port=0, batch_window_ms=40)
+        srv.register_graph("g", pg)
+        async with srv:
+            msgs = await _client(srv.host, srv.port, [
+                {"op": "submit", "id": f"w{i}", "graph": "g",
+                 "query": "MERGE (n:W {k: 1}) ON MATCH SET n.c = 1"}
+                for i in range(3)
+            ])
+        return msgs
+
+    msgs = asyncio.run(run())
+    dones = [_done(msgs, f"w{i}") for i in range(3)]
+    # three identical writes in one window: every one executed (batched=1)
+    assert all(d["batched"] == 1 for d in dones)
+    assert all(d["cached"] is False for d in dones)
+    m = pg._graph
+    assert m.committed_batches >= 1  # first created, later ones matched

@@ -17,7 +17,7 @@ Layers of coverage:
   >1M-row result streams under a fixed host-memory ceiling.
 * **HTTP goldens** — ``GET /metrics`` byte-identical to the in-process
   ``session.metrics_text()``; ``GET /queries/<id>`` serving the span
-  tree JSON; ``GET /cache`` + ``GET /cache/flush``.
+  tree JSON; ``GET /cache`` + POST-only ``/cache/flush`` (405 on GET).
 """
 
 import asyncio
@@ -85,9 +85,9 @@ async def _client(host, port, lines, want=None):
     return out
 
 
-async def _http(host, port, path):
+async def _http(host, port, path, method="GET"):
     reader, writer = await asyncio.open_connection(host, port)
-    writer.write(f"GET {path} HTTP/1.1\r\nHost: t\r\n\r\n".encode())
+    writer.write(f"{method} {path} HTTP/1.1\r\nHost: t\r\n\r\n".encode())
     await writer.drain()
     data = await reader.read()
     writer.close()
@@ -667,7 +667,9 @@ def test_http_cache_stats_and_flush(session, graph):
                 {"op": "submit", "id": "h2", "graph": "g", "query": COUNT_Q},
             ])
             _, stats = await _http(srv.host, srv.port, "/cache")
-            _, flushed = await _http(srv.host, srv.port, "/cache/flush")
+            _, flushed = await _http(
+                srv.host, srv.port, "/cache/flush", method="POST"
+            )
             _, stats2 = await _http(srv.host, srv.port, "/cache")
         return json.loads(stats), json.loads(flushed), json.loads(stats2)
 
@@ -676,6 +678,32 @@ def test_http_cache_stats_and_flush(session, graph):
     assert stats["max_bytes"] > 0
     assert flushed == {"flushed": 1}
     assert stats2["entries"] == 0 and stats2["bytes"] == 0
+
+
+def test_cache_flush_requires_post(session, graph):
+    """GET /cache/flush is 405 and must NOT drop the cache — a crawler or
+    monitoring probe sweeping GET routes can't flush state. POST to any
+    other route is 405 too."""
+
+    async def run():
+        async with _serve(session, graph) as srv:
+            await _client(srv.host, srv.port, [
+                {"op": "submit", "id": "h1", "graph": "g", "query": COUNT_Q},
+            ])
+            get_status, get_body = await _http(
+                srv.host, srv.port, "/cache/flush"
+            )
+            _, stats = await _http(srv.host, srv.port, "/cache")
+            post_other, _ = await _http(
+                srv.host, srv.port, "/metrics", method="POST"
+            )
+        return get_status, json.loads(get_body), json.loads(stats), post_other
+
+    get_status, get_body, stats, post_other = asyncio.run(run())
+    assert get_status.startswith("HTTP/1.1 405")
+    assert "POST" in get_body["error"]
+    assert stats["entries"] == 1  # the GET dropped nothing
+    assert post_other.startswith("HTTP/1.1 405")
 
 
 # ---------------------------------------------------------------------------

@@ -315,7 +315,15 @@ class TpuEvaluator:
         cols = {c: self.table._cols[c].to_values() for c in deps}
         lt = LocalTable(cols, self.n)
         vals = LocalEvaluator(lt, self.header, self.params).evaluate(expr)
-        return Column.from_values(vals)
+        col = Column.from_values(vals)
+        if col.data is not None and int(col.data.shape[0]) > self.n:
+            # pad-invariant: ``from_values`` bucket-pads its ingest, but an
+            # island column re-enters a table whose physical row count is
+            # authoritative — a longer column would desync from row-aligned
+            # device state built at table size (e.g. the group segment
+            # index). Pads are always tail rows, so a slice restores it.
+            col = col.slice(0, self.n)
+        return col
 
     def _dependency_columns(self, expr: E.Expr) -> List[str]:
         """Physical columns a host island must decode: header-mapped

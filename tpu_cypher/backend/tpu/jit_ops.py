@@ -239,6 +239,46 @@ def cols_concat(a_cols, b_cols):
     return out
 
 
+@jax.jit
+def cols_union_counted(a_cols, b_cols, idx, count):
+    """``cols_concat`` for BUCKET-PADDED inputs: concatenate the PHYSICAL
+    (lattice-shaped) arrays, then gather both sides' logical rows to the
+    front through ``idx`` — host-built positions travel as a device
+    operand, so logical row counts never key compilation. Lanes at or
+    past the traced true ``count`` are dead duplicates; the output is a
+    tail-padded column set with ``count`` logical rows, same contract as
+    ``cols_take_counted``."""
+    live = jnp.arange(idx.shape[0], dtype=jnp.int64) < count
+    out = {}
+    for c, (ad, av, ai) in a_cols.items():
+        bd, bv, bi = b_cols[c]
+        data = jnp.take(jnp.concatenate([ad, bd]), idx, axis=0)
+        if av is None and bv is None:
+            valid = live
+        else:
+            valid = jnp.take(
+                jnp.concatenate([
+                    av if av is not None else jnp.ones(ad.shape[0], bool),
+                    bv if bv is not None else jnp.ones(bd.shape[0], bool),
+                ]),
+                idx,
+                axis=0,
+            ) & live
+        if ai is None and bi is None:
+            iflag = None
+        else:
+            iflag = jnp.take(
+                jnp.concatenate([
+                    ai if ai is not None else jnp.zeros(ad.shape[0], bool),
+                    bi if bi is not None else jnp.zeros(bd.shape[0], bool),
+                ]),
+                idx,
+                axis=0,
+            ) & live
+        out[c] = (data, valid, iflag)
+    return out
+
+
 # ---------------------------------------------------------------------------
 # fused CSR expand phases
 # ---------------------------------------------------------------------------
@@ -1209,6 +1249,30 @@ def first_occurrence_rows(order, flags, k: int):
     """Distinct row indices (original order) from a sorted factorization."""
     idx = jnp.nonzero(flags, size=k)[0]
     return jnp.sort(jnp.take(order, idx))
+
+
+@jax.jit
+def live_first_flags(order, flags, n):
+    """First-of-group flags restricted to LIVE rows (original index below
+    the traced logical ``n``) plus their count — the distinct discipline
+    over pad-carrying tables, where pad rows were keyed into trailing
+    groups and must not survive as phantom distinct rows."""
+    f = flags & (order < n)
+    return f, jnp.sum(f)
+
+
+@partial(jax.jit, static_argnames=("k",))
+def first_occurrence_rows_counted(order, flags, count, k: int):
+    """``first_occurrence_rows`` at a BUCKETED static ``k`` >= the traced
+    true ``count``: pad lanes take a beyond-end sentinel before the sort
+    so the real firsts land in the leading ``count`` lanes (tail-pad
+    invariant), then clip back in-bounds as dead duplicates for the
+    counted gather (``cols_take_counted`` masks them)."""
+    n = order.shape[0]
+    pos = jnp.nonzero(flags, size=k)[0]
+    rows = jnp.take(order, pos)
+    rows = jnp.where(jnp.arange(k, dtype=jnp.int64) < count, rows, n)
+    return jnp.clip(jnp.sort(rows), 0, n - 1)
 
 
 @partial(jax.jit, static_argnames=("k",))

@@ -407,6 +407,7 @@ class TpuTable(Table):
         for c, col in self._cols.items():
             if col.kind == OBJ:
                 if idx_host is None:
+                    fault_point("compact")
                     idx_host = np.asarray(idx)[:count]
                 out[c] = col.take(idx_host)
             else:
@@ -855,11 +856,15 @@ class TpuTable(Table):
 
     def union_all(self, other: "TpuTable") -> "TpuTable":
         other = ensure_flat(other)
+        if set(self._cols) != set(other._cols):
+            raise TpuBackendError("unionAll column mismatch")
+        if bucketing.enabled():
+            padded = self._union_all_padded(other)
+            if padded is not None:
+                return padded
         t, o = self._depad(), other._depad()
         if t is not self or o is not other:
             return t.union_all(o)
-        if set(self._cols) != set(other._cols):
-            raise TpuBackendError("unionAll column mismatch")
         # structurally simple columns (same kind/dtype, shared vocab) concat
         # in ONE jitted dispatch; kind promotion / vocab unification /
         # object columns keep the per-column host path
@@ -887,6 +892,90 @@ class TpuTable(Table):
                 out[c] = self._cols[c].concat(other._cols[c])
         ordered = {c: out[c] for c in self._cols}
         return TpuTable(ordered, self._nrows + other._nrows)
+
+    def _union_all_padded(self, other: "TpuTable") -> Optional["TpuTable"]:
+        """UNION ALL that never leaves the bucket lattice: concatenate the
+        PHYSICAL (bucket/shard-padded) arrays and gather both sides'
+        logical rows to the front at a bucket-rounded size
+        (``jit_ops.cols_union_counted``). The compile key is the
+        (physical, physical, rounded-output) shape triple — all lattice
+        values — so snapshot scans over a growing base/delta pair reuse
+        one compiled union across commits AND compactions, where the
+        depadded path would recompile on every logical row-count drift.
+        Returns None (caller takes the exact depadded path) unless every
+        column on both sides is device-resident and structurally
+        aligned."""
+        a_n, b_n = self._nrows, other._nrows
+        a_phys, b_phys = self._phys, other._phys
+        out_n = a_n + b_n
+        if out_n == 0:
+            return None
+        a_cols = dict(self._cols)
+        b_cols = dict(other._cols)
+        for c, a in a_cols.items():
+            b = b_cols[c]
+            if a.kind != b.kind and a.kind != OBJ and b.kind != OBJ:
+                # same discipline as ``Column.concat``: an all-null side
+                # carries no payload (scan alignment fills absent
+                # properties with I64 null constants) — adopt the other
+                # side's kind instead of losing the one-dispatch path
+                if len(b) == 0 or b.is_all_null():
+                    b = b_cols[c] = a.null_like(len(b))
+                elif len(a) == 0 or a.is_all_null():
+                    a = a_cols[c] = b.null_like(len(a))
+            if (
+                a.kind == OBJ
+                or a.kind != b.kind
+                or a.vocab is not b.vocab
+                or a.data is None
+                or b.data is None
+                or a.data.dtype != b.data.dtype
+                or len(a) != a_phys
+                or len(b) != b_phys
+            ):
+                return None
+        # output physical size = SUM of the input physical sizes, not
+        # ``round_size(out_n)``: both inputs are already lattice-shaped, so
+        # the sum is stable while the logical sum ``out_n`` drifts — the
+        # union's compile key then changes only when an INPUT crosses its
+        # own bucket, never on a within-bucket row-count change
+        out_phys = a_phys + b_phys
+        idx = np.zeros(out_phys, np.int64)
+        idx[:a_n] = np.arange(a_n, dtype=np.int64)
+        idx[a_n:out_n] = a_phys + np.arange(b_n, dtype=np.int64)
+
+        # null-free columns carry ``valid=None`` — but ONLY while the table
+        # has pad rows to mark; a table that exactly fills its bucket keeps
+        # None. That structural flip would re-key the jit across
+        # compactions, so synthesize a concrete mask on the way in and
+        # always keep one on the way out: the program shape is then a pure
+        # function of the lattice sizes
+        def _dev(cols: Dict[str, Column], phys: int):
+            return {
+                c: (
+                    col.data,
+                    col.valid
+                    if col.valid is not None
+                    else jnp.ones(phys, bool),
+                    col.int_flag,
+                )
+                for c, col in cols.items()
+            }
+
+        merged = J.cols_union_counted(
+            _dev(a_cols, a_phys), _dev(b_cols, b_phys), idx, out_n
+        )
+        pad = out_phys - out_n
+        out: Dict[str, Column] = {}
+        for c, (d, v, i) in merged.items():
+            a, b = a_cols[c], b_cols[c]
+            synth = pad > 0 and (a.valid is None or a.pad_synth) and (
+                b.valid is None or b.pad_synth
+            )
+            out[c] = Column(
+                a.kind, d, v, a.vocab, int_flag=i, pad=pad, pad_synth=synth,
+            )
+        return TpuTable({c: out[c] for c in self._cols}, out_n)
 
     # -- ordering ----------------------------------------------------------
 
@@ -1047,6 +1136,10 @@ class TpuTable(Table):
         return sharded_distinct_count(keys)
 
     def distinct(self, cols: Optional[Sequence[str]] = None) -> "TpuTable":
+        if bucketing.enabled():
+            out = self._distinct_bucketed(cols)
+            if out is not None:
+                return out
         t = self._depad()
         if t is not self:
             return t.distinct(cols)
@@ -1060,6 +1153,43 @@ class TpuTable(Table):
         order, flags, cnt = self._first_occurrence_index(on)
         first = J.first_occurrence_rows(order, flags, k=int(cnt))
         return self._take(first)
+
+    def _distinct_bucketed(
+        self, cols: Optional[Sequence[str]]
+    ) -> Optional["TpuTable"]:
+        """Pad-aware DISTINCT: the first-occurrence factorization runs over
+        the PHYSICAL (bucket/shard-padded) arrays with a prepended
+        pad-group key — pad rows sort into trailing groups of their own,
+        first flags are then restricted to live rows
+        (``jit_ops.live_first_flags``), and the survivor gather lands on a
+        BUCKETED static size. Two tables whose distinct counts share a
+        bucket reuse one compiled pipeline, so snapshot dedup never
+        recompiles across compactions. Returns None (caller takes the
+        exact depadded path) when a key is host-resident or a pad-carrying
+        table holds OBJ columns the counted gather cannot align."""
+        n, phys = self._nrows, self._phys
+        on = list(cols) if cols is not None else self.physical_columns
+        if not on or n == 0:
+            return None
+        if any(self._cols[c].kind == OBJ for c in on):
+            return None
+        if phys > n and any(c.kind == OBJ for c in self._cols.values()):
+            return None
+        if any(
+            c.kind != OBJ and len(c) != phys for c in self._cols.values()
+        ):
+            return None
+        # the pad-group key rides along even when the table exactly fills
+        # its bucket (all-False then): dropping it would re-key the sort
+        # whenever a compaction lands a table on a bucket boundary
+        extras = (np.arange(phys) >= n,)
+        order, flags, _ = self._first_occurrence_index(on, extra_keys=extras)
+        flags, cnt = J.live_first_flags(order, flags, n)
+        cnt = int(cnt)
+        first = J.first_occurrence_rows_counted(
+            order, flags, cnt, k=bucketing.round_size(cnt)
+        )
+        return self._take_counted(first, cnt)
 
     # -- aggregation / projection / explode --------------------------------
 

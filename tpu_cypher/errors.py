@@ -110,6 +110,13 @@ class AdmissionRejected(ExecutionFault):
         self.budget_bytes = budget_bytes
 
 
+class MutationError(TpuCypherError):
+    """A Cypher write failed validation or evaluation (deleting a node
+    that still has relationships without DETACH, SET on an unbound or
+    non-element variable, an unsupported write shape). A client error:
+    the write is rolled back and never reaches the WAL."""
+
+
 # ---------------------------------------------------------------------------
 # classification of raw exceptions
 # ---------------------------------------------------------------------------

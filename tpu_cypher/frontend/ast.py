@@ -184,10 +184,35 @@ class SetItem(TreeNode):
 
 @dataclass(frozen=True)
 class CreateClause(Clause):
-    """CREATE pattern — used by the in-memory test-graph factory
+    """CREATE pattern — a graph write against a mutable ambient graph
+    (docs/mutation.md); also reused by the in-memory test-graph factory
     (reference ``CreateQueryParser.scala:97``) and CONSTRUCT NEW."""
 
     pattern: Pattern
+
+
+@dataclass(frozen=True)
+class MergeClause(Clause):
+    """MERGE pattern [ON CREATE SET ...] [ON MATCH SET ...]"""
+
+    pattern: Pattern  # single pattern part
+    on_create: Tuple["SetItem", ...] = ()
+    on_match: Tuple["SetItem", ...] = ()
+
+
+@dataclass(frozen=True)
+class SetClause(Clause):
+    """SET item [, item]* as a standalone write clause."""
+
+    items: Tuple["SetItem", ...]
+
+
+@dataclass(frozen=True)
+class DeleteClause(Clause):
+    """[DETACH] DELETE expr [, expr]* — exprs must be bound element vars."""
+
+    exprs: Tuple[Expr, ...]
+    detach: bool = False
 
 
 @dataclass(frozen=True)

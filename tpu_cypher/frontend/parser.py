@@ -247,6 +247,36 @@ class Parser:
         if self.at_kw("CREATE"):
             self.next()
             return A.CreateClause(self.parse_pattern())
+        if self.at_kw("MERGE"):
+            self.next()
+            pattern = self.parse_pattern(single_part=True)
+            on_create: List[A.SetItem] = []
+            on_match: List[A.SetItem] = []
+            while self.try_kw("ON"):
+                if self.try_kw("CREATE"):
+                    items = on_create
+                elif self.try_kw("MATCH"):
+                    items = on_match
+                else:
+                    self.fail("Expected CREATE or MATCH after ON")
+                self.eat_kw("SET")
+                items.append(self.parse_set_item())
+                while self.try_sym(","):
+                    items.append(self.parse_set_item())
+            return A.MergeClause(pattern, tuple(on_create), tuple(on_match))
+        if self.at_kw("SET"):
+            self.next()
+            items = [self.parse_set_item()]
+            while self.try_sym(","):
+                items.append(self.parse_set_item())
+            return A.SetClause(tuple(items))
+        if self.at_kw("DELETE") or self.at_kw("DETACH"):
+            detach = self.try_kw("DETACH")
+            self.eat_kw("DELETE")
+            exprs = [self.parse_expression()]
+            while self.try_sym(","):
+                exprs.append(self.parse_expression())
+            return A.DeleteClause(tuple(exprs), detach)
         if self.at_kw("CALL"):
             self.next()
             return self.parse_call()

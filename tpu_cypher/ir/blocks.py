@@ -142,3 +142,76 @@ class CreateViewIR:
 class DropGraphIR:
     qgn: str
     view: bool = False
+
+
+# ---------------------------------------------------------------------------
+# write IR (docs/mutation.md): CREATE / MERGE / SET / DELETE against the
+# ambient mutable graph. The read prefix is a normal QueryIR (planned on the
+# write query's pinned snapshot); the write ops evaluate host-side per
+# binding row and commit as one WriteBatch.
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class NodeTemplate:
+    """One node element of a CREATE/MERGE pattern."""
+
+    var: str  # binding name (fresh for anonymous nodes)
+    bound: bool  # True: var is already bound — reuse, don't create
+    labels: Tuple[str, ...] = ()
+    props: Tuple[Tuple[str, Expr], ...] = ()
+
+
+@dataclass
+class RelTemplate:
+    """One relationship element; endpoints name node templates/bindings."""
+
+    var: str
+    rel_type: str
+    src: str
+    dst: str
+    props: Tuple[Tuple[str, Expr], ...] = ()
+
+
+@dataclass
+class SetItemSpec:
+    """One SET item: property assign, label add, or whole-map rewrite."""
+
+    var: str
+    key: Optional[str] = None  # property key; None for labels / map value
+    value: Optional[Expr] = None
+    labels: Tuple[str, ...] = ()
+
+
+@dataclass
+class CreateOp(Block):
+    nodes: Tuple[NodeTemplate, ...]
+    rels: Tuple[RelTemplate, ...]
+
+
+@dataclass
+class MergeOp(Block):
+    nodes: Tuple[NodeTemplate, ...]
+    rels: Tuple[RelTemplate, ...]
+    on_create: Tuple[SetItemSpec, ...] = ()
+    on_match: Tuple[SetItemSpec, ...] = ()
+
+
+@dataclass
+class SetOp(Block):
+    items: Tuple[SetItemSpec, ...]
+
+
+@dataclass
+class DeleteOp(Block):
+    fields: Tuple[str, ...]
+    detach: bool = False
+
+
+@dataclass
+class UpdateIR:
+    """A write query: optional read prefix + ordered write ops."""
+
+    read: Optional[QueryIR]
+    ops: Tuple[Block, ...]
+    source_graph: str = "session.ambient"

@@ -39,6 +39,10 @@ class IRBuildError(Exception):
     pass
 
 
+# clauses that make a single query a WRITE query (docs/mutation.md)
+_WRITE_CLAUSES = (A.CreateClause, A.MergeClause, A.SetClause, A.DeleteClause)
+
+
 class UnsupportedFeatureError(IRBuildError):
     """A feature the grammar accepts but the engine does not execute
     (procedure calls). The reference's analog: its frontend parses CALL and
@@ -67,6 +71,8 @@ class IRBuilder:
 
     def build(self, stmt: A.Statement):
         if isinstance(stmt, A.SingleQuery):
+            if any(isinstance(c, _WRITE_CLAUSES) for c in stmt.clauses):
+                return self._build_update(stmt)
             return self._build_single(stmt)
         if isinstance(stmt, A.UnionQuery):
             irs = [self._build_single(q) for q in stmt.queries]
@@ -79,6 +85,11 @@ class IRBuilder:
             return B.UnionIR(tuple(irs), all=stmt.all, returns=cols)
         if isinstance(stmt, A.CreateGraphStatement):
             inner = IRBuilder(self.ctx).build(stmt.inner)
+            if isinstance(inner, B.UpdateIR):
+                raise IRBuildError(
+                    "CREATE GRAPH inner queries cannot contain write "
+                    "clauses (use FROM/CONSTRUCT/RETURN GRAPH)"
+                )
             return B.CreateGraphIR(stmt.qgn, inner)
         if isinstance(stmt, A.CreateViewStatement):
             return B.CreateViewIR(stmt.name, stmt.params, stmt.inner_text)
@@ -132,10 +143,12 @@ class IRBuilder:
             elif isinstance(c, A.ReturnGraph):
                 blocks.append(B.GraphResultBlock())
                 saw_return = True
-            elif isinstance(c, A.CreateClause):
+            elif isinstance(c, _WRITE_CLAUSES):
+                # single-query writes route through _build_update; reaching
+                # here means a UNION branch or view body carries a write
                 raise IRBuildError(
-                    "CREATE is only supported in test-graph construction "
-                    "(use testing.create_graph) or CONSTRUCT NEW"
+                    f"{type(c).__name__}: write clauses are only supported "
+                    "in top-level single queries"
                 )
             elif isinstance(c, A.CallClause):
                 raise UnsupportedFeatureError(
@@ -147,6 +160,203 @@ class IRBuilder:
         if not saw_return:
             raise IRBuildError("Query must end in RETURN")
         return B.QueryIR(tuple(blocks), returns, self.ctx.working_graph)
+
+    # ------------------------------------------------------------------
+    # write queries (docs/mutation.md)
+    # ------------------------------------------------------------------
+
+    def _build_update(self, q: A.SingleQuery) -> B.UpdateIR:
+        """Split a write query at its first write clause: the read prefix
+        becomes a normal QueryIR returning every in-scope field (planned
+        and executed on the pinned snapshot), the write suffix becomes
+        host-evaluated write ops (relational/mutate.py)."""
+        clauses = list(q.clauses)
+        first = next(
+            i for i, c in enumerate(clauses) if isinstance(c, _WRITE_CLAUSES)
+        )
+        reads, writes = clauses[:first], clauses[first:]
+        env: Dict[str, CypherType] = dict(self.ctx.input_fields)
+        blocks: List[B.Block] = []
+        for c in reads:
+            if isinstance(c, A.Match):
+                blocks.extend(self._convert_match(c, env))
+            elif isinstance(c, A.Unwind):
+                lst = self.convert_expr(c.expr, env)
+                blocks.append(B.UnwindBlock(lst, c.var))
+                env[c.var] = self._list_inner_type(lst.cypher_type)
+            elif isinstance(c, A.With) and not isinstance(c, A.Return):
+                new_env, seg = self._convert_projection(c, env)
+                blocks.extend(seg)
+                env = new_env
+            else:
+                raise IRBuildError(
+                    f"{type(c).__name__} cannot precede a write clause"
+                )
+        read_ir: Optional[B.QueryIR] = None
+        if blocks:
+            fields = tuple(n for n in env if not n.startswith("__"))
+            blocks.append(B.ResultBlock(fields))
+            read_ir = B.QueryIR(tuple(blocks), fields, self.ctx.working_graph)
+        ops: List[B.Block] = []
+        for c in writes:
+            if isinstance(c, A.CreateClause):
+                nodes, rels = self._convert_write_pattern(c.pattern, env)
+                ops.append(B.CreateOp(nodes, rels))
+            elif isinstance(c, A.MergeClause):
+                ops.append(self._convert_merge(c, env))
+            elif isinstance(c, A.SetClause):
+                ops.append(
+                    B.SetOp(
+                        tuple(self._convert_set_item(it, env) for it in c.items)
+                    )
+                )
+            elif isinstance(c, A.DeleteClause):
+                ops.append(self._convert_delete(c, env))
+            else:
+                raise IRBuildError(
+                    f"{type(c).__name__} cannot follow a write clause — "
+                    "write queries end at their writes (RETURN after a "
+                    "write is not supported; they return write counters)"
+                )
+        return B.UpdateIR(read_ir, tuple(ops), self.ctx.working_graph)
+
+    def _convert_write_pattern(
+        self, pattern: A.Pattern, env: Dict[str, CypherType]
+    ) -> Tuple[Tuple[B.NodeTemplate, ...], Tuple[B.RelTemplate, ...]]:
+        nodes: List[B.NodeTemplate] = []
+        rels: List[B.RelTemplate] = []
+        for part in pattern.parts:
+            if part.path_var:
+                raise IRBuildError("path variables are not allowed in writes")
+            elems = part.elements
+            prev = self._convert_write_node(elems[0], env, nodes)
+            for j in range(1, len(elems), 2):
+                rp: A.RelPattern = elems[j]
+                nxt = self._convert_write_node(elems[j + 1], env, nodes)
+                if len(rp.types) != 1:
+                    raise IRBuildError(
+                        "created relationships need exactly one type"
+                    )
+                if rp.direction == A.BOTH:
+                    raise IRBuildError(
+                        "created relationships need a direction"
+                    )
+                if rp.var and rp.var in env:
+                    raise IRBuildError(
+                        f"relationship variable {rp.var!r} already bound"
+                    )
+                var = rp.var or self.fresh_name("wr")
+                props = self._convert_write_props(rp.properties, env)
+                src, dst = (
+                    (nxt, prev) if rp.direction == A.INCOMING else (prev, nxt)
+                )
+                rels.append(
+                    B.RelTemplate(var, rp.types[0], src, dst, props)
+                )
+                env[var] = T.CTRelationshipType((rp.types[0],))
+                prev = nxt
+        return tuple(nodes), tuple(rels)
+
+    def _convert_write_node(
+        self, np: A.NodePattern, env: Dict[str, CypherType], out: List
+    ) -> str:
+        if np.var and np.var in env:
+            m = env[np.var].material
+            if not isinstance(m, T.CTNodeType):
+                raise IRBuildError(f"{np.var!r} is not a node")
+            if np.labels or np.properties is not None:
+                raise IRBuildError(
+                    f"bound variable {np.var!r} cannot carry labels or "
+                    "properties in a write pattern"
+                )
+            out.append(B.NodeTemplate(np.var, bound=True))
+            return np.var
+        var = np.var or self.fresh_name("wn")
+        props = self._convert_write_props(np.properties, env)
+        out.append(
+            B.NodeTemplate(var, bound=False, labels=tuple(np.labels), props=props)
+        )
+        env[var] = T.CTNodeType(tuple(np.labels))
+        return var
+
+    def _convert_write_props(
+        self, properties, env: Dict[str, CypherType]
+    ) -> Tuple[Tuple[str, E.Expr], ...]:
+        if properties is None:
+            return ()
+        out = []
+        for k, v in zip(properties.keys, properties.values):
+            if k.startswith("__"):
+                raise IRBuildError(
+                    f"property key {k!r} is reserved (double-underscore "
+                    "prefix marks system columns)"
+                )
+            out.append((k, self.convert_expr(v, env)))
+        return tuple(out)
+
+    def _convert_merge(
+        self, c: A.MergeClause, env: Dict[str, CypherType]
+    ) -> B.MergeOp:
+        nodes, rels = self._convert_write_pattern(c.pattern, env)
+        if len(rels) > 1:
+            raise IRBuildError("MERGE supports at most one relationship")
+        if rels:
+            by_var = {t.var: t for t in nodes}
+            for end in (rels[0].src, rels[0].dst):
+                if not by_var[end].bound:
+                    raise IRBuildError(
+                        "MERGE relationship endpoints must be bound "
+                        "variables (merge the nodes first)"
+                    )
+        on_create = tuple(self._convert_set_item(i, env) for i in c.on_create)
+        on_match = tuple(self._convert_set_item(i, env) for i in c.on_match)
+        return B.MergeOp(nodes, rels, on_create, on_match)
+
+    def _convert_set_item(
+        self, item: A.SetItem, env: Dict[str, CypherType]
+    ) -> B.SetItemSpec:
+        target = item.target
+        if isinstance(target, E.Property):
+            if not isinstance(target.expr, E.Var):
+                raise IRBuildError("SET target must be a variable property")
+            var = target.expr.name
+            self._check_set_var(var, env)
+            if target.key.startswith("__"):
+                raise IRBuildError(
+                    f"property key {target.key!r} is reserved"
+                )
+            return B.SetItemSpec(
+                var, key=target.key, value=self.convert_expr(item.value, env)
+            )
+        if isinstance(target, E.Var):
+            var = target.name
+            self._check_set_var(var, env)
+            if item.labels:
+                return B.SetItemSpec(var, labels=tuple(item.labels))
+            return B.SetItemSpec(var, value=self.convert_expr(item.value, env))
+        raise IRBuildError(f"unsupported SET target {target.pretty_expr()}")
+
+    def _check_set_var(self, var: str, env: Dict[str, CypherType]) -> None:
+        if var not in env:
+            raise IRBuildError(f"SET on unbound variable {var!r}")
+        m = env[var].material
+        if not isinstance(m, (T.CTNodeType, T.CTRelationshipType)):
+            raise IRBuildError(f"SET target {var!r} is not an element")
+
+    def _convert_delete(
+        self, c: A.DeleteClause, env: Dict[str, CypherType]
+    ) -> B.DeleteOp:
+        fields = []
+        for e in c.exprs:
+            if not isinstance(e, E.Var):
+                raise IRBuildError("DELETE takes bound element variables")
+            if e.name not in env:
+                raise IRBuildError(f"DELETE on unbound variable {e.name!r}")
+            m = env[e.name].material
+            if not isinstance(m, (T.CTNodeType, T.CTRelationshipType)):
+                raise IRBuildError(f"DELETE target {e.name!r} is not an element")
+            fields.append(e.name)
+        return B.DeleteOp(tuple(fields), c.detach)
 
     def _resolve_qgn(self, name: str) -> str:
         if "." in name:

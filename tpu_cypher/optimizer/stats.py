@@ -176,3 +176,30 @@ class GraphStatistics:
             digest = hashlib.sha256("|".join(parts).encode()).hexdigest()
             self._fingerprint = digest[:16]
         return self._fingerprint
+
+
+def seed_statistics(
+    graph,
+    *,
+    node_counts: Dict[Tuple[str, ...], int],
+    rel_counts: Dict[Tuple[str, ...], int],
+    fingerprint: str,
+) -> GraphStatistics:
+    """Stamp pre-computed statistics onto a graph object — the incremental
+    versioning path for mutation snapshots (``storage/delta.py``). The
+    mutable store maintains total and single-label/type cardinalities
+    per write batch and chains the fingerprint
+    (``advance_fingerprint``), so every snapshot carries exact counts and
+    a batch-unique fingerprint with NO rescan; compound label-set counts
+    and degree families stay lazy and compute against the (immutable)
+    snapshot on demand. Because ``of`` caches on the graph attribute this
+    writes, seeded statistics win over lazy collection."""
+    st = GraphStatistics(graph)
+    st._node_counts.update(node_counts)
+    st._rel_counts.update(rel_counts)
+    st._fingerprint = fingerprint
+    try:
+        graph._tpu_cypher_opt_stats = st
+    except AttributeError:  # pragma: no cover - exotic graph without __dict__
+        pass
+    return st

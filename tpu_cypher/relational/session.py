@@ -19,6 +19,7 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 from ..api import types as T
 from ..api.mapping import NodeMapping, RelationshipMapping
 from ..api.schema import PropertyGraphSchema
+from ..errors import MutationError
 from ..frontend import ast as A
 from ..frontend.parser import parse as parse_cypher
 from ..ir import blocks as B
@@ -140,6 +141,15 @@ def _graph_to_local(g: RelationalCypherGraph) -> RelationalCypherGraph:
         return OverlayGraph([_graph_to_local(m) for m in g.members])
     if isinstance(g, EmptyGraph):
         return g
+    from ..storage.delta import SnapshotGraph
+
+    if isinstance(g, SnapshotGraph):
+        return SnapshotGraph(
+            _graph_to_local(g.base),
+            _graph_to_local(g.live) if g.live is not None else None,
+            _graph_to_local(g.dead) if g.dead is not None else None,
+            g.version,
+        )
     raise TypeError(f"no host conversion for graph type {type(g).__name__}")
 
 
@@ -894,7 +904,13 @@ class CypherSession:
     # beyond the ambient graph — such queries are never plan-cached. FROM
     # alone covers the keyword-optional `FROM <name>` form; a false match
     # (e.g. a property named `from`) only skips caching, never corrupts.
-    _PLAN_CACHE_EXCLUDES = ("FROM", "CATALOG", "CONSTRUCT", "GRAPH")
+    # CREATE/MERGE/SET/DELETE/DETACH mark write queries (docs/mutation.md):
+    # they run host-side against the mutable store and produce no reusable
+    # relational plan, so they never enter the plan cache either.
+    _PLAN_CACHE_EXCLUDES = (
+        "FROM", "CATALOG", "CONSTRUCT", "GRAPH",
+        "CREATE", "MERGE", "SET", "DELETE", "DETACH",
+    )
     _PLAN_CACHE_MAX = 256
 
     def _plan_cache_key(self, query, graph, parameters, driving_table):
@@ -980,12 +996,19 @@ class CypherSession:
             from .. import errors as ERR
             from ..runtime import guard as G
 
+            from .mutate import is_write_query
+
             typed = ERR.classify(exc)
             if (
                 typed is None
                 or not typed.retryable
                 or not G.ladder_enabled()
                 or self._host_session() is None
+                # a write must NEVER re-execute on the host oracle: the
+                # host session would mutate a converted COPY of the store
+                # (silently wrong), and a commit-site fault already left
+                # the real store untouched — surface it typed instead
+                or is_write_query(query)
             ):
                 raise
             host = self._host_session()
@@ -995,8 +1018,11 @@ class CypherSession:
                     query, parameters, graph=hg, driving_table=driving_table
                 )
             except Exception:
+                # surface the ORIGINAL device fault, not the host rung's
+                # own plumbing error (a bare ``raise`` here would re-raise
+                # the latter — the active exception of THIS except block)
                 if typed is exc:
-                    raise
+                    raise exc
                 raise typed from exc
             result.execution_log.append(
                 {
@@ -1018,6 +1044,18 @@ class CypherSession:
         driving_table=None,
     ) -> CypherResult:
         parameters = dict(parameters or {})
+        # A mutable ambient graph pins the snapshot it had when the query
+        # arrived (docs/mutation.md): readers plan and execute against that
+        # immutable (base, delta) pair; concurrent writers publish new
+        # snapshots without ever blocking this query. The snapshot object is
+        # cached per version, so its identity doubles as the plan-cache
+        # graph identity (a committed write changes it -> replan).
+        from ..storage.delta import MutableGraph as _MG
+
+        mutable = None
+        if graph is not None and isinstance(graph._graph, _MG):
+            mutable = graph._graph
+            graph = PropertyGraph(self, mutable.snapshot())
         cache_key = self._plan_cache_key(query, graph, parameters, driving_table)
         if cache_key is not None:
             hit = self._plan_cache.get(cache_key)
@@ -1102,6 +1140,27 @@ class CypherSession:
                 else:
                     self.drop_graph(ir.qgn)
                 return CypherResult(self, None, None, None)
+
+            if isinstance(ir, B.UpdateIR):
+                if mutable is None:
+                    raise MutationError(
+                        "write queries require a mutable graph; this graph "
+                        "is immutable (create it via "
+                        "storage.mutable_graph_from_create_query)"
+                    )
+                from .mutate import execute_update
+
+                def run_read(read_ir):
+                    return self._plan_and_run(
+                        read_ir, parameters, input_fields, driving_table,
+                        driving_header, ambient_qgn, schemas,
+                    )
+
+                result = execute_update(
+                    self, ir, mutable, parameters, run_read
+                )
+                result._trace = trace
+                return result
 
             result = self._plan_and_run(
                 ir, parameters, input_fields, driving_table, driving_header,

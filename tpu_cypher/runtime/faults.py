@@ -12,8 +12,13 @@ specs):
   ``filter``, ``compact``, ``shuffle``, ``agg``, plus the Pallas kernel-tier sites
   ``kernel_join``/``kernel_expand``/``kernel_agg``/``kernel_frontier``
   fired by ``backend.tpu.pallas.dispatch.launch`` just before a kernel
-  launch; grep ``fault_point(`` and ``dispatch.register(`` for the full
-  set)
+  launch, and the write-path sites ``wal_append`` (before the WAL
+  append: the write fails with nothing durable), ``delta_apply``
+  (after the append, before the in-memory apply: commit rolls the WAL
+  back to the pre-append offset) and ``compact`` again inside
+  ``MutableGraph._maybe_compact`` (the already-committed write survives;
+  compaction defers to the next commit) — see ``storage/delta.py``.
+  Grep ``fault_point(`` and ``dispatch.register(`` for the full set)
 * ``occurrence`` — WHICH invocations of the site fire, 1-based:
   ``:3`` (exactly the 3rd), ``:2-5`` (2nd through 5th), ``:*`` (every
   invocation — drives the ladder all the way to the host oracle). Default

@@ -36,6 +36,7 @@ import tempfile
 import time
 from typing import Any, Dict, List, Optional
 
+from ..storage.wal import wal_directory
 from ..utils.config import (
     COMPILE_CACHE_DIR,
     SERVE_DRAIN_TIMEOUT_S,
@@ -65,6 +66,7 @@ class ClusterServer(QueryServer):  # shared-by: loop
         hedge_ms: Optional[float] = None,
         lanes: int = 4,
         cache_bytes: Optional[int] = None,
+        wal_dir: Optional[str] = None,
     ):
         self.n_workers = max(
             int(workers if workers is not None else SERVE_WORKERS.get()), 1
@@ -85,7 +87,13 @@ class ClusterServer(QueryServer):  # shared-by: loop
             or tempfile.mkdtemp(prefix="tpu-cypher-cluster-cache-")
         )
         self.lanes = int(lanes)
+        # where worker WAL files live (one per mutable graph); defaults to
+        # 'wal/' beside the shared compile cache — durability artifacts
+        # ride next to the compile artifacts a restarted worker re-warms
+        # from (storage.wal.wal_directory resolution)
+        self.wal_dir = wal_directory(wal_dir, self.persistent_cache_dir)
         self._graph_specs: Dict[str, str] = {}
+        self._mutable_graphs: set = set()
         self._warmup_specs: Dict[str, List[str]] = {}
         self._launcher = launcher
         self._retry_max = retry_max
@@ -95,12 +103,20 @@ class ClusterServer(QueryServer):  # shared-by: loop
 
     # -- graphs: replicated by CREATE text -------------------------------
 
-    def register_graph(self, name: str, create_query: str) -> None:  # type: ignore[override]
+    def register_graph(
+        self, name: str, create_query: str, mutable: bool = False
+    ) -> None:  # type: ignore[override]
         """Mount a graph cluster-wide from its CREATE query text. The
         front end builds a LOCAL replica too (cost estimation, batching
         keys, and the single-process protocol surface all need a real
-        graph object); workers each build theirs at boot."""
+        graph object); workers each build theirs at boot. ``mutable``
+        graphs boot on the workers as delta-CSR stores sharing one WAL
+        file under ``wal_dir`` — the front-end replica stays immutable
+        (it never executes queries; its fingerprint is refreshed from
+        each write payload)."""
         self._graph_specs[name] = create_query
+        if mutable:
+            self._mutable_graphs.add(name)
         graph = self.session.create_graph_from_create_query(create_query)
         super().register_graph(name, graph)
 
@@ -121,6 +137,8 @@ class ClusterServer(QueryServer):  # shared-by: loop
                 self._graph_specs, self._warmup_specs,
                 persistent_cache_dir=self.persistent_cache_dir,
                 host=self.host, lanes=self.lanes,
+                mutable=sorted(self._mutable_graphs),
+                wal_dir=self.wal_dir,
             )
         canary = None
         if self._graph_specs:

@@ -71,6 +71,13 @@ def graph_fingerprint(session, graph) -> str:
         from ..optimizer.stats import GraphStatistics
 
         base = getattr(graph, "_graph", graph)
+        own = getattr(base, "fingerprint", None)
+        if callable(own):
+            # mutable graphs chain their fingerprint per committed write
+            # batch (storage.delta.advance_fingerprint) — the serving tier
+            # refreshes its copy from each write payload, so cache entries
+            # stored under older fingerprints simply stop matching
+            return own()
         ctx = session._runtime_context({})
         return GraphStatistics.of(base, ctx).fingerprint()
     except Exception:  # fault-ok: degrade to identity-based invalidation

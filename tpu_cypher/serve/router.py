@@ -10,10 +10,13 @@ takes to answer it, without the client ever noticing:
   worker; availability is re-evaluated per attempt, so affinity BENDS
   under failure instead of breaking.
 
-* **replica retry** — every query the serving tier accepts is a read
-  (graphs are immutable snapshots; mutation happens at registration), so
-  a ``WorkerLost`` mid-query is safely retryable on a surviving replica.
-  Each failed attempt is stamped into the client-visible
+* **replica retry** — reads run against immutable snapshots, so a
+  ``WorkerLost`` mid-query is safely retryable on a surviving replica.
+  Writes retry too: a batch the dying worker committed is replayed from
+  the shared WAL by the next writer, and the retried statement simply
+  re-executes there (phrase writes as MERGE for exactly-once under
+  retry — docs/mutation.md). Each failed attempt is stamped into the
+  client-visible
   ``execution_log`` as rung ``"replica"`` (``guard.RUNG_REPLICA``) —
   transparent recovery stays auditable, exactly like the in-process
   degrade ladder. Retries deliberately DROP the request's fault schedule:
@@ -24,7 +27,10 @@ takes to answer it, without the client ever noticing:
   still unanswered after the delay is duplicated to a second replica and
   the first reply wins (the tail-latency trade from "The Tail at Scale":
   pay one duplicate execute to cut p99). Hedging is skipped for faulted
-  requests — a chaos schedule must fire exactly once.
+  requests — a chaos schedule must fire exactly once — and for writes,
+  which would otherwise execute twice by design. Writes also skip the
+  tenant hash: they pin to the first ready worker in stable id order
+  (the single-writer discipline; see ``_pick``).
 
 The router never talks to a breaker directly beyond ``allow()`` — failure
 accounting flows through ``Supervisor.note_failure`` so process-death
@@ -82,11 +88,16 @@ class Router:  # shared-by: loop
     # -- worker selection ------------------------------------------------
 
     def _pick(
-        self, tenant: str, exclude: Optional[set] = None
+        self, tenant: str, exclude: Optional[set] = None, *,
+        write: bool = False,
     ) -> WorkerHandle:
         """Tenant-affine choice over the CURRENTLY available workers.
         ``exclude`` removes workers this query already watched die, so a
-        retry lands elsewhere even before the breaker reacts."""
+        retry lands elsewhere even before the breaker reacts. A ``write``
+        goes to the FIRST ready worker in stable id order — one writer at
+        a time keeps its delta overlay warm, and failover is simply the
+        next worker in that order (the shared WAL's exclusive lock +
+        catch-up make the hand-off safe regardless)."""
         ready = [
             w for w in self.supervisor.ready_workers
             if not (exclude and w.worker_id in exclude)
@@ -100,6 +111,8 @@ class Router:  # shared-by: loop
                 "no available engine worker (all down or breaker-open)",
                 site="serve-routing",
             )
+        if write:
+            return min(ready, key=lambda w: w.worker_id)
         idx = zlib.crc32(tenant.encode()) % len(ready)
         return ready[idx]
 
@@ -108,6 +121,7 @@ class Router:  # shared-by: loop
         tenant: str,
         tried: set,
         deadline_at: Optional[float],
+        write: bool = False,
     ) -> WorkerHandle:
         """``_pick``, but an empty fleet waits (bounded) for the supervisor
         to bring a worker back instead of failing instantly. A restart is
@@ -118,7 +132,7 @@ class Router:  # shared-by: loop
             wait_until = min(wait_until, deadline_at)
         while True:
             try:
-                return self._pick(tenant, exclude=tried)
+                return self._pick(tenant, exclude=tried, write=write)
             except ERR.WorkerLost:
                 if time.monotonic() >= wait_until:
                     raise
@@ -142,6 +156,13 @@ class Router:  # shared-by: loop
         deadline_at = (
             time.monotonic() + deadline_s if deadline_s else None
         )
+        # writes pin to the stable writer pick and never hedge (a hedged
+        # write would execute twice by design). WorkerLost retry stays ON:
+        # a write the dying worker committed is recovered from the WAL by
+        # its successor, and the retried statement re-executes there —
+        # callers wanting exactly-once under retry phrase writes as MERGE
+        # (docs/mutation.md)
+        is_write = wire.is_write_query(query)
         retry_log: List[Dict[str, Any]] = []
         spec = faults
         tried: set = set()
@@ -152,7 +173,9 @@ class Router:  # shared-by: loop
                     f"attempt(s)",
                     site="serve-routing",
                 )
-            w = await self._pick_or_wait(tenant, tried, deadline_at)
+            w = await self._pick_or_wait(
+                tenant, tried, deadline_at, write=is_write
+            )
             req = {
                 "op": "execute", "id": qid, "graph": graph, "query": query,
                 "parameters": parameters or {}, "faults": spec,
@@ -161,7 +184,7 @@ class Router:  # shared-by: loop
                 req["deadline_s"] = max(deadline_at - time.monotonic(), 1e-6)
             t0 = time.monotonic()
             try:
-                if self._should_hedge(spec, deadline_at):
+                if not is_write and self._should_hedge(spec, deadline_at):
                     reply = await self._hedged(w, tenant, tried, req)
                 else:
                     reply = await self._call(w, req)

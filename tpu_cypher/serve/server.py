@@ -40,8 +40,9 @@ scheduler, and a batch coalescer, and serves two protocols on ONE port:
   returns the per-query record — status, execution log, ladder rungs,
   batch tags, and the full ``profile()`` span tree as JSON.
   ``GET /cache`` reports result-cache occupancy and hit counters;
-  ``GET /cache/flush`` drops every cached result (cluster mode fans the
-  flush out to its worker processes).
+  ``POST /cache/flush`` drops every cached result (cluster mode fans the
+  flush out to its worker processes; GET on it is 405 — a probe or
+  crawler must never drop the cache).
 
 Execution path per submit: resolve graph -> batch coalescing
 (``serve/batching.py``) -> pre-flight budget admission + cost-ordered,
@@ -294,7 +295,7 @@ class QueryServer:  # shared-by: loop
             first = await reader.readline()
             if not first:
                 return
-            if first[:4] in (b"GET ", b"HEAD"):
+            if first[:4] in (b"GET ", b"HEAD") or first[:5] == b"POST ":
                 await self._handle_http(first, reader, writer)
                 return
             await self._handle_line(first, conn)
@@ -446,9 +447,14 @@ class QueryServer:  # shared-by: loop
             return
         # chaos schedules and per-request deadlines are client-scoped
         # state: such queries never share a dispatch — and, for the same
-        # reason, never hit or populate the result cache
+        # reason, never hit or populate the result cache. Writes are also
+        # excluded (belt to batch_key's suspenders): each must execute.
         key = None
-        if t.faults is None and t.deadline_s is None:
+        if (
+            t.faults is None
+            and t.deadline_s is None
+            and not wire.is_write_query(t.query)
+        ):
             key = batch_key(self.session, t.query, graph, t.parameters)
             hit = self.cache.lookup(key, self._fingerprints.get(t.graph_name, ""))
             if hit is not None:
@@ -510,6 +516,12 @@ class QueryServer:  # shared-by: loop
                 "route", wall - float(payload.get("seconds") or 0.0)
             )
             self.batcher.publish(batch, result=payload)
+            write_stats = payload.get("write")
+            if write_stats and write_stats.get("fingerprint"):
+                # a committed write advanced the graph's chained
+                # fingerprint: refresh our copy so result-cache entries
+                # stored under the old one stop matching from now on
+                self._fingerprints[t.graph_name] = write_stats["fingerprint"]
             fp = self._fingerprints.get(t.graph_name)
             if batch.key is not None and fp is not None:
                 # populate AFTER publish (and after any router mutation):
@@ -721,7 +733,7 @@ class QueryServer:  # shared-by: loop
         self._tickets.pop(t.qid, None)
 
     async def _flush_caches(self) -> int:
-        """Drop every cached result (``GET /cache/flush``). The cluster
+        """Drop every cached result (``POST /cache/flush``). The cluster
         tier overrides this to also fan out to its workers."""
         return self.cache.flush()
 
@@ -737,16 +749,32 @@ class QueryServer:  # shared-by: loop
             if not line or line in (b"\r\n", b"\n"):
                 break
         try:
-            _, path, _ = first.decode("latin-1").split(" ", 2)
+            method, path, _ = first.decode("latin-1").split(" ", 2)
         except ValueError:
-            path = "/"
+            method, path = "GET", "/"
         if path.split("?", 1)[0] == "/cache/flush":
-            # the one ASYNC route: the cluster tier fans the flush out to
-            # its worker processes over the wire
-            dropped = await self._flush_caches()
+            if method != "POST":
+                # flushing is a state change: POST only. A GET (a crawler,
+                # a stray browser tab, a monitoring probe) must never drop
+                # the cache.
+                status, ctype, body = (
+                    "405 Method Not Allowed", "application/json",
+                    json.dumps(
+                        {"error": "/cache/flush requires POST"}
+                    ).encode(),
+                )
+            else:
+                # the one ASYNC route: the cluster tier fans the flush out
+                # to its worker processes over the wire
+                dropped = await self._flush_caches()
+                status, ctype, body = (
+                    "200 OK", "application/json",
+                    json.dumps({"flushed": dropped}).encode(),
+                )
+        elif method == "POST":
             status, ctype, body = (
-                "200 OK", "application/json",
-                json.dumps({"flushed": dropped}).encode(),
+                "405 Method Not Allowed", "application/json",
+                json.dumps({"error": f"no POST route {path!r}"}).encode(),
             )
         else:
             status, ctype, body = self._http_response(path)

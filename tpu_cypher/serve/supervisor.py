@@ -231,12 +231,16 @@ class SubprocessLauncher:
         persistent_cache_dir: Optional[str] = None,
         host: str = "127.0.0.1",
         lanes: int = 4,
+        mutable: Optional[List[str]] = None,
+        wal_dir: Optional[str] = None,
     ):
         self.graphs = dict(graphs)
         self.warmup = {k: list(v) for k, v in warmup.items()}
         self.persistent_cache_dir = persistent_cache_dir
         self.host = host
         self.lanes = lanes
+        self.mutable = sorted(mutable or ())
+        self.wal_dir = wal_dir
 
     async def spawn(self, worker_id: str) -> SubprocessTransport:
         env = dict(os.environ)
@@ -259,6 +263,8 @@ class SubprocessLauncher:
             "warmup": self.warmup,
             "persistent_cache_dir": self.persistent_cache_dir,
             "lanes": self.lanes,
+            "mutable": self.mutable,
+            "wal_dir": self.wal_dir,
         }
         proc.stdin.write((json.dumps(cfg) + "\n").encode())
         await proc.stdin.drain()

@@ -40,6 +40,11 @@ from ..runtime import faults as F
 from ..runtime import guard as G
 
 
+# canonical write sniff lives beside the write executor; re-exported here
+# because every serving tier keys cache/batch/routing decisions off it
+from ..relational.mutate import is_write_query  # noqa: F401
+
+
 def json_value(v: Any) -> Any:
     """JSON-safe wire form of a Cypher value. Scalars pass through;
     structured and temporal values ride their deterministic Cypher text
@@ -81,7 +86,7 @@ def execute_payload(
         columns = list(records.columns) if records is not None else []
     log = list(result.execution_log)
     rungs = [e["rung"] for e in log]
-    return {
+    payload = {
         "rows": encode_rows(rows, columns),
         "columns": columns,
         "seconds": round(time.perf_counter() - t0, 6),
@@ -91,6 +96,10 @@ def execute_payload(
         "compile_stats": result.compile_stats,
         "profile": result.profile(execute=False).to_dict(),
     }
+    write_stats = getattr(result, "write_stats", None)
+    if write_stats is not None:
+        payload["write"] = write_stats
+    return payload
 
 
 def open_stream(

@@ -53,6 +53,7 @@ from typing import Any, Dict, Optional
 from .. import errors as ERR
 from ..relational.session import CypherSession
 from ..runtime import faults as F
+from ..storage.wal import wal_directory
 from . import wire
 from .batching import batch_key
 from .result_cache import ResultCache, graph_fingerprint
@@ -173,13 +174,20 @@ class EngineWorker:  # shared-by: loop
         self.inflight += 1
         try:
             payload = await self.pool.run(
-                lambda: wire.execute_payload(
-                    self.pool.session, graph, msg["query"],
-                    msg.get("parameters"),
-                    deadline_s=msg.get("deadline_s"),
-                    faults=msg.get("faults"),
-                )
+                lambda: self._execute(graph, msg)
             )
+            refreshed = payload.pop("_wal_refresh_fingerprint", None)
+            if refreshed is not None:
+                # the pool-lane execution replayed WAL batches; apply the
+                # advanced fingerprint here, on the loop that owns it
+                self._fingerprints[msg.get("graph")] = refreshed
+            write_stats = payload.get("write")
+            if write_stats and write_stats.get("fingerprint"):
+                # the committed write advanced the graph's chained
+                # fingerprint: refresh so our cached reads stop matching
+                self._fingerprints[msg.get("graph")] = (
+                    write_stats["fingerprint"]
+                )
             if key is not None:
                 self.cache.store(key, fp, payload)
             return {"id": qid, "ok": True, "payload": payload}
@@ -194,6 +202,29 @@ class EngineWorker:  # shared-by: loop
             self.inflight -= 1
             self._idle.set()
 
+    def _execute(self, graph, msg: Dict[str, Any]) -> Dict[str, Any]:
+        """One engine execution on a pool lane. A read against a mutable
+        graph first refreshes from the shared WAL (read-your-writes on a
+        replica that is not the current writer; a no-op for the writer and
+        for immutable graphs). The refreshed fingerprint travels back in
+        the payload — ``self._fingerprints`` is loop-owned state, so the
+        write-back happens in ``_op_execute`` on the event loop, never on
+        a pool lane."""
+        base = getattr(graph, "_graph", graph)
+        refresh = getattr(base, "refresh", None)
+        refreshed = None
+        if callable(refresh) and refresh():
+            refreshed = base.fingerprint()
+        payload = wire.execute_payload(
+            self.pool.session, graph, msg["query"],
+            msg.get("parameters"),
+            deadline_s=msg.get("deadline_s"),
+            faults=msg.get("faults"),
+        )
+        if refreshed is not None:
+            payload["_wal_refresh_fingerprint"] = refreshed
+        return payload
+
 
 def main() -> None:
     cfg = json.loads(sys.stdin.readline())
@@ -205,10 +236,29 @@ def main() -> None:
     session = CypherSession.tpu(
         persistent_cache_dir=cfg.get("persistent_cache_dir") or None
     )
-    graphs = {
-        name: session.create_graph_from_create_query(create_query)
-        for name, create_query in (cfg.get("graphs") or {}).items()
-    }
+    # graphs marked mutable boot as delta-CSR stores with a WAL persisted
+    # beside the compile cache: the CREATE-query replay rebuilds the base,
+    # then attach_wal replays every committed batch — a SIGKILLed worker
+    # restarts with exactly the committed writes (docs/mutation.md)
+    mutable_names = set(cfg.get("mutable") or ())
+    wal_dir = wal_directory(
+        cfg.get("wal_dir"), cfg.get("persistent_cache_dir")
+    )
+    graphs = {}
+    for name, create_query in (cfg.get("graphs") or {}).items():
+        if name in mutable_names:
+            from ..storage import mutable_graph_from_create_query
+
+            wal_path = (
+                os.path.join(wal_dir, f"{name}.wal") if wal_dir else None
+            )
+            graphs[name] = mutable_graph_from_create_query(
+                session, create_query, name=name, wal_path=wal_path
+            )
+        else:
+            graphs[name] = session.create_graph_from_create_query(
+                create_query
+            )
     warmup_stats: Dict[str, Any] = {"queries": 0, "compiles": 0}
     for graph_name, queries in (cfg.get("warmup") or {}).items():
         stats = session.warmup(queries, graph=graphs[graph_name])

@@ -481,6 +481,41 @@ SERVE_STREAM_CHUNK_ROWS = declare(
     "ladder's chunk machinery); 0 = follow TPU_CYPHER_CHUNK_ROWS",
 )
 
+# transactional mutation (storage/): write-ahead-log durability and
+# delta-overlay compaction (docs/mutation.md)
+WAL_DIR = declare(
+    "TPU_CYPHER_WAL_DIR",
+    "",
+    str,
+    help="write-ahead log directory; empty = derive '<compile cache>/wal' "
+    "when a persistent compile cache is configured, else mutations are "
+    "in-memory only (no durability)",
+)
+WAL_SYNC = declare(
+    "TPU_CYPHER_WAL_SYNC",
+    "fsync",
+    str,
+    help="WAL commit durability: fsync (default, survives SIGKILL and "
+    "power loss) | flush (OS buffers only: survives SIGKILL, not power "
+    "loss) | off (test-only, no flush at commit)",
+)
+COMPACT_DELTA_MAX = declare(
+    "TPU_CYPHER_COMPACT_DELTA_MAX",
+    256,
+    int,
+    help="delta-overlay row threshold: a committed batch leaving more "
+    "than this many live+tombstone delta rows triggers compaction into a "
+    "fresh immutable base",
+)
+COMPACT_MIN_BUCKET = declare(
+    "TPU_CYPHER_COMPACT_MIN_BUCKET",
+    8,
+    int,
+    help="minimum row bucket a delta-overlay table is host-padded to when "
+    "shape bucketing is on, so small deltas share one program shape "
+    "across write batches",
+)
+
 # observability (obs/metrics.py, utils/profiling.py, obs/trace.py)
 METRICS_FILE = declare(
     "TPU_CYPHER_METRICS_FILE",
